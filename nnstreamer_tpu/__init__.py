@@ -1,0 +1,42 @@
+"""nnstreamer_tpu — a TPU-native streaming-inference framework.
+
+A brand-new framework with the capabilities of NNStreamer (reference:
+DaeyangCho/nnstreamer): typed multi-tensor streams flowing through composable
+pipeline elements (convert, transform, filter/infer, decode, mux/demux/merge/
+split, aggregate, rate-control, conditional branch, recurrence), pluggable
+model backends behind a stable filter API, runtime latency/throughput
+instrumentation, and distributed offload — re-designed idiomatically for TPU:
+
+- the compute path is JAX/XLA: filters jit their models, tensors stay
+  device-resident (``jax.Array`` in HBM) as they flow between elements;
+- batching across sources (tensor_mux) becomes one batched XLA invoke;
+- multi-chip scaling uses ``jax.sharding.Mesh`` + XLA collectives over ICI,
+  not hand-rolled transports;
+- distributed offload (tensor_query equivalent) runs a framed TCP / gRPC
+  front-end over DCN feeding the sharded on-device path.
+
+Layer map (mirrors SURVEY.md §1):
+
+- L1 ``tensors``   — tensor type system, caps, buffers, flexible/sparse meta
+- L2 ``config`` / ``registry`` — ini+env config, subplugin registries
+- L3 ``elements`` / ``pipeline`` — stream elements and the pipeline core
+- L4 ``filters.api`` — the filter-framework vtable (FilterFramework)
+- L5 ``filters.*`` / ``decoders`` / ``converters`` — subplugins
+- L6 ``query`` — distributed client/server/pub-sub
+- L7 ``single`` / ``parse`` — pipeline-less invoke + gst-launch-style CLI
+"""
+
+__version__ = "0.1.0"
+
+from nnstreamer_tpu.tensors.types import (  # noqa: F401
+    TensorType,
+    TensorFormat,
+    TensorInfo,
+    TensorsInfo,
+    TensorsConfig,
+    NNS_TENSOR_RANK_LIMIT,
+    NNS_TENSOR_SIZE_LIMIT,
+)
+from nnstreamer_tpu.tensors.buffer import TensorBuffer  # noqa: F401
+from nnstreamer_tpu.pipeline.pipeline import Pipeline  # noqa: F401
+from nnstreamer_tpu.pipeline.parse import parse_launch  # noqa: F401

@@ -1,0 +1,105 @@
+"""Config system: ini file + environment-variable overrides.
+
+Capability parity with the reference's three-layer config
+(``nnstreamer_conf.c``, 717 LoC; ``nnstreamer.ini.in``):
+
+1. an ini file — default ``/etc/nnstreamer_tpu.ini`` or
+   ``$NNSTREAMER_TPU_CONF`` (reference envvar ``NNSTREAMER_CONF``,
+   nnstreamer_conf.h:61);
+2. env-var overrides ``NNSTREAMER_TPU_<GROUP>_<KEY>`` (reference
+   ``NNSTREAMER_${group}_${key}``, nnstreamer_conf.h:149-164);
+3. runtime element properties (handled by the elements themselves).
+
+Recognized groups/keys mirror the reference's:
+``[common] enable_envvar``, ``[filter] filters=<subplugin search paths>``,
+``[filter] framework_priority_<ext>`` for framework auto-detection by model
+extension (reference ``get_subplugin_priority``), and per-framework sections
+(e.g. ``[jax] platform=tpu``).
+"""
+
+from __future__ import annotations
+
+import configparser
+import os
+import threading
+from typing import Dict, List, Optional
+
+ENV_CONF = "NNSTREAMER_TPU_CONF"
+ENV_PREFIX = "NNSTREAMER_TPU_"
+DEFAULT_CONF_PATHS = (
+    os.path.expanduser("~/.config/nnstreamer_tpu.ini"),
+    "/etc/nnstreamer_tpu.ini",
+)
+
+#: Default model-extension → framework priority (reference nnstreamer.ini.in
+#: [filter] framework priorities). First loadable wins.
+DEFAULT_EXT_PRIORITY: Dict[str, List[str]] = {
+    ".msgpack": ["jax"],
+    ".jax": ["jax"],
+    ".orbax": ["jax"],
+    ".stablehlo": ["jax"],
+    ".mlir": ["jax"],
+    ".pt": ["torch"],
+    ".pth": ["torch"],
+    ".pt2": ["torch"],
+    ".tflite": ["tflite", "jax"],
+    ".py": ["python"],
+    ".so": ["custom"],
+}
+
+
+class Conf:
+    """Parsed configuration with env overrides. Thread-safe singleton via
+    :func:`get_conf`."""
+
+    def __init__(self, path: Optional[str] = None):
+        self._cp = configparser.ConfigParser()
+        self.path = path or os.environ.get(ENV_CONF)
+        if not self.path:
+            for p in DEFAULT_CONF_PATHS:
+                if os.path.isfile(p):
+                    self.path = p
+                    break
+        if self.path and os.path.isfile(self.path):
+            self._cp.read(self.path)
+
+    def get(self, group: str, key: str, default: Optional[str] = None):
+        """Env override first (NNSTREAMER_TPU_<GROUP>_<KEY>), then ini."""
+        env = os.environ.get(f"{ENV_PREFIX}{group.upper()}_{key.upper()}")
+        if env is not None:
+            return env
+        return self._cp.get(group, key, fallback=default)
+
+    def get_bool(self, group: str, key: str, default: bool = False) -> bool:
+        v = self.get(group, key)
+        if v is None:
+            return default
+        return str(v).strip().lower() in ("1", "true", "yes", "on")
+
+    def subplugin_paths(self, kind: str) -> List[str]:
+        """Extra search paths for dynamically-discovered subplugins
+        (reference [filter]/[decoder]/[converter] path keys)."""
+        raw = self.get(kind, "path", "") or ""
+        return [p for p in raw.split(os.pathsep) if p]
+
+    def framework_priority(self, model_path: str) -> List[str]:
+        """Framework candidates for a model file, best first (reference
+        framework auto-detect by extension, tensor_filter_common.c:1200)."""
+        ext = os.path.splitext(model_path)[1].lower()
+        key = f"framework_priority_{ext.lstrip('.')}"
+        raw = self.get("filter", key)
+        if raw:
+            return [f.strip() for f in raw.split(",") if f.strip()]
+        return list(DEFAULT_EXT_PRIORITY.get(ext, []))
+
+
+_conf: Optional[Conf] = None
+_lock = threading.Lock()
+
+
+def get_conf(refresh: bool = False) -> Conf:
+    global _conf
+    with _lock:
+        if _conf is None or refresh:
+            _conf = Conf()
+        return _conf

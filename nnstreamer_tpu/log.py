@@ -1,0 +1,23 @@
+"""Logging façade (reference ``nnstreamer_log.h:29-77`` ml_log* macros).
+
+The reference maps ml_loge/logw/logi/logd onto dlog/android-log/GLib per
+platform; we map onto :mod:`logging` with one namespaced logger per element
+and the same severity vocabulary. Elements honor a ``silent`` property by
+raising their logger's level (reference: per-element ``silent`` prop).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+_ROOT = "nnstreamer_tpu"
+
+logging.basicConfig(
+    level=os.environ.get("NNSTREAMER_TPU_LOGLEVEL", "WARNING").upper(),
+    format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
+)
+
+
+def get_logger(name: str = "") -> logging.Logger:
+    return logging.getLogger(f"{_ROOT}.{name}" if name else _ROOT)

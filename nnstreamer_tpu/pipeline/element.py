@@ -1,0 +1,326 @@
+"""Element / Pad / Event — the dataflow substrate.
+
+The reference builds on GStreamer's element model: elements expose src/sink
+pads; buffers flow downstream through per-pad ``chain`` functions; events
+(CAPS, EOS, custom like RELOAD_MODEL) flow alongside; caps negotiation fixes
+stream formats at link/first-buffer time. We keep exactly that capability —
+it is what makes 20+ semantics-agnostic elements composable — with a design
+chosen for the TPU runtime:
+
+- **Synchronous push by default.** A source thread drives its whole chain of
+  elements as plain function calls, so a ``jax.Array`` produced by one
+  element is consumed by the next with zero host round-trips and zero queue
+  latency. XLA's async dispatch already pipelines device work; host-side
+  threads per element (GStreamer's model) would only add latency.
+- **Explicit thread boundaries.** A ``queue`` element introduces a bounded
+  ring buffer + worker thread where stage decoupling is wanted (reference:
+  gst ``queue``); multi-input elements (mux/merge/join) are natural thread
+  joins and do their own locking.
+- **Events carry negotiation.** ``CapsEvent`` fixes per-pad
+  ``TensorsConfig``-bearing caps before the first buffer; elements override
+  hooks rather than reimplementing negotiation.
+
+Flow control mirrors GstFlowReturn: ``FlowReturn.OK/EOS``, errors raise
+:class:`FlowError` (carried to the pipeline bus by the driving thread).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from nnstreamer_tpu.log import get_logger
+from nnstreamer_tpu.pipeline.caps import ANY, Caps, CapsList
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
+from nnstreamer_tpu.utils.stats import InvokeStats
+
+
+class FlowReturn(enum.Enum):
+    OK = "ok"
+    EOS = "eos"
+
+
+class FlowError(RuntimeError):
+    """Fatal streaming error (GST_FLOW_ERROR equivalent)."""
+
+
+class PadDirection(enum.Enum):
+    SRC = "src"
+    SINK = "sink"
+
+
+# --------------------------------------------------------------------------
+# Events
+# --------------------------------------------------------------------------
+@dataclasses.dataclass
+class Event:
+    """Base event; flows downstream through pads."""
+
+
+@dataclasses.dataclass
+class CapsEvent(Event):
+    caps: Caps
+
+
+@dataclasses.dataclass
+class EosEvent(Event):
+    pass
+
+
+@dataclasses.dataclass
+class CustomEvent(Event):
+    """Named application event (reference custom downstream events, e.g.
+    RELOAD_MODEL on tensor_filter)."""
+
+    name: str
+    data: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+# --------------------------------------------------------------------------
+# Pad
+# --------------------------------------------------------------------------
+class Pad:
+    """A connection point on an element.
+
+    Sink pads receive buffers/events (dispatched to the owner element's
+    ``chain``/``sink_event``); src pads push to their linked peer.
+    """
+
+    def __init__(self, element: "Element", name: str,
+                 direction: PadDirection,
+                 template_caps: Optional[CapsList] = None):
+        self.element = element
+        self.name = name
+        self.direction = direction
+        self.template_caps = template_caps or CapsList.any()
+        self.peer: Optional["Pad"] = None
+        self.caps: Optional[Caps] = None  # negotiated, fixed caps
+        self.eos = False
+
+    # -- linking -------------------------------------------------------------
+    def link(self, sink: "Pad") -> None:
+        if self.direction is not PadDirection.SRC:
+            raise ValueError(f"{self} is not a src pad")
+        if sink.direction is not PadDirection.SINK:
+            raise ValueError(f"{sink} is not a sink pad")
+        if self.peer is not None or sink.peer is not None:
+            raise ValueError(f"pad already linked: {self} / {sink}")
+        inter = self.template_caps.intersect(sink.template_caps)
+        if inter.is_empty():
+            raise ValueError(
+                f"cannot link {self} -> {sink}: caps do not intersect "
+                f"({self.template_caps} vs {sink.template_caps})"
+            )
+        self.peer = sink
+        sink.peer = self
+
+    def unlink(self) -> None:
+        if self.peer is not None:
+            self.peer.peer = None
+            self.peer = None
+
+    # -- dataflow ------------------------------------------------------------
+    def push(self, buf: TensorBuffer) -> FlowReturn:
+        """Push a buffer downstream (src pads only)."""
+        if self.peer is None:
+            return FlowReturn.OK  # unlinked src: drop (gst would error; we
+            # drop to allow partial pipelines in tests)
+        return self.peer.element._chain_entry(self.peer, buf)
+
+    def push_event(self, event: Event) -> None:
+        if isinstance(event, CapsEvent):
+            self.caps = event.caps
+        if self.peer is not None:
+            self.peer.element._event_entry(self.peer, event)
+
+    def set_caps(self, caps: Caps) -> None:
+        """Fix this src pad's caps and announce downstream."""
+        if not caps.is_fixed():
+            caps = caps.fixate()
+        self.push_event(CapsEvent(caps))
+
+    def __repr__(self):
+        return f"Pad({self.element.name}.{self.name}:{self.direction.value})"
+
+
+# --------------------------------------------------------------------------
+# Element
+# --------------------------------------------------------------------------
+class Element:
+    """Base class for all stream elements.
+
+    Subclasses declare::
+
+        ELEMENT_NAME = "tensor_something"   # registry name
+        PROPERTIES = {"prop": default, ...}
+
+    and override :meth:`chain` (per-buffer work), :meth:`sink_event`
+    (negotiation via CapsEvent), and optionally :meth:`start`/:meth:`stop`
+    (state changes). Every element gets reference-style ``latency`` /
+    ``throughput`` read-outs via :attr:`stats` for free (tensor_filter.c
+    exposes these as properties; here they are uniform across elements,
+    which is what GstShark's proctime tracer adds externally).
+    """
+
+    ELEMENT_NAME = "element"
+    PROPERTIES: Dict[str, Any] = {"silent": True, "name": None}
+
+    def __init__(self, name: Optional[str] = None, **props):
+        cls_props: Dict[str, Any] = {}
+        for klass in reversed(type(self).__mro__):
+            cls_props.update(getattr(klass, "PROPERTIES", {}))
+        self._props = dict(cls_props)
+        self.name = name or f"{self.ELEMENT_NAME}{id(self) & 0xFFFF:x}"
+        self.log = get_logger(self.name)
+        self.sinkpads: List[Pad] = []
+        self.srcpads: List[Pad] = []
+        self.stats = InvokeStats()
+        self.pipeline = None  # set by Pipeline.add
+        self._started = False
+        self._lock = threading.RLock()
+        for k, v in props.items():
+            self.set_property(k, v)
+
+    # -- properties ----------------------------------------------------------
+    def set_property(self, key: str, value: Any) -> None:
+        key = key.replace("-", "_")
+        if key not in self._props:
+            raise KeyError(
+                f"{self.ELEMENT_NAME} has no property {key!r} "
+                f"(has: {sorted(self._props)})"
+            )
+        self._props[key] = self._coerce_property(key, value)
+        self.property_changed(key)
+
+    def get_property(self, key: str) -> Any:
+        key = key.replace("-", "_")
+        if key == "latency":
+            return self.stats.latency_us
+        if key == "throughput":
+            return self.stats.throughput_milli
+        return self._props[key]
+
+    def _coerce_property(self, key: str, value: Any) -> Any:
+        """Coerce string property values (from parse_launch) to the default's
+        type."""
+        default = self._props.get(key)
+        if isinstance(value, str):
+            if isinstance(default, bool):
+                return value.strip().lower() in ("1", "true", "yes", "on")
+            if isinstance(default, int) and not isinstance(default, bool):
+                return int(value)
+            if isinstance(default, float):
+                return float(value)
+        return value
+
+    def property_changed(self, key: str) -> None:
+        """Hook: subclass reacts to a property update."""
+
+    # -- pad management ------------------------------------------------------
+    def add_sink_pad(self, name: str = "sink", caps: Optional[CapsList] = None
+                     ) -> Pad:
+        pad = Pad(self, name, PadDirection.SINK, caps)
+        self.sinkpads.append(pad)
+        return pad
+
+    def add_src_pad(self, name: str = "src", caps: Optional[CapsList] = None
+                    ) -> Pad:
+        pad = Pad(self, name, PadDirection.SRC, caps)
+        self.srcpads.append(pad)
+        return pad
+
+    def request_sink_pad(self) -> Pad:
+        """For N-input elements (mux/merge/join): allocate a new sink pad.
+        Default: error — override in request-pad elements."""
+        raise NotImplementedError(f"{self.ELEMENT_NAME} has fixed pads")
+
+    @property
+    def sinkpad(self) -> Pad:
+        return self.sinkpads[0]
+
+    @property
+    def srcpad(self) -> Pad:
+        return self.srcpads[0]
+
+    def link(self, downstream: "Element") -> "Element":
+        """Link this element's first free src pad to downstream's first free
+        sink pad (gst_element_link). Returns downstream for chaining."""
+        src = next((p for p in self.srcpads if p.peer is None), None)
+        if src is None:
+            raise ValueError(f"{self.name}: no free src pad")
+        sink = next((p for p in downstream.sinkpads if p.peer is None), None)
+        if sink is None:
+            sink = downstream.request_sink_pad()
+        src.link(sink)
+        return downstream
+
+    # -- dataflow entry (with uniform instrumentation) -----------------------
+    def _chain_entry(self, pad: Pad, buf: TensorBuffer) -> FlowReturn:
+        if pad.eos:
+            return FlowReturn.EOS
+        with self.stats.measure():
+            try:
+                ret = self.chain(pad, buf)
+            except FlowError:
+                raise
+            except Exception as e:
+                raise FlowError(f"{self.name}: {e}") from e
+        return FlowReturn.OK if ret is None else ret
+
+    def _event_entry(self, pad: Pad, event: Event) -> None:
+        if isinstance(event, CapsEvent):
+            pad.caps = event.caps
+        if isinstance(event, EosEvent):
+            pad.eos = True
+        self.sink_event(pad, event)
+
+    # -- subclass hooks ------------------------------------------------------
+    def chain(self, pad: Pad, buf: TensorBuffer) -> Optional[FlowReturn]:
+        """Process one input buffer. Default: passthrough to first src pad."""
+        if self.srcpads:
+            return self.srcpad.push(buf)
+        return FlowReturn.OK
+
+    def sink_event(self, pad: Pad, event: Event) -> None:
+        """Handle a downstream-flowing event. Default: CAPS → negotiate via
+        :meth:`transform_caps`; EOS/custom → forward when all sink pads agree.
+        """
+        if isinstance(event, CapsEvent):
+            out = self.transform_caps(pad, event.caps)
+            if out is not None and self.srcpads:
+                for sp in self.srcpads:
+                    sp.set_caps(out)
+        elif isinstance(event, EosEvent):
+            if all(p.eos for p in self.sinkpads):
+                self.handle_eos()
+                for sp in self.srcpads:
+                    sp.push_event(event)
+        else:
+            for sp in self.srcpads:
+                sp.push_event(event)
+
+    def transform_caps(self, pad: Pad, caps: Caps) -> Optional[Caps]:
+        """Map fixed input caps to output caps. Default: identity."""
+        return caps
+
+    def handle_eos(self) -> None:
+        """Hook: flush buffered state at end-of-stream."""
+
+    # -- state ---------------------------------------------------------------
+    def start(self) -> None:
+        """Transition to streaming state (allocate resources, open models)."""
+        self._started = True
+
+    def stop(self) -> None:
+        self._started = False
+
+    def post_error(self, exc: Exception) -> None:
+        if self.pipeline is not None:
+            self.pipeline.post_error(self, exc)
+        else:
+            raise exc
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self.name!r}>"

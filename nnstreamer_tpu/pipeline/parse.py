@@ -1,0 +1,162 @@
+"""gst-launch-style pipeline-description parser.
+
+The reference's CLI *is* ``gst-launch-1.0`` with pipeline descriptions
+(``Documentation/gst-launch-script-example.md``); the same grammar is used
+programmatically via ``gst_parse_launch``. We implement the useful core of
+that grammar over our element registry so reference pipelines translate
+almost verbatim::
+
+    parse_launch(
+      "videotestsrc num-buffers=30 ! tensor_converter ! "
+      "tensor_filter framework=jax model=m.msgpack ! "
+      "tensor_decoder mode=image_labeling option1=labels.txt ! "
+      "tensor_sink name=out"
+    )
+
+Supported grammar (tools/development/parser is the reference's bison
+grammar for the same language):
+
+- ``element prop=value ...``  — properties; values may be quoted.
+- ``a ! b ! c``               — linking.
+- ``name=foo`` then ``foo.``  — named-element branch points (tee/demux):
+  ``t. ! queue ! sink`` continues from element ``foo``'s next free src pad.
+- caps filter strings (``other/tensors,num_tensors=1,...``) between ``!``
+  become :class:`CapsFilter` elements.
+"""
+
+from __future__ import annotations
+
+import shlex
+from typing import List, Optional, Tuple
+
+from nnstreamer_tpu.pipeline.caps import ANY, Caps, CapsList
+from nnstreamer_tpu.pipeline.element import Element, Pad
+from nnstreamer_tpu.pipeline.pipeline import Pipeline
+from nnstreamer_tpu.registry import ELEMENT, get_subplugin, subplugin
+
+
+@subplugin(ELEMENT, "capsfilter")
+class CapsFilter(Element):
+    """Constrains stream caps (gst capsfilter): intersects incoming caps with
+    its ``caps`` property and forwards; buffers pass through untouched."""
+
+    ELEMENT_NAME = "capsfilter"
+    PROPERTIES = {**Element.PROPERTIES, "caps": None}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+
+    def transform_caps(self, pad, caps):
+        want = self.get_property("caps")
+        if want is None:
+            return caps
+        merged = caps.intersect(want)
+        if merged is None:
+            raise ValueError(
+                f"{self.name}: caps {caps!r} do not satisfy filter {want!r}"
+            )
+        return merged.fixate()
+
+
+def parse_caps_string(text: str) -> Caps:
+    """Parse ``media/type,k=v,k2=v2`` into Caps (values kept as str/int)."""
+    parts = text.split(",")
+    name = parts[0].strip()
+    fields = {}
+    for item in parts[1:]:
+        if not item.strip():
+            continue
+        if "=" not in item:
+            raise ValueError(f"bad caps field {item!r} in {text!r}")
+        k, v = item.split("=", 1)
+        v = v.strip().strip('"')
+        # strip gst type annotations like (int)640 / (string)RGB
+        if v.startswith("(") and ")" in v:
+            v = v[v.index(")") + 1:]
+        try:
+            v2: object = int(v)
+        except ValueError:
+            v2 = v
+        fields[k.strip()] = v2
+    return Caps(name, fields)
+
+
+def _is_caps_token(tok: str) -> bool:
+    head = tok.split(",", 1)[0]
+    return "/" in head and "=" not in head
+
+
+def _make_element(factory_name: str, props: List[Tuple[str, str]]) -> Element:
+    factory = get_subplugin(ELEMENT, factory_name)
+    if factory is None:
+        raise ValueError(f"no such element factory {factory_name!r}")
+    el: Element = factory()
+    for k, v in props:
+        if k == "name":
+            el.name = v
+        elif k == "caps" and isinstance(el, CapsFilter):
+            el.set_property("caps", parse_caps_string(v))
+        else:
+            el.set_property(k, v)
+    return el
+
+
+def parse_launch(description: str, pipeline: Optional[Pipeline] = None
+                 ) -> Pipeline:
+    """Build a Pipeline from a gst-launch-style description."""
+    pipe = pipeline or Pipeline()
+    lexer = shlex.shlex(description, posix=True, punctuation_chars="!")
+    lexer.whitespace_split = True
+    tokens = list(lexer)
+
+    prev: Optional[Element] = None  # element whose src feeds the next link
+    pending_props: List[Tuple[str, str]] = []
+    current: Optional[Element] = None
+    link_pending = False
+
+    def finish_current():
+        nonlocal current, prev, link_pending
+        if current is None:
+            return
+        pipe.add(current)
+        if link_pending and prev is not None:
+            prev.link(current)
+        prev = current
+        link_pending = False
+        current = None
+
+    i = 0
+    while i < len(tokens):
+        tok = tokens[i]
+        if tok == "!":
+            finish_current()
+            link_pending = True
+        elif "=" in tok and current is not None and not _is_caps_token(tok):
+            k, v = tok.split("=", 1)
+            if k == "name":
+                current.name = v
+            elif k == "caps" and isinstance(current, CapsFilter):
+                current.set_property("caps", parse_caps_string(v))
+            else:
+                current.set_property(k, v)
+        elif tok.endswith(".") and len(tok) > 1:
+            # branch point: continue from a named element
+            finish_current()
+            ref = tok[:-1]
+            if ref not in pipe.by_name:
+                raise ValueError(f"unknown element reference {ref!r}")
+            prev = pipe.by_name[ref]
+            link_pending = False
+        elif _is_caps_token(tok):
+            finish_current()
+            cf = CapsFilter()
+            cf.set_property("caps", parse_caps_string(tok))
+            current = cf
+        else:
+            finish_current()
+            current = _make_element(tok, [])
+        i += 1
+    finish_current()
+    return pipe

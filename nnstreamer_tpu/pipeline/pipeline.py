@@ -1,0 +1,314 @@
+"""Pipeline — element container, scheduler, and bus.
+
+The reference's pipelines are GStreamer pipelines: sources run streaming
+threads, ``queue`` elements decouple stages, a bus carries ERROR/EOS messages
+to the application. This module provides the same capability:
+
+- :class:`Pipeline` holds elements, drives state changes
+  (NULL→READY→PLAYING, reference state model), runs one thread per source
+  element, and exposes a bus (:meth:`pop_message`, :meth:`wait`).
+- :class:`SourceElement` is the push-mode live/file source base
+  (GstBaseSrc's create-loop, e.g. tensor_src_iio.c:18-52).
+- :class:`Queue` is the explicit thread boundary (gst ``queue``): a bounded
+  buffer + worker thread giving pipeline (stage) parallelism — the
+  reference's only intra-pipeline parallelism form (SURVEY §2.4.1). Stages
+  separated by queues overlap host work with XLA's async device dispatch.
+"""
+
+from __future__ import annotations
+
+import enum
+import queue as _queue
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from nnstreamer_tpu.log import get_logger
+from nnstreamer_tpu.pipeline.element import (
+    Element,
+    EosEvent,
+    FlowError,
+    FlowReturn,
+    Pad,
+)
+from nnstreamer_tpu.registry import ELEMENT, subplugin
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
+
+log = get_logger("pipeline")
+
+
+class State(enum.Enum):
+    NULL = "null"
+    READY = "ready"
+    PLAYING = "playing"
+
+
+class Message:
+    """Bus message (GstMessage equivalent)."""
+
+    def __init__(self, kind: str, source: Optional[Element] = None,
+                 error: Optional[Exception] = None):
+        self.kind = kind  # "eos" | "error"
+        self.source = source
+        self.error = error
+
+    def __repr__(self):
+        return f"Message({self.kind}, src={getattr(self.source, 'name', None)}, err={self.error})"
+
+
+class SourceElement(Element):
+    """Push-mode source: the pipeline runs :meth:`create` in a loop on a
+    dedicated streaming thread until it returns None (EOS) or the pipeline
+    stops."""
+
+    ELEMENT_NAME = "source"
+    PROPERTIES = {**Element.PROPERTIES}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        if not self.srcpads:
+            self.add_src_pad("src")
+        self._stop_evt = threading.Event()
+
+    def create(self) -> Optional[TensorBuffer]:
+        """Produce the next buffer, or None at end-of-stream. Blocking calls
+        must poll ``self._stop_evt``."""
+        raise NotImplementedError
+
+    def negotiate(self) -> None:
+        """Announce src caps before the first buffer (override)."""
+
+    # -- driven by Pipeline ---------------------------------------------------
+    def run_loop(self, pipeline: "Pipeline") -> None:
+        try:
+            self.negotiate()
+            while not self._stop_evt.is_set():
+                buf = self.create()
+                if buf is None:
+                    break
+                ret = self.srcpad.push(buf)
+                if ret is FlowReturn.EOS:
+                    break
+            for sp in self.srcpads:
+                sp.push_event(EosEvent())
+            pipeline.post_message(Message("eos", self))
+        except FlowError as e:
+            pipeline.post_error(self, e)
+        except Exception as e:  # noqa: BLE001 — bus carries any failure
+            pipeline.post_error(self, e)
+
+    def stop(self):
+        self._stop_evt.set()
+        super().stop()
+
+
+@subplugin(ELEMENT, "queue")
+class Queue(Element):
+    """Thread-boundary element: bounded FIFO + worker thread.
+
+    ``max_size_buffers`` bounds occupancy; ``leaky`` ("no"|"downstream")
+    selects blocking vs drop-oldest backpressure (gst queue's leaky prop).
+    """
+
+    ELEMENT_NAME = "queue"
+    PROPERTIES = {**Element.PROPERTIES, "max_size_buffers": 16, "leaky": "no"}
+
+    _EOS = object()
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+        self._q: _queue.Queue = _queue.Queue()
+        self._worker: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+        self._eos_done = threading.Event()
+
+    def start(self):
+        super().start()
+        self._stop_evt.clear()
+        self._eos_done.clear()
+        self._q = _queue.Queue(maxsize=int(self.get_property("max_size_buffers")))
+        self._worker = threading.Thread(
+            target=self._drain, name=f"{self.name}-worker", daemon=True
+        )
+        self._worker.start()
+
+    def stop(self):
+        self._stop_evt.set()
+        try:
+            self._q.put_nowait(self._EOS)
+        except _queue.Full:
+            pass
+        if self._worker is not None:
+            self._worker.join(timeout=5)
+            self._worker = None
+        super().stop()
+
+    def chain(self, pad, buf):
+        if self._worker is None:  # not started: degenerate passthrough
+            return self.srcpad.push(buf)
+        if self.get_property("leaky") == "downstream":
+            while True:
+                try:
+                    self._q.put_nowait(buf)
+                    return FlowReturn.OK
+                except _queue.Full:
+                    try:
+                        self._q.get_nowait()  # drop oldest
+                    except _queue.Empty:
+                        pass
+        else:
+            while not self._stop_evt.is_set():
+                try:
+                    self._q.put(buf, timeout=0.1)
+                    return FlowReturn.OK
+                except _queue.Full:
+                    continue
+            return FlowReturn.EOS
+
+    def sink_event(self, pad, event):
+        if isinstance(event, EosEvent) and self._worker is not None:
+            # EOS is serialized: enqueue the sentinel in-order, then block
+            # until the worker has drained everything ahead of it and
+            # forwarded EOS downstream (gst serialized-event semantics).
+            self._q.put(self._EOS)
+            self._eos_done.wait(timeout=30)
+        else:
+            super().sink_event(pad, event)
+
+    def _drain(self):
+        while not self._stop_evt.is_set():
+            try:
+                item = self._q.get(timeout=0.1)
+            except _queue.Empty:
+                continue
+            if item is self._EOS:
+                self.srcpad.push_event(EosEvent())
+                self._eos_done.set()
+                return
+            try:
+                self.srcpad.push(item)
+            except FlowError as e:
+                self.post_error(e)
+                self._eos_done.set()  # unblock a waiting EOS pusher
+                return
+
+
+class Pipeline:
+    """Element container + scheduler + bus."""
+
+    def __init__(self, name: str = "pipeline"):
+        self.name = name
+        self.elements: List[Element] = []
+        self.by_name: Dict[str, Element] = {}
+        self.state = State.NULL
+        self._bus: _queue.Queue = _queue.Queue()
+        self._threads: List[threading.Thread] = []
+        self._eos_pending = 0
+        self._lock = threading.Lock()
+
+    # -- construction ---------------------------------------------------------
+    def add(self, *elements: Element) -> "Pipeline":
+        for el in elements:
+            if el.name in self.by_name:
+                raise ValueError(f"duplicate element name {el.name!r}")
+            el.pipeline = self
+            self.elements.append(el)
+            self.by_name[el.name] = el
+        return self
+
+    def add_linked(self, *elements: Element) -> "Pipeline":
+        """Add elements and link them in sequence."""
+        self.add(*elements)
+        for a, b in zip(elements, elements[1:]):
+            a.link(b)
+        return self
+
+    def get(self, name: str) -> Element:
+        return self.by_name[name]
+
+    # -- state ----------------------------------------------------------------
+    def start(self) -> "Pipeline":
+        """NULL→PLAYING: start all elements (non-sources first so queues and
+        filters are ready), then spawn one streaming thread per source."""
+        if self.state is State.PLAYING:
+            return self
+        sources = [e for e in self.elements if isinstance(e, SourceElement)]
+        others = [e for e in self.elements if not isinstance(e, SourceElement)]
+        for el in others:
+            el.start()
+        for el in sources:
+            el.start()
+        self.state = State.PLAYING
+        self._eos_pending = len(sources)
+        for src in sources:
+            t = threading.Thread(
+                target=src.run_loop, args=(self,),
+                name=f"{self.name}:{src.name}", daemon=True
+            )
+            self._threads.append(t)
+            t.start()
+        return self
+
+    def stop(self) -> "Pipeline":
+        if self.state is State.NULL:
+            return self
+        for el in self.elements:
+            if isinstance(el, SourceElement):
+                el.stop()
+        for t in self._threads:
+            t.join(timeout=10)
+        self._threads.clear()
+        for el in self.elements:
+            if not isinstance(el, SourceElement):
+                el.stop()
+        self.state = State.NULL
+        return self
+
+    # -- bus ------------------------------------------------------------------
+    def post_message(self, msg: Message) -> None:
+        self._bus.put(msg)
+
+    def post_error(self, source: Element, error: Exception) -> None:
+        log.error("pipeline %s: error from %s: %s", self.name,
+                  source.name if source else "?", error)
+        self._bus.put(Message("error", source, error))
+
+    def pop_message(self, timeout: Optional[float] = None) -> Optional[Message]:
+        try:
+            return self._bus.get(timeout=timeout)
+        except _queue.Empty:
+            return None
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[Message]:
+        """Block until every source reached EOS (returns the final EOS
+        message) or any element errored (returns the error message)."""
+        remaining = self._eos_pending
+        deadline = None if timeout is None else (
+            threading.TIMEOUT_MAX if timeout < 0 else timeout
+        )
+        import time
+
+        t_end = None if deadline is None else time.monotonic() + deadline
+        while True:
+            t_left = None if t_end is None else max(0.0, t_end - time.monotonic())
+            msg = self.pop_message(timeout=t_left)
+            if msg is None:
+                return None  # timed out
+            if msg.kind == "error":
+                return msg
+            if msg.kind == "eos":
+                remaining -= 1
+                if remaining <= 0:
+                    return msg
+
+    def run(self, timeout: Optional[float] = None) -> Optional[Message]:
+        """start() + wait() + stop(); raises on error message."""
+        self.start()
+        try:
+            msg = self.wait(timeout=timeout)
+            if msg is not None and msg.kind == "error":
+                raise FlowError(str(msg.error)) from msg.error
+            return msg
+        finally:
+            self.stop()

@@ -1,0 +1,150 @@
+"""Subplugin registries — name → implementation per subplugin kind.
+
+Reference: ``nnstreamer_subplugin.c`` keeps one hash per type
+{FILTER, DECODER, CONVERTER} with lazy dlopen discovery
+(``get_subplugin``:138, ``register_subplugin``:222). Here the same contract:
+
+- :func:`register_subplugin` / decorator :func:`subplugin` — explicit
+  registration (what the reference's .so constructors do);
+- :func:`get_subplugin` — lookup with lazy discovery: on a miss we import
+  the built-in module that provides the name, then any user search paths
+  from config (``[filter] path=...`` etc. — the dlopen analog is importing
+  ``nnstreamer_tpu_<kind>_<name>.py`` from those paths), then installed
+  entry points if available.
+
+Also registers ELEMENT factories (pipeline/parse.py builds pipelines by
+element name, like gst's element registry, registerer/nnstreamer.c:85-116).
+"""
+
+from __future__ import annotations
+
+import importlib
+import importlib.util
+import os
+import sys
+import threading
+from typing import Any, Callable, Dict, Optional
+
+from nnstreamer_tpu.config import get_conf
+from nnstreamer_tpu.log import get_logger
+
+log = get_logger("registry")
+
+FILTER = "filter"
+DECODER = "decoder"
+CONVERTER = "converter"
+ELEMENT = "element"
+
+_KINDS = (FILTER, DECODER, CONVERTER, ELEMENT)
+_registry: Dict[str, Dict[str, Any]] = {k: {} for k in _KINDS}
+_lock = threading.RLock()
+
+#: name → module that provides it, for lazy built-in discovery.
+_BUILTIN_PROVIDERS: Dict[str, Dict[str, str]] = {
+    FILTER: {
+        "jax": "nnstreamer_tpu.filters.jax_backend",
+        "torch": "nnstreamer_tpu.filters.torch_backend",
+        "python": "nnstreamer_tpu.filters.python_class",
+        "custom": "nnstreamer_tpu.filters.custom",
+        "custom-easy": "nnstreamer_tpu.filters.custom",
+        "tflite": "nnstreamer_tpu.filters.tflite_backend",
+        "tensorflow-lite": "nnstreamer_tpu.filters.tflite_backend",
+    },
+    DECODER: {
+        "image_labeling": "nnstreamer_tpu.decoders.image_labeling",
+        "bounding_boxes": "nnstreamer_tpu.decoders.bounding_boxes",
+        "pose_estimation": "nnstreamer_tpu.decoders.pose_estimation",
+        "image_segment": "nnstreamer_tpu.decoders.image_segment",
+        "direct_video": "nnstreamer_tpu.decoders.direct_video",
+        "octet_stream": "nnstreamer_tpu.decoders.octet_stream",
+        "flexbuf": "nnstreamer_tpu.decoders.flexbuf",
+        "protobuf": "nnstreamer_tpu.decoders.protobuf_codec",
+        "python3": "nnstreamer_tpu.decoders.python3",
+    },
+    CONVERTER: {
+        "flexbuf": "nnstreamer_tpu.converters.flexbuf",
+        "protobuf": "nnstreamer_tpu.converters.protobuf_codec",
+        "python3": "nnstreamer_tpu.converters.python3",
+    },
+    ELEMENT: {},  # populated by nnstreamer_tpu.elements at import
+}
+
+_ELEMENTS_MODULE = "nnstreamer_tpu.elements"
+
+
+def register_subplugin(kind: str, name: str, impl: Any,
+                       replace: bool = True) -> None:
+    """Register ``impl`` under (kind, name). Reference
+    ``register_subplugin`` (nnstreamer_subplugin.c:222)."""
+    if kind not in _KINDS:
+        raise ValueError(f"unknown subplugin kind {kind!r}")
+    with _lock:
+        if name in _registry[kind] and not replace:
+            raise ValueError(f"{kind} subplugin {name!r} already registered")
+        _registry[kind][name] = impl
+
+
+def unregister_subplugin(kind: str, name: str) -> bool:
+    with _lock:
+        return _registry[kind].pop(name, None) is not None
+
+
+def subplugin(kind: str, name: str) -> Callable:
+    """Class/function decorator form of :func:`register_subplugin`."""
+
+    def deco(obj):
+        register_subplugin(kind, name, obj)
+        return obj
+
+    return deco
+
+
+def _try_import(module: str) -> bool:
+    try:
+        importlib.import_module(module)
+        return True
+    except ImportError as e:
+        log.debug("lazy import of %s failed: %s", module, e)
+        return False
+
+
+def _search_external(kind: str, name: str) -> None:
+    """Load ``nnstreamer_tpu_<kind>_<name>.py`` from configured search paths
+    (the dlopen-from-conf-paths analog, nnstreamer_subplugin.c:107-135)."""
+    fname = f"nnstreamer_tpu_{kind}_{name}.py"
+    for path in get_conf().subplugin_paths(kind):
+        full = os.path.join(path, fname)
+        if os.path.isfile(full):
+            spec = importlib.util.spec_from_file_location(
+                f"nnstreamer_tpu_ext.{kind}.{name}", full
+            )
+            mod = importlib.util.module_from_spec(spec)
+            sys.modules[spec.name] = mod
+            spec.loader.exec_module(mod)  # module registers itself on import
+            return
+
+
+def get_subplugin(kind: str, name: str) -> Optional[Any]:
+    """Look up a subplugin, lazily discovering built-ins and externals.
+    Reference ``get_subplugin`` (nnstreamer_subplugin.c:138)."""
+    with _lock:
+        if name in _registry[kind]:
+            return _registry[kind][name]
+    if kind == ELEMENT:
+        _try_import(_ELEMENTS_MODULE)
+    provider = _BUILTIN_PROVIDERS.get(kind, {}).get(name)
+    if provider:
+        _try_import(provider)
+    with _lock:
+        if name not in _registry[kind]:
+            _lock.release()
+            try:
+                _search_external(kind, name)
+            finally:
+                _lock.acquire()
+        return _registry[kind].get(name)
+
+
+def list_subplugins(kind: str) -> Dict[str, Any]:
+    with _lock:
+        return dict(_registry[kind])

@@ -1,0 +1,13 @@
+"""L1 — tensor type system, caps, buffers, and per-memory metadata."""
+
+from nnstreamer_tpu.tensors.types import (  # noqa: F401
+    TensorType,
+    TensorFormat,
+    TensorInfo,
+    TensorsInfo,
+    TensorsConfig,
+    NNS_TENSOR_RANK_LIMIT,
+    NNS_TENSOR_SIZE_LIMIT,
+)
+from nnstreamer_tpu.tensors.buffer import TensorBuffer  # noqa: F401
+from nnstreamer_tpu.tensors.meta import TensorMetaInfo  # noqa: F401

@@ -1,0 +1,143 @@
+"""TensorBuffer — one stream frame: N tensors + timing metadata.
+
+The reference flows ``GstBuffer``s holding up to 16 ``GstMemory`` chunks
+(one per tensor) with pts/dts/duration and attachable metas
+(``gst/nnstreamer/tensor_meta.c``). Here a frame is a list of *arrays* —
+host ``numpy.ndarray`` or device-resident ``jax.Array`` — so tensors can stay
+in TPU HBM as they flow between elements (the reference's zero-copy
+``GstMemory`` mapping, ``tensor_filter.c:585-604``, maps to "never leave the
+device"). Host/device placement is explicit via :meth:`to_device` /
+:meth:`to_host`; elements that only reorder/route tensors never touch bytes.
+
+``meta`` carries attachable per-buffer metadata the way GstMeta does — e.g.
+the query client id used by the distributed serversink to route results
+(reference ``GstMetaQuery``, tensor_meta.c), or crop regions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from nnstreamer_tpu.tensors.types import (
+    NNS_TENSOR_SIZE_LIMIT,
+    TensorsInfo,
+)
+
+#: Sentinel for "no timestamp" (reference GST_CLOCK_TIME_NONE).
+CLOCK_NONE: Optional[int] = None
+
+
+def is_device_array(x) -> bool:
+    """True if ``x`` is a jax.Array (device-resident)."""
+    import jax
+
+    return isinstance(x, jax.Array)
+
+
+@dataclasses.dataclass
+class TensorBuffer:
+    """One frame of a tensor stream.
+
+    Attributes
+    ----------
+    tensors : list of numpy.ndarray or jax.Array
+    pts, dts, duration : int nanoseconds, or None (unset)
+    meta : free-form attachable metadata (GstMeta equivalent)
+    """
+
+    tensors: List[Any] = dataclasses.field(default_factory=list)
+    pts: Optional[int] = None
+    dts: Optional[int] = None
+    duration: Optional[int] = None
+    meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        if len(self.tensors) > NNS_TENSOR_SIZE_LIMIT:
+            raise ValueError(
+                f"{len(self.tensors)} tensors exceeds {NNS_TENSOR_SIZE_LIMIT}"
+            )
+
+    # -- constructors -------------------------------------------------------
+    @classmethod
+    def from_arrays(cls, arrays: Sequence, pts: Optional[int] = None, **kw):
+        return cls(tensors=list(arrays), pts=pts, **kw)
+
+    @classmethod
+    def wall_clock_pts(cls) -> int:
+        return time.monotonic_ns()
+
+    # -- container protocol --------------------------------------------------
+    def __len__(self):
+        return len(self.tensors)
+
+    def __getitem__(self, i):
+        return self.tensors[i]
+
+    def __iter__(self):
+        return iter(self.tensors)
+
+    # -- derived -------------------------------------------------------------
+    @property
+    def num_tensors(self) -> int:
+        return len(self.tensors)
+
+    def tensors_info(self) -> TensorsInfo:
+        return TensorsInfo.from_arrays(self.tensors)
+
+    def nbytes(self) -> int:
+        return sum(int(np.prod(t.shape)) * t.dtype.itemsize for t in self.tensors)
+
+    def on_device(self) -> bool:
+        return bool(self.tensors) and all(is_device_array(t) for t in self.tensors)
+
+    # -- placement -----------------------------------------------------------
+    def to_host(self) -> "TensorBuffer":
+        """Materialize all tensors as numpy arrays (blocking D2H if needed)."""
+        out = []
+        for t in self.tensors:
+            out.append(np.asarray(t) if not isinstance(t, np.ndarray) else t)
+        return self.replace(tensors=out)
+
+    def to_device(self, device=None, sharding=None) -> "TensorBuffer":
+        """Move all tensors onto a JAX device (or sharding)."""
+        import jax
+
+        tgt = sharding if sharding is not None else device
+        out = [jax.device_put(t, tgt) if tgt is not None else jax.device_put(t)
+               for t in self.tensors]
+        return self.replace(tensors=out)
+
+    def block_until_ready(self) -> "TensorBuffer":
+        for t in self.tensors:
+            if is_device_array(t):
+                t.block_until_ready()
+        return self
+
+    # -- functional update ----------------------------------------------------
+    def replace(self, **kw) -> "TensorBuffer":
+        """Copy with replaced fields; tensors list is shallow-copied, meta is
+        copied (buffers are treated as immutable once pushed)."""
+        fields = dict(
+            tensors=list(self.tensors),
+            pts=self.pts,
+            dts=self.dts,
+            duration=self.duration,
+            meta=dict(self.meta),
+        )
+        fields.update(kw)
+        return TensorBuffer(**fields)
+
+    def with_tensors(self, tensors: Sequence) -> "TensorBuffer":
+        """New buffer with the same timing/meta but different payload."""
+        return self.replace(tensors=list(tensors))
+
+    def __repr__(self):
+        shapes = ",".join(
+            f"{tuple(t.shape)}:{np.dtype(t.dtype).name}" for t in self.tensors
+        )
+        dev = "dev" if self.on_device() else "host"
+        return f"TensorBuffer([{shapes}] {dev} pts={self.pts})"

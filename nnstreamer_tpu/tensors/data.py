@@ -1,0 +1,36 @@
+"""Typed scalar/tensor math helpers (reference ``tensor_data.c``).
+
+The reference implements per-dtype get/set/typecast/average in C for use by
+tensor_if / tensor_crop / tensor_transform. Here the elementwise work is XLA's
+job; these helpers cover the host-side scalar paths (condition evaluation,
+crop coordinate extraction) plus saturating typecast semantics matching the
+reference's behavior for integer narrowing.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from nnstreamer_tpu.tensors.types import TensorType
+
+
+def typecast(arr, dst: TensorType):
+    """Cast with C-style saturation for float->int (reference
+    ``gst_tensor_data_typecast``, tensor_data.c)."""
+    dst = TensorType.from_any(dst)
+    dt = dst.np_dtype
+    a = np.asarray(arr)
+    if np.issubdtype(dt, np.integer) and np.issubdtype(a.dtype, np.floating):
+        inf = np.iinfo(dt)
+        a = np.clip(a, inf.min, inf.max)
+    return a.astype(dt)
+
+
+def average(arr) -> float:
+    """Scalar mean of a tensor (reference ``gst_tensor_data_average``)."""
+    return float(np.mean(np.asarray(arr, dtype=np.float64)))
+
+
+def scalar_at(arr, flat_index: int) -> float:
+    """Value at a flat index, as float (reference per-dtype get)."""
+    return float(np.asarray(arr).reshape(-1)[flat_index])

@@ -1,0 +1,123 @@
+"""Serializable per-tensor header for flexible/sparse streams and the wire.
+
+The reference prepends a fixed binary header (``GstTensorMetaInfo``,
+``gst/nnstreamer/tensor_meta.c`` / ``tensor_typedef.h:272-297``) to every
+memory of a flexible or sparse tensor so each buffer is self-describing:
+version magic, dtype, dim[rank], format, and for sparse tensors the
+number of non-zero elements. We keep the same idea with an explicit
+little-endian layout (struct-packed), used by:
+
+- flexible-format streams (``TensorFormat.FLEXIBLE``) where shapes vary
+  per buffer and caps carry no dimensions;
+- sparse encode/decode (``elements.sparse``);
+- the distributed query protocol's tensor framing (``query.protocol``).
+
+Header layout (little-endian, 96 bytes):
+  u32 magic      0x544D4931 ("TMI1")
+  u32 type       TensorType index
+  u32 format     TensorFormat index (static=0/flexible=1/sparse=2)
+  u32 rank
+  u64 dim[8]     innermost-first, unused trailing dims = 1
+  u64 media_type reserved (0)
+  u64 sparse_nnz nonzero count for sparse payloads, else 0
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import Optional, Tuple
+
+from nnstreamer_tpu.tensors.types import (
+    NNS_TENSOR_RANK_LIMIT,
+    TensorFormat,
+    TensorInfo,
+    TensorType,
+)
+
+_MAGIC = 0x544D4931
+_TYPE_ORDER = list(TensorType)
+_FORMAT_ORDER = list(TensorFormat)
+_STRUCT = struct.Struct("<IIII8QQQ")
+
+HEADER_SIZE = _STRUCT.size
+
+
+@dataclasses.dataclass
+class TensorMetaInfo:
+    """Self-describing tensor header (reference ``GstTensorMetaInfo``)."""
+
+    type: TensorType
+    dim: Tuple[int, ...]
+    format: TensorFormat = TensorFormat.STATIC
+    sparse_nnz: int = 0
+
+    @classmethod
+    def from_info(cls, info: TensorInfo, format=TensorFormat.FLEXIBLE,
+                  sparse_nnz: int = 0) -> "TensorMetaInfo":
+        return cls(type=info.type, dim=tuple(info.dim), format=format,
+                   sparse_nnz=sparse_nnz)
+
+    def to_info(self) -> TensorInfo:
+        return TensorInfo(dim=self.dim, type=self.type)
+
+    # -- wire format ---------------------------------------------------------
+    def pack(self) -> bytes:
+        dim = list(self.dim[:NNS_TENSOR_RANK_LIMIT])
+        dim += [1] * (NNS_TENSOR_RANK_LIMIT - len(dim))
+        return _STRUCT.pack(
+            _MAGIC,
+            _TYPE_ORDER.index(self.type),
+            _FORMAT_ORDER.index(self.format),
+            len(self.dim),
+            *dim,
+            0,
+            self.sparse_nnz,
+        )
+
+    @classmethod
+    def unpack(cls, data: bytes) -> "TensorMetaInfo":
+        if len(data) < HEADER_SIZE:
+            raise ValueError(f"header too short: {len(data)} < {HEADER_SIZE}")
+        fields = _STRUCT.unpack_from(data)
+        magic, type_i, fmt_i, rank = fields[0], fields[1], fields[2], fields[3]
+        if magic != _MAGIC:
+            raise ValueError(f"bad tensor header magic: {magic:#x}")
+        if rank < 1 or rank > NNS_TENSOR_RANK_LIMIT:
+            raise ValueError(f"bad rank {rank}")
+        dim = tuple(int(d) for d in fields[4:4 + rank])
+        return cls(
+            type=_TYPE_ORDER[type_i],
+            dim=dim,
+            format=_FORMAT_ORDER[fmt_i],
+            sparse_nnz=int(fields[13]),
+        )
+
+    @property
+    def data_size(self) -> int:
+        """Byte size of the dense payload this header describes."""
+        return self.to_info().size
+
+
+def pack_tensor(arr, format=TensorFormat.FLEXIBLE) -> bytes:
+    """Serialize one tensor as header + raw bytes (host-side)."""
+    import numpy as np
+
+    arr = np.ascontiguousarray(np.asarray(arr))
+    info = TensorInfo.from_array(arr)
+    return TensorMetaInfo.from_info(info, format=format).pack() + arr.tobytes()
+
+
+def unpack_tensor(data: bytes, offset: int = 0):
+    """Parse header + payload at ``offset``; returns (array, next_offset)."""
+    import numpy as np
+
+    meta = TensorMetaInfo.unpack(data[offset:offset + HEADER_SIZE])
+    start = offset + HEADER_SIZE
+    end = start + meta.data_size
+    if len(data) < end:
+        raise ValueError("truncated tensor payload")
+    arr = np.frombuffer(data[start:end], dtype=meta.type.np_dtype).reshape(
+        meta.to_info().shape
+    )
+    return arr, end

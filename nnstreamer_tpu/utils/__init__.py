@@ -1,0 +1,3 @@
+"""Shared utilities: runtime statistics, tracing hooks."""
+
+from nnstreamer_tpu.utils.stats import InvokeStats  # noqa: F401

@@ -1,0 +1,156 @@
+"""Unit tests for caps negotiation, pads/elements, queue, and the pipeline
+scheduler (reference: unittest_common caps negotiation + gst core behavior)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.pipeline.caps import ANY, Caps, CapsList, IntRange
+from nnstreamer_tpu.pipeline.element import (
+    CapsEvent,
+    Element,
+    EosEvent,
+    FlowError,
+    FlowReturn,
+)
+from nnstreamer_tpu.pipeline.pipeline import Pipeline, Queue, SourceElement
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
+
+
+class TestCaps:
+    def test_intersect_fixed(self):
+        a = Caps("other/tensors", {"num_tensors": 1, "types": "uint8"})
+        b = Caps("other/tensors", {"num_tensors": 1})
+        c = a.intersect(b)
+        assert c is not None and c["types"] == "uint8"
+
+    def test_intersect_mismatch(self):
+        a = Caps("other/tensors", {"num_tensors": 1})
+        b = Caps("other/tensors", {"num_tensors": 2})
+        assert a.intersect(b) is None
+        assert a.intersect(Caps("video/x-raw", {})) is None
+
+    def test_range_and_list(self):
+        a = Caps("video/x-raw", {"width": IntRange(16, 4096), "format": ["RGB", "GRAY8"]})
+        b = Caps("video/x-raw", {"width": 224, "format": "RGB"})
+        c = a.intersect(b)
+        assert c["width"] == 224 and c["format"] == "RGB"
+
+    def test_fixate(self):
+        a = Caps("video/x-raw", {"width": IntRange(16, 4096), "format": ["RGB", "GRAY8"]})
+        f = a.fixate()
+        assert f.is_fixed()
+        assert f["width"] == 16 and f["format"] == "RGB"
+
+    def test_capslist_any(self):
+        assert CapsList.any().intersect(CapsList([Caps("x", {})])).caps
+
+    def test_capslist_empty_is_not_any(self):
+        # regression: failed negotiation (empty) must differ from ANY
+        a = CapsList([Caps("other/tensors", {})])
+        b = CapsList([Caps("video/x-raw", {})])
+        assert a.intersect(b).is_empty()
+        assert not CapsList.any().is_empty()
+
+    def test_link_incompatible_pads_raises(self):
+        e1, e2 = Element(), Element()
+        s = e1.add_src_pad(caps=CapsList([Caps("other/tensors", {})]))
+        k = e2.add_sink_pad(caps=CapsList([Caps("video/x-raw", {})]))
+        with pytest.raises(ValueError, match="caps do not intersect"):
+            s.link(k)
+
+
+class _NumSrc(SourceElement):
+    """Deterministic test source: counts 0..n-1 as 1-elem float32 tensors."""
+
+    ELEMENT_NAME = "_numsrc"
+    PROPERTIES = {**SourceElement.PROPERTIES, "num_buffers": 5}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.i = 0
+
+    def negotiate(self):
+        from nnstreamer_tpu.tensors.types import TensorsConfig
+
+        cfg = TensorsConfig.from_arrays([np.zeros((1,), np.float32)])
+        self.srcpad.set_caps(cfg.to_caps())
+
+    def create(self):
+        if self.i >= self.get_property("num_buffers"):
+            return None
+        buf = TensorBuffer([np.array([float(self.i)], np.float32)],
+                           pts=self.i * 1000)
+        self.i += 1
+        return buf
+
+
+class _Collect(Element):
+    ELEMENT_NAME = "_collect"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad("sink")
+        self.buffers = []
+        self.caps_seen = []
+        self.got_eos = False
+
+    def chain(self, pad, buf):
+        self.buffers.append(buf)
+        return FlowReturn.OK
+
+    def sink_event(self, pad, event):
+        if isinstance(event, CapsEvent):
+            self.caps_seen.append(event.caps)
+        if isinstance(event, EosEvent):
+            self.got_eos = True
+
+
+class TestPipeline:
+    def test_push_flow_and_eos(self):
+        src, sink = _NumSrc(num_buffers=7), _Collect()
+        pipe = Pipeline().add_linked(src, sink)
+        msg = pipe.run(timeout=10)
+        assert msg is not None and msg.kind == "eos"
+        assert len(sink.buffers) == 7
+        assert [float(b[0][0]) for b in sink.buffers] == list(range(7))
+        assert sink.got_eos
+        assert sink.caps_seen and sink.caps_seen[0].name == "other/tensors"
+
+    def test_queue_thread_boundary(self):
+        src, q, sink = _NumSrc(num_buffers=20), Queue(), _Collect()
+        pipe = Pipeline().add_linked(src, q, sink)
+        pipe.run(timeout=10)
+        assert [float(b[0][0]) for b in sink.buffers] == list(range(20))
+        assert sink.got_eos
+
+    def test_error_propagates_to_bus(self):
+        class _Boom(Element):
+            ELEMENT_NAME = "_boom"
+
+            def __init__(self):
+                super().__init__()
+                self.add_sink_pad()
+
+            def chain(self, pad, buf):
+                raise ValueError("boom")
+
+        pipe = Pipeline().add_linked(_NumSrc(), _Boom())
+        with pytest.raises(FlowError, match="boom"):
+            pipe.run(timeout=10)
+
+    def test_element_stats_populated(self):
+        src, sink = _NumSrc(num_buffers=50), _Collect()
+        Pipeline().add_linked(src, sink).run(timeout=10)
+        assert sink.stats.total_invokes == 50
+        assert sink.get_property("latency") >= 0
+
+    def test_property_unknown_raises(self):
+        with pytest.raises(KeyError):
+            _Collect().set_property("nope", 1)
+
+    def test_property_coercion(self):
+        src = _NumSrc()
+        src.set_property("num_buffers", "12")
+        assert src.get_property("num_buffers") == 12

@@ -1,0 +1,158 @@
+"""Unit tests for the L1 tensor type system (reference: unittest_common's
+caps/config coverage, tests/unittest_common.cc)."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.tensors.types import (
+    Fraction,
+    TensorFormat,
+    TensorInfo,
+    TensorsConfig,
+    TensorsInfo,
+    TensorType,
+    NNS_TENSOR_SIZE_LIMIT,
+)
+from nnstreamer_tpu.tensors.meta import (
+    HEADER_SIZE,
+    TensorMetaInfo,
+    pack_tensor,
+    unpack_tensor,
+)
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
+from nnstreamer_tpu.tensors import data as tdata
+
+
+class TestTensorType:
+    def test_all_dtypes_roundtrip_numpy(self):
+        for t in TensorType:
+            assert TensorType.from_any(t.np_dtype) is t
+
+    def test_sizes(self):
+        assert TensorType.UINT8.size == 1
+        assert TensorType.FLOAT32.size == 4
+        assert TensorType.BFLOAT16.size == 2
+        assert TensorType.FLOAT64.size == 8
+
+    def test_from_string(self):
+        assert TensorType.from_any("float32") is TensorType.FLOAT32
+        assert TensorType.from_any("UINT8") is TensorType.UINT8
+
+
+class TestTensorInfo:
+    def test_dim_vs_shape_reversal(self):
+        # NNStreamer dim C:W:H:N == numpy shape (N,H,W,C)
+        info = TensorInfo.from_str("3:224:224:1", "uint8")
+        assert info.shape == (1, 224, 224, 3)
+        assert info.size == 3 * 224 * 224
+
+    def test_from_array(self):
+        a = np.zeros((1, 224, 224, 3), np.uint8)
+        info = TensorInfo.from_array(a)
+        assert info.dim == (3, 224, 224, 1)
+        assert info.type is TensorType.UINT8
+
+    def test_equality_mod_trailing_ones(self):
+        a = TensorInfo.from_str("3:224:224:1", "uint8")
+        b = TensorInfo.from_str("3:224:224", "uint8")
+        assert a.is_equal(b)
+        c = TensorInfo.from_str("3:224:225", "uint8")
+        assert not a.is_equal(c)
+
+    def test_invalid_dims(self):
+        with pytest.raises(ValueError):
+            TensorInfo.from_str("0:2", "uint8")
+        with pytest.raises(ValueError):
+            TensorInfo.from_str(":".join(["2"] * 9), "uint8")
+
+
+class TestTensorsInfo:
+    def test_parse_multi(self):
+        ti = TensorsInfo.from_str("3:224:224:1,1001:1", "uint8,float32")
+        assert ti.num_tensors == 2
+        assert ti.dims_str() == "3:224:224:1,1001:1"
+        assert ti.types_str() == "uint8,float32"
+
+    def test_limit(self):
+        with pytest.raises(ValueError):
+            TensorsInfo([TensorInfo((1,), "uint8")] * (NNS_TENSOR_SIZE_LIMIT + 1))
+
+    def test_mismatched_counts(self):
+        with pytest.raises(ValueError):
+            TensorsInfo.from_str("3:4,5:6", "uint8")
+
+
+class TestTensorsConfig:
+    def test_caps_roundtrip(self):
+        cfg = TensorsConfig(
+            info=TensorsInfo.from_str("3:224:224:1", "uint8"),
+            rate=Fraction(30, 1),
+        )
+        caps = cfg.to_caps()
+        back = TensorsConfig.from_caps(caps)
+        assert back.is_equal(cfg)
+        assert back.rate.fps == 30.0
+
+    def test_flexible_always_valid(self):
+        cfg = TensorsConfig(format=TensorFormat.FLEXIBLE)
+        assert cfg.is_valid()
+        assert not TensorsConfig().is_valid()  # static w/o info
+
+
+class TestMetaHeader:
+    def test_pack_unpack(self):
+        m = TensorMetaInfo(TensorType.FLOAT32, (3, 224, 224),
+                           TensorFormat.FLEXIBLE)
+        m2 = TensorMetaInfo.unpack(m.pack())
+        assert m2.type is TensorType.FLOAT32
+        assert m2.dim == (3, 224, 224)
+        assert m2.format is TensorFormat.FLEXIBLE
+
+    def test_tensor_roundtrip(self, rng):
+        a = rng.standard_normal((2, 3, 4)).astype(np.float32)
+        blob = pack_tensor(a)
+        assert len(blob) == HEADER_SIZE + a.nbytes
+        b, end = unpack_tensor(blob)
+        assert end == len(blob)
+        np.testing.assert_array_equal(a, b)
+
+    def test_bad_magic(self):
+        with pytest.raises(ValueError):
+            TensorMetaInfo.unpack(b"\x00" * HEADER_SIZE)
+
+
+class TestTensorBuffer:
+    def test_basic(self, rng):
+        a = rng.standard_normal((4, 4)).astype(np.float32)
+        buf = TensorBuffer.from_arrays([a], pts=123)
+        assert buf.num_tensors == 1
+        assert buf.pts == 123
+        assert not buf.on_device()
+        assert buf.nbytes() == a.nbytes
+
+    def test_replace_does_not_alias_meta(self):
+        buf = TensorBuffer(tensors=[np.zeros(3)], meta={"k": 1})
+        b2 = buf.replace(pts=5)
+        b2.meta["k"] = 2
+        assert buf.meta["k"] == 1
+        assert b2.pts == 5 and buf.pts is None
+
+    def test_device_roundtrip(self):
+        import jax
+
+        buf = TensorBuffer(tensors=[np.arange(8, dtype=np.float32)])
+        dev = buf.to_device()
+        assert dev.on_device()
+        host = dev.to_host()
+        np.testing.assert_array_equal(host[0], buf[0])
+
+
+class TestTypedData:
+    def test_saturating_typecast(self):
+        a = np.array([300.0, -300.0, 5.5])
+        out = tdata.typecast(a, TensorType.UINT8)
+        assert out.dtype == np.uint8
+        assert list(out) == [255, 0, 5]
+
+    def test_average(self):
+        assert tdata.average(np.array([1, 2, 3], np.int8)) == 2.0
