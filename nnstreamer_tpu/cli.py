@@ -1,0 +1,71 @@
+"""nns-launch — the gst-launch-1.0 equivalent CLI.
+
+The reference's CLI *is* ``gst-launch-1.0 <pipeline description>``
+(Documentation/gst-launch-script-example.md). Same deal here::
+
+    nns-launch "videotestsrc num-buffers=30 ! tensor_converter ! \
+                tensor_filter framework=jax model=m.py ! tensor_sink"
+
+Options:
+  -q / --quiet     suppress the per-element stats summary
+  -t / --timeout   seconds to wait for EOS (default: none — run to EOS)
+  -v / --verbose   print caps as they are negotiated and buffer counts
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="nns-launch",
+        description="Run an nnstreamer_tpu pipeline description "
+                    "(gst-launch-1.0 equivalent).",
+    )
+    ap.add_argument("description", nargs="+",
+                    help="pipeline description (may be multiple tokens)")
+    ap.add_argument("-t", "--timeout", type=float, default=None)
+    ap.add_argument("-q", "--quiet", action="store_true")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    from nnstreamer_tpu import parse_launch
+    from nnstreamer_tpu.elements.sink import TensorSink
+
+    desc = " ".join(args.description)
+    try:
+        pipe = parse_launch(desc)
+    except (ValueError, KeyError) as e:
+        print(f"nns-launch: parse error: {e}", file=sys.stderr)
+        return 2
+
+    if args.verbose:
+        for el in pipe.elements:
+            if isinstance(el, TensorSink):
+                el.connect(lambda buf, name=el.name:
+                           print(f"{name}: {buf!r}"))
+
+    print(f"Setting pipeline to PLAYING ({len(pipe.elements)} elements)...")
+    try:
+        msg = pipe.run(timeout=args.timeout)
+    except Exception as e:  # noqa: BLE001 — CLI reports any failure
+        print(f"nns-launch: ERROR: {e}", file=sys.stderr)
+        return 1
+    if msg is None:
+        print("nns-launch: timeout waiting for EOS", file=sys.stderr)
+        return 3
+    print("Got EOS from pipeline.")
+
+    if not args.quiet:
+        print("-- element stats (latency µs / throughput milli-out/s / invokes)")
+        for el in pipe.elements:
+            s = el.stats.snapshot()
+            print(f"  {el.name:28s} {s['latency_us']:>8d}  "
+                  f"{s['throughput_milli']:>10d}  {s['total_invokes']:>8d}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

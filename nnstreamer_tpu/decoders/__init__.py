@@ -1,0 +1,1 @@
+"""L5 decoder subplugins (reference ext/nnstreamer/tensor_decoder/)."""

@@ -1,0 +1,47 @@
+"""image_labeling decoder — classification scores → text label.
+
+Reference: ``ext/nnstreamer/tensor_decoder/tensordec-imagelabel.c`` (271
+LoC): argmax over the score tensor, label looked up from the option1 labels
+file, output ``text/x-raw``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from nnstreamer_tpu.pipeline.caps import Caps
+from nnstreamer_tpu.registry import DECODER, subplugin
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
+
+
+def load_labels(path: str):
+    with open(path) as f:
+        return [line.strip() for line in f if line.strip()]
+
+
+@subplugin(DECODER, "image_labeling")
+class ImageLabeling:
+    def __init__(self):
+        self._labels = None
+        self._labels_path = None
+
+    def _get_labels(self, options):
+        path = options.get("option1")
+        if path and path != self._labels_path:
+            self._labels = load_labels(path)
+            self._labels_path = path
+        return self._labels
+
+    def out_caps(self, config, options) -> Caps:
+        return Caps("text/x-raw", {"format": "utf8"})
+
+    def decode(self, buf: TensorBuffer, config, options) -> TensorBuffer:
+        scores = np.asarray(buf[0]).reshape(-1)
+        idx = int(np.argmax(scores))
+        labels = self._get_labels(options)
+        text = labels[idx] if labels and idx < len(labels) else str(idx)
+        out = np.frombuffer(text.encode("utf-8"), np.uint8)
+        return buf.with_tensors([out]).replace(
+            meta={**buf.meta, "label_index": idx, "label": text,
+                  "score": float(scores[idx])}
+        )

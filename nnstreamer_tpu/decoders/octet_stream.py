@@ -1,0 +1,25 @@
+"""octet_stream decoder — tensors → raw byte stream.
+
+Reference: ``ext/nnstreamer/tensor_decoder/tensordec-octetstream.c`` (130
+LoC): concatenates tensor payloads into application/octet-stream bytes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from nnstreamer_tpu.pipeline.caps import Caps
+from nnstreamer_tpu.registry import DECODER, subplugin
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
+
+
+@subplugin(DECODER, "octet_stream")
+class OctetStream:
+    def out_caps(self, config, options) -> Caps:
+        return Caps("application/octet-stream", {})
+
+    def decode(self, buf: TensorBuffer, config, options) -> TensorBuffer:
+        blob = b"".join(
+            np.ascontiguousarray(np.asarray(t)).tobytes() for t in buf.tensors
+        )
+        return buf.with_tensors([np.frombuffer(blob, np.uint8)])

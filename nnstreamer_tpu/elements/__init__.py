@@ -1,0 +1,28 @@
+"""L3 stream elements.
+
+Importing this package registers every built-in element with the ELEMENT
+registry (the reference registers its 20+ elements in one gst plugin,
+``gst/nnstreamer/registerer/nnstreamer.c:85-116``)."""
+
+from nnstreamer_tpu.pipeline.pipeline import Queue  # noqa: F401 (registers "queue")
+from nnstreamer_tpu.pipeline.parse import CapsFilter  # noqa: F401 ("capsfilter")
+
+from nnstreamer_tpu.elements import source  # noqa: F401
+from nnstreamer_tpu.elements import sink  # noqa: F401
+from nnstreamer_tpu.elements import converter  # noqa: F401
+from nnstreamer_tpu.elements import transform  # noqa: F401
+from nnstreamer_tpu.elements import filter as filter_element  # noqa: F401
+from nnstreamer_tpu.elements import decoder  # noqa: F401
+from nnstreamer_tpu.elements import mux  # noqa: F401
+from nnstreamer_tpu.elements import demux  # noqa: F401
+from nnstreamer_tpu.elements import merge  # noqa: F401
+from nnstreamer_tpu.elements import split  # noqa: F401
+from nnstreamer_tpu.elements import join  # noqa: F401
+from nnstreamer_tpu.elements import tee  # noqa: F401
+from nnstreamer_tpu.elements import aggregator  # noqa: F401
+from nnstreamer_tpu.elements import rate  # noqa: F401
+from nnstreamer_tpu.elements import cond  # noqa: F401
+from nnstreamer_tpu.elements import crop  # noqa: F401
+from nnstreamer_tpu.elements import repo  # noqa: F401
+from nnstreamer_tpu.elements import sparse  # noqa: F401
+from nnstreamer_tpu.elements import query  # noqa: F401

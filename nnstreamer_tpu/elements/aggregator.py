@@ -1,0 +1,91 @@
+"""tensor_aggregator — temporal frame aggregation / dis-aggregation.
+
+Reference: ``gst/nnstreamer/elements/gsttensoraggregator.c`` (1081 LoC,
+tensor_aggregator/README.md): collects ``frames-in`` frames per input
+buffer, emits ``frames-out`` frames per output, advancing by
+``frames-flush`` (sliding window when flush < out), concatenating along
+``frames-dim``. This is the stream-side micro-batching / sequence-window
+primitive (SURVEY §2.4.3) — e.g. windowing audio for a sequence model.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from nnstreamer_tpu.pipeline.element import Element
+from nnstreamer_tpu.registry import ELEMENT, subplugin
+from nnstreamer_tpu.tensors.buffer import TensorBuffer, is_device_array
+
+
+@subplugin(ELEMENT, "tensor_aggregator")
+class TensorAggregator(Element):
+    ELEMENT_NAME = "tensor_aggregator"
+    PROPERTIES = {
+        **Element.PROPERTIES,
+        "frames_in": 1,
+        "frames_out": 1,
+        "frames_flush": 0,   # 0 → == frames_out (no overlap)
+        "frames_dim": 0,     # innermost-first dim index to aggregate along
+        "concat": True,
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+        self._window: List[np.ndarray] = []  # unit frames along frames_dim
+        self._pts: Optional[int] = None
+
+    def transform_caps(self, pad, caps):
+        return None  # announced from the first output (shape changes)
+
+    def _axis(self, arr) -> int:
+        return arr.ndim - 1 - int(self.get_property("frames_dim"))
+
+    def chain(self, pad, buf):
+        fin = int(self.get_property("frames_in"))
+        fout = int(self.get_property("frames_out"))
+        flush = int(self.get_property("frames_flush")) or fout
+        arr = buf.tensors[0]
+        axis = self._axis(arr)
+        if self._pts is None:
+            self._pts = buf.pts
+        # split the incoming buffer into its `frames_in` unit frames
+        n = max(fin, 1)
+        if arr.shape[axis] % n:
+            raise ValueError(
+                f"tensor_aggregator: dim {self.get_property('frames_dim')} "
+                f"size {arr.shape[axis]} not divisible by frames-in {n}"
+            )
+        per = arr.shape[axis] // n
+        for k in range(n):
+            sl = [slice(None)] * arr.ndim
+            sl[axis] = slice(k * per, (k + 1) * per)
+            self._window.append(arr[tuple(sl)])
+        ret = None
+        while len(self._window) >= fout:
+            chunk = self._window[:fout]
+            if is_device_array(chunk[0]):
+                import jax.numpy as jnp
+
+                out = jnp.concatenate(chunk, axis=axis)
+            else:
+                out = np.concatenate(chunk, axis=axis)
+            if self.srcpad.caps is None:
+                from nnstreamer_tpu.tensors.types import TensorsConfig
+
+                self.srcpad.set_caps(
+                    TensorsConfig.from_arrays([out]).to_caps()
+                )
+            ret = self.srcpad.push(
+                TensorBuffer([out], pts=self._pts)
+            )
+            self._window = self._window[flush:]
+            self._pts = buf.pts
+        return ret
+
+    def handle_eos(self):
+        self._window.clear()
+        self._pts = None

@@ -1,0 +1,160 @@
+"""CollectPads + timestamp-sync policies for N-to-1 elements.
+
+Reference: ``gst/nnstreamer/tensor_common_pipeline.c`` (707 LoC) — the four
+pad-sync policies shared by tensor_mux/tensor_merge
+(``tensor_time_sync_mode``, tensor_common.h:62-69;
+Documentation/synchronization-policies-at-mux-merge.md):
+
+- ``nosync``  — combine in arrival order; one output per full set.
+- ``slowest`` — sync to the slowest pad: output timestamp is the max of the
+  collected pts; every pad contributes its buffer closest to that time.
+- ``basepad`` — sync to a chosen pad (option ``<pad>:<duration>``): output
+  per base-pad buffer, others contribute their latest buffer within the
+  duration window (stale ones are reused).
+- ``refresh`` — output whenever ANY pad receives a buffer, reusing the
+  last-known buffer of the other pads.
+
+Mechanics: producer threads call :meth:`push`; the policy decides when a
+full frame-set is ready and which buffers compose it. All control flow is
+host-side; payloads (possibly device arrays) are only routed, never copied
+— the handle-based design SURVEY §7 calls for.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
+
+SYNC_POLICIES = ("nosync", "slowest", "basepad", "refresh")
+
+
+class CollectPads:
+    """Collects one buffer per pad according to a sync policy and emits
+    combined frame-sets via ``on_ready([(pad_index, buffer), ...])``."""
+
+    def __init__(self, num_pads: int, policy: str = "slowest",
+                 option: str = "",
+                 on_ready: Optional[Callable[[List[tuple]], None]] = None):
+        if policy not in SYNC_POLICIES:
+            raise ValueError(f"unknown sync policy {policy!r}")
+        self.num_pads = num_pads
+        self.policy = policy
+        self.on_ready = on_ready
+        self._lock = threading.Lock()
+        self._queues: Dict[int, List[TensorBuffer]] = {
+            i: [] for i in range(num_pads)
+        }
+        self._last: Dict[int, Optional[TensorBuffer]] = {
+            i: None for i in range(num_pads)
+        }
+        self._eos: Dict[int, bool] = {i: False for i in range(num_pads)}
+        self.base_pad = 0
+        self.base_window_ns = 0
+        if policy == "basepad" and option:
+            parts = str(option).split(":")
+            self.base_pad = int(parts[0])
+            if len(parts) > 1:
+                self.base_window_ns = int(parts[1])
+
+    def add_pad(self) -> int:
+        with self._lock:
+            i = self.num_pads
+            self.num_pads += 1
+            self._queues[i] = []
+            self._last[i] = None
+            self._eos[i] = False
+            return i
+
+    # -- input ---------------------------------------------------------------
+    def push(self, pad_index: int, buf: TensorBuffer) -> None:
+        ready = None
+        with self._lock:
+            self._queues[pad_index].append(buf)
+            self._last[pad_index] = buf
+            ready = self._collect_locked(pad_index)
+        if ready and self.on_ready:
+            for frame in ready:
+                self.on_ready(frame)
+
+    def set_eos(self, pad_index: int) -> bool:
+        """Mark a pad EOS; returns True when ALL pads are EOS."""
+        with self._lock:
+            self._eos[pad_index] = True
+            return all(self._eos.values())
+
+    # -- policies ------------------------------------------------------------
+    def _collect_locked(self, arrived: int) -> List[List[tuple]]:
+        frames = []
+        if self.policy in ("nosync", "slowest"):
+            # both need a full set; slowest additionally aligns timestamps
+            while all(q or self._eos[i]
+                      for i, q in self._queues.items()) and any(
+                          q for q in self._queues.values()):
+                if not all(self._queues[i] for i in self._queues
+                           if not self._eos[i]):
+                    break
+                live = [i for i in self._queues if self._queues[i]]
+                if len(live) < sum(1 for i in self._eos if not self._eos[i]):
+                    break
+                if self.policy == "slowest" and len(live) > 1:
+                    # drop buffers older than the slowest head timestamp
+                    base = max(
+                        (self._queues[i][0].pts or 0) for i in live
+                    )
+                    for i in live:
+                        q = self._queues[i]
+                        while len(q) > 1 and (q[1].pts or 0) <= base:
+                            q.pop(0)
+                frames.append([(i, self._queues[i].pop(0)) for i in live])
+        elif self.policy == "basepad":
+            while self._queues[self.base_pad]:
+                base_buf = self._queues[self.base_pad][0]
+                others_ready = True
+                for i in self._queues:
+                    if i == self.base_pad or self._eos[i]:
+                        continue
+                    if not self._queues[i] and self._last[i] is None:
+                        others_ready = False
+                        break
+                if not others_ready:
+                    break
+                self._queues[self.base_pad].pop(0)
+                frame = [(self.base_pad, base_buf)]
+                base_ts = base_buf.pts or 0
+                for i in self._queues:
+                    if i == self.base_pad:
+                        continue
+                    q = self._queues[i]
+                    # advance to the newest buffer not beyond the window
+                    chosen = self._last[i]
+                    while q:
+                        cand = q[0]
+                        if self.base_window_ns and cand.pts is not None and \
+                                cand.pts > base_ts + self.base_window_ns:
+                            break
+                        chosen = q.pop(0)
+                    if chosen is not None:
+                        frame.append((i, chosen))
+                frames.append(sorted(frame))
+        elif self.policy == "refresh":
+            if all(self._last[i] is not None or self._eos[i]
+                   for i in self._queues):
+                frame = [(i, self._last[i]) for i in self._queues
+                         if self._last[i] is not None]
+                self._queues[arrived].clear()
+                frames.append(frame)
+        return frames
+
+    def flush_remaining(self) -> List[List[tuple]]:
+        """At EOS: emit any complete-as-possible leftover sets (nosync)."""
+        with self._lock:
+            frames = []
+            while any(q for q in self._queues.values()):
+                frame = [(i, q.pop(0)) for i, q in self._queues.items() if q]
+                if self.policy in ("nosync",) and frame:
+                    frames.append(frame)
+                else:
+                    break
+            return frames

@@ -1,0 +1,209 @@
+"""tensor_converter — media streams → ``other/tensors``.
+
+Reference: ``gst/nnstreamer/elements/gsttensorconverter.c`` (2307 LoC):
+converts video/audio/text/octet/flexible streams into typed tensor frames,
+re-chunking with a GstAdapter (``_gst_tensor_converter_chain_chunk``:937),
+handling ``frames-per-tensor`` batching, and delegating unknown media types
+to external converter subplugins (``registerExternalConverter``:2185).
+
+Only converter (and decoder) know media semantics — every other element is
+semantics-agnostic (Documentation/component-description.md:15). Dim
+conventions match the reference: video → (C, W, H, N-frames); audio →
+(channels, samples); text/octet → per ``input-dim``/``input-type``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from nnstreamer_tpu.pipeline.caps import Caps
+from nnstreamer_tpu.pipeline.element import CapsEvent, Element, Event, Pad
+from nnstreamer_tpu.registry import CONVERTER, ELEMENT, get_subplugin, subplugin
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
+from nnstreamer_tpu.tensors.types import (
+    Fraction,
+    TensorFormat,
+    TensorInfo,
+    TensorsConfig,
+    TensorsInfo,
+    TensorType,
+)
+
+_VIDEO_CHANNELS = {"RGB": 3, "BGR": 3, "RGBA": 4, "BGRA": 4, "GRAY8": 1}
+_AUDIO_TYPES = {"S8": "int8", "U8": "uint8", "S16LE": "int16",
+                "U16LE": "uint16", "S32LE": "int32", "U32LE": "uint32",
+                "F32LE": "float32", "F64LE": "float64"}
+
+
+@subplugin(ELEMENT, "tensor_converter")
+class TensorConverter(Element):
+    ELEMENT_NAME = "tensor_converter"
+    PROPERTIES = {
+        **Element.PROPERTIES,
+        "frames_per_tensor": 1,
+        "input_dim": None,   # for octet/text streams: e.g. "3:224:224:1"
+        "input_type": None,  # e.g. "uint8"
+        "format": "static",  # output format: static | flexible
+        "mode": None,        # "custom-code:<registered-converter-name>"
+        "set_timestamp": True,
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+        self._in_caps: Optional[Caps] = None
+        self._out_config: Optional[TensorsConfig] = None
+        self._pending = bytearray()  # adapter for octet re-chunking
+        self._frame_acc: list = []   # adapter for frames-per-tensor batching
+        self._custom = None
+        self._frame_idx = 0
+
+    # -- negotiation ---------------------------------------------------------
+    def transform_caps(self, pad, caps):
+        self._in_caps = caps
+        self._out_config = self._derive_config(caps)
+        if self._out_config is None:
+            return None  # flexible/custom: announce on first buffer
+        return self._out_config.to_caps()
+
+    def _derive_config(self, caps: Caps) -> Optional[TensorsConfig]:
+        mode = self.get_property("mode")
+        if mode:  # custom converter owns the output config
+            name = mode.split(":", 1)[1] if ":" in mode else mode
+            self._custom = get_subplugin(CONVERTER, name)
+            if self._custom is None:
+                raise ValueError(f"tensor_converter: no converter subplugin "
+                                 f"{name!r}")
+            out = getattr(self._custom, "get_out_config", lambda c: None)(caps)
+            return out
+        rate = Fraction.parse(caps.get("framerate", "0/1"))
+        fpt = int(self.get_property("frames_per_tensor"))
+        if caps.name == "video/x-raw":
+            ch = _VIDEO_CHANNELS[caps.get("format", "RGB")]
+            w, h = int(caps["width"]), int(caps["height"])
+            info = TensorInfo(dim=(ch, w, h, fpt), type=TensorType.UINT8)
+            return TensorsConfig(info=TensorsInfo([info]), rate=rate)
+        if caps.name == "audio/x-raw":
+            t = TensorType(_AUDIO_TYPES[caps.get("format", "S16LE")])
+            ch = int(caps.get("channels", 1))
+            info = TensorInfo(dim=(ch, fpt), type=t)
+            return TensorsConfig(info=TensorsInfo([info]), rate=rate)
+        if caps.name in ("application/octet-stream", "text/x-raw"):
+            dim = self.get_property("input_dim")
+            typ = self.get_property("input_type") or "uint8"
+            if caps.name == "text/x-raw" and dim is None:
+                raise ValueError(
+                    "tensor_converter: text streams need input-dim "
+                    "(reference requires 'input-dim' for text, "
+                    "gsttensorconverter.c)"
+                )
+            if dim is None:
+                return None  # per-buffer shape → flexible output
+            info = TensorInfo.from_str(dim, typ)
+            return TensorsConfig(info=TensorsInfo([info]), rate=rate)
+        if caps.name in ("other/tensor", "other/tensors"):
+            cfg = TensorsConfig.from_caps(caps)
+            if cfg.format is not TensorFormat.STATIC:
+                return None  # flexible input: emit static per-buffer
+            return cfg
+        raise ValueError(f"tensor_converter: unsupported media {caps.name!r} "
+                         f"(use mode=custom-code:<name>)")
+
+    # -- dataflow ------------------------------------------------------------
+    def chain(self, pad, buf):
+        if self._custom is not None:
+            out = self._custom.convert(buf, self._in_caps)
+            return self._emit(out)
+        caps_name = self._in_caps.name if self._in_caps else MEDIA_DEFAULT
+        if caps_name == "video/x-raw":
+            return self._chain_video(buf)
+        if caps_name == "audio/x-raw":
+            return self._chain_audio(buf)
+        if caps_name in ("application/octet-stream", "text/x-raw"):
+            return self._chain_octet(buf)
+        return self._emit(buf)  # tensor passthrough (possibly flex→static)
+
+    def _emit(self, buf: TensorBuffer):
+        if self.srcpad.caps is None:
+            cfg = TensorsConfig.from_arrays(buf.tensors)
+            if self.get_property("format") == "flexible":
+                cfg = TensorsConfig(format=TensorFormat.FLEXIBLE)
+            self.srcpad.set_caps(cfg.to_caps())
+        if self.get_property("set_timestamp") and buf.pts is None:
+            rate = self._out_config.rate if self._out_config else Fraction(0, 1)
+            dur = rate.frame_duration_ns
+            buf = buf.replace(pts=self._frame_idx * dur if dur else
+                              TensorBuffer.wall_clock_pts())
+        self._frame_idx += 1
+        return self.srcpad.push(buf)
+
+    def _chain_video(self, buf):
+        """video frame (H,W,C) → tensor shape (N,H,W,C) == dim (C,W,H,N).
+
+        The reference strips stride-4 row padding here
+        (gsttensorconverter.c width-stride handling); our sources produce
+        packed arrays so only the frames-per-tensor batching remains.
+        """
+        frame = np.asarray(buf[0])
+        if frame.ndim == 2:
+            frame = frame[:, :, None]
+        fpt = int(self.get_property("frames_per_tensor"))
+        if fpt <= 1:
+            return self._emit(buf.with_tensors([frame[None]]))
+        self._frame_acc.append((frame, buf))
+        if len(self._frame_acc) < fpt:
+            return None
+        frames = np.stack([f for f, _ in self._frame_acc], axis=0)
+        first = self._frame_acc[0][1]
+        self._frame_acc.clear()
+        return self._emit(first.with_tensors([frames]))
+
+    def _chain_audio(self, buf):
+        samples = np.asarray(buf[0])  # (S, ch)
+        if samples.ndim == 1:
+            samples = samples[:, None]
+        fpt = int(self.get_property("frames_per_tensor"))
+        want = fpt if fpt > 1 else samples.shape[0]
+        # adapter: re-chunk to `want` samples per tensor
+        self._frame_acc.append((samples, buf))
+        total = sum(s.shape[0] for s, _ in self._frame_acc)
+        if total < want:
+            return None
+        cat = np.concatenate([s for s, _ in self._frame_acc], axis=0)
+        first = self._frame_acc[0][1]
+        self._frame_acc.clear()
+        ret = None
+        while cat.shape[0] >= want:
+            chunk, cat = cat[:want], cat[want:]
+            ret = self._emit(first.with_tensors([chunk]))
+        if cat.shape[0]:
+            self._frame_acc.append((cat, first))
+        return ret
+
+    def _chain_octet(self, buf):
+        dim = self.get_property("input_dim")
+        typ = TensorType.from_any(self.get_property("input_type") or "uint8")
+        raw = np.ascontiguousarray(np.asarray(buf[0])).tobytes()
+        if dim is None:
+            arr = np.frombuffer(raw, dtype=typ.np_dtype)
+            return self._emit(buf.with_tensors([arr]))
+        info = TensorInfo.from_str(dim, typ.value)
+        self._pending.extend(raw)
+        frame_size = info.size
+        ret = None
+        while len(self._pending) >= frame_size:
+            chunk = bytes(self._pending[:frame_size])
+            del self._pending[:frame_size]
+            arr = np.frombuffer(chunk, dtype=typ.np_dtype).reshape(info.shape)
+            ret = self._emit(buf.with_tensors([arr]))
+        return ret
+
+    def handle_eos(self):
+        self._pending.clear()
+        self._frame_acc.clear()
+
+
+MEDIA_DEFAULT = "application/octet-stream"

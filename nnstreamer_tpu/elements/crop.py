@@ -1,0 +1,68 @@
+"""tensor_crop — crop raw-tensor regions using a second "info" pad.
+
+Reference: ``gst/nnstreamer/elements/gsttensorcrop.c`` (820 LoC,
+tensor_crop.c:20-36): the ``raw`` sink pad carries data tensors, the
+``info`` sink pad carries crop coordinates (x, y, w, h per region, e.g.
+from a detection model); output is a flexible-format stream of cropped
+regions (shapes vary per frame).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from nnstreamer_tpu.elements.collect import CollectPads
+from nnstreamer_tpu.pipeline.element import CapsEvent, Element, EosEvent, FlowReturn
+from nnstreamer_tpu.registry import ELEMENT, subplugin
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
+from nnstreamer_tpu.tensors.types import TensorFormat, TensorsConfig
+
+
+@subplugin(ELEMENT, "tensor_crop")
+class TensorCrop(Element):
+    ELEMENT_NAME = "tensor_crop"
+    PROPERTIES = {**Element.PROPERTIES, "lateness": 0}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.raw_pad = self.add_sink_pad("raw")
+        self.info_pad = self.add_sink_pad("info")
+        self.add_src_pad("src")
+        self._collect = CollectPads(num_pads=2, policy="slowest",
+                                    on_ready=self._emit)
+
+    def chain(self, pad, buf):
+        self._collect.push(0 if pad is self.raw_pad else 1, buf)
+        return FlowReturn.OK
+
+    def _emit(self, frame):
+        by_pad = dict(frame)
+        raw, info = by_pad.get(0), by_pad.get(1)
+        if raw is None or info is None:
+            return
+        data = np.asarray(raw.tensors[0])
+        if data.ndim == 4 and data.shape[0] == 1:
+            data = data[0]  # (H, W, C)
+        regions = np.asarray(info.tensors[0]).reshape(-1, 4).astype(int)
+        crops = []
+        for x, y, w, h in regions:
+            x0, y0 = max(0, x), max(0, y)
+            crop = data[y0:y0 + h, x0:x0 + w]
+            crops.append(np.ascontiguousarray(crop))
+        if self.srcpad.caps is None:
+            cfg = TensorsConfig(format=TensorFormat.FLEXIBLE)
+            self.srcpad.set_caps(cfg.to_caps())
+        self.srcpad.push(raw.with_tensors(crops).replace(
+            meta={**raw.meta, "crop_regions": regions.tolist()}
+        ))
+
+    def sink_event(self, pad, event):
+        if isinstance(event, CapsEvent):
+            return
+        if isinstance(event, EosEvent):
+            if self._collect.set_eos(0 if pad is self.raw_pad else 1):
+                self.srcpad.push_event(event)
+            return
+        super().sink_event(pad, event)

@@ -1,0 +1,66 @@
+"""tensor_decoder — tensors → media, via decoder subplugins.
+
+Reference: ``gst/nnstreamer/elements/gsttensordecoder.c`` (973 LoC) with the
+subplugin API ``GstTensorDecoderDef`` (init/getOutCaps/decode,
+nnstreamer_plugin_api_decoder.h:38-97). Only converter/decoder know data
+semantics; a decoder turns model output tensors into labels, boxes,
+keypoints, overlay video, or serialized payloads.
+
+Subplugin protocol (duck-typed): an object (or class) with
+``out_caps(config, options) -> Caps`` and
+``decode(buf, config, options) -> TensorBuffer`` where ``options`` is the
+dict of ``option1..optionN`` strings (reference mode options).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from nnstreamer_tpu.pipeline.element import Element
+from nnstreamer_tpu.registry import DECODER, ELEMENT, get_subplugin, subplugin
+from nnstreamer_tpu.tensors.types import TensorsConfig
+
+
+@subplugin(ELEMENT, "tensor_decoder")
+class TensorDecoder(Element):
+    ELEMENT_NAME = "tensor_decoder"
+    PROPERTIES = {
+        **Element.PROPERTIES,
+        "mode": None,
+        **{f"option{i}": None for i in range(1, 10)},
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+        self._dec = None
+        self._config: Optional[TensorsConfig] = None
+
+    def _options(self) -> Dict[str, str]:
+        return {
+            f"option{i}": self.get_property(f"option{i}")
+            for i in range(1, 10)
+            if self.get_property(f"option{i}") is not None
+        }
+
+    def _get_decoder(self):
+        mode = self.get_property("mode")
+        if mode is None:
+            raise ValueError(f"{self.name}: mode not set")
+        if self._dec is None:
+            impl = get_subplugin(DECODER, mode)
+            if impl is None:
+                raise ValueError(f"{self.name}: no decoder subplugin {mode!r}")
+            self._dec = impl() if isinstance(impl, type) else impl
+        return self._dec
+
+    def transform_caps(self, pad, caps):
+        self._config = TensorsConfig.from_caps(caps)
+        dec = self._get_decoder()
+        return dec.out_caps(self._config, self._options())
+
+    def chain(self, pad, buf):
+        dec = self._get_decoder()
+        out = dec.decode(buf.to_host(), self._config, self._options())
+        return self.srcpad.push(out)

@@ -1,0 +1,230 @@
+"""tensor_filter — THE inference element.
+
+Reference: ``gst/nnstreamer/elements/gsttensorfilter.c`` (1297 LoC) +
+``tensor_filter_common.c`` (3001 LoC). Wraps a FilterFramework backend;
+negotiates caps from the model's tensor info; per-frame it maps inputs,
+invokes the backend (the HOT LOOP, tensor_filter.c:547-785), records
+latency/throughput statistics (:325-423), and supports:
+
+- ``framework=auto`` — detect backend from model extension by configured
+  priority (tensor_filter_common.c:1200);
+- ``input-combination``/``output-combination`` — route a subset of input
+  tensors to the model and merge model outputs with passthrough inputs
+  (tensor_filter_common.c combination props);
+- ``shared-tensor-filter-key`` — cross-instance model sharing;
+- ``is-updatable`` + ``reload_model`` custom event — hot model reload
+  (RELOAD_MODEL, nnstreamer_plugin_api_filter.h:377-383);
+- ``throttle`` QoS — drop frames when downstream lags (tensor_filter.c:426).
+
+TPU specifics: backends with ``KEEP_ON_DEVICE`` receive whatever arrived
+(host or device array) and return device arrays — a chain of
+converter→transform→filter→decoder keeps payloads in HBM end to end; XLA's
+async dispatch means invoke() returns before the device finishes, so
+pipeline stages overlap naturally.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, List, Optional
+
+import numpy as np
+
+from nnstreamer_tpu.config import get_conf
+from nnstreamer_tpu.filters.api import FilterFramework, FilterProperties
+from nnstreamer_tpu.pipeline.element import CustomEvent, Element, Event, Pad
+from nnstreamer_tpu.registry import ELEMENT, FILTER, get_subplugin, subplugin
+from nnstreamer_tpu.tensors.types import (
+    TensorsConfig,
+    TensorsInfo,
+)
+
+
+def detect_framework(model: str) -> Optional[str]:
+    """framework=auto: first loadable backend for this model's extension
+    (reference gst_tensor_filter_detect_framework,
+    tensor_filter_common.c:1200)."""
+    for cand in get_conf().framework_priority(model):
+        if get_subplugin(FILTER, cand) is not None:
+            return cand
+    return None
+
+
+def _parse_combination(spec: Optional[str]) -> Optional[List[tuple]]:
+    """Parse "i0,i2" / "o0,i1" into [(kind, idx), ...]."""
+    if not spec:
+        return None
+    out = []
+    for item in str(spec).split(","):
+        item = item.strip().lower()
+        if not item:
+            continue
+        kind, idx = item[0], item[1:]
+        if kind not in ("i", "o") or not idx.isdigit():
+            raise ValueError(f"bad combination item {item!r}")
+        out.append((kind, int(idx)))
+    return out
+
+
+@subplugin(ELEMENT, "tensor_filter")
+class TensorFilter(Element):
+    ELEMENT_NAME = "tensor_filter"
+    PROPERTIES = {
+        **Element.PROPERTIES,
+        "framework": "auto",
+        "model": None,
+        "custom": None,
+        "accelerator": None,
+        "input": None,            # forced input dims "3:224:224:1"
+        "inputtype": None,
+        "output": None,
+        "outputtype": None,
+        "is_updatable": False,
+        "input_combination": None,
+        "output_combination": None,
+        "shared_tensor_filter_key": None,
+        "throttle": 0,            # max invokes/sec; 0 = unthrottled
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+        self.fw: Optional[FilterFramework] = None
+        self._in_model_info: Optional[TensorsInfo] = None
+        self._out_model_info: Optional[TensorsInfo] = None
+        self._last_invoke_t = 0.0
+
+    # -- backend lifecycle ---------------------------------------------------
+    def _open_fw(self) -> FilterFramework:
+        """Open the backend once (reference
+        gst_tensor_filter_common_open_fw, tensor_filter_common.c:2394)."""
+        if self.fw is not None:
+            return self.fw
+        fw_name = self.get_property("framework") or "auto"
+        model = self.get_property("model")
+        if fw_name == "auto":
+            if model is None:
+                raise ValueError(f"{self.name}: framework=auto needs a model")
+            fw_name = detect_framework(model)
+            if fw_name is None:
+                raise ValueError(
+                    f"{self.name}: cannot detect framework for {model!r}"
+                )
+            self.log.info("framework=auto resolved to %s", fw_name)
+        factory = get_subplugin(FILTER, fw_name)
+        if factory is None:
+            raise ValueError(f"{self.name}: no filter backend {fw_name!r}")
+        fw = factory()
+        props = FilterProperties(
+            model=model,
+            custom=self.get_property("custom"),
+            accelerator=self.get_property("accelerator"),
+            input_info=self._forced_info("input", "inputtype"),
+            output_info=self._forced_info("output", "outputtype"),
+            is_updatable=bool(self.get_property("is_updatable")),
+            shared_key=self.get_property("shared_tensor_filter_key"),
+        )
+        fw.open(props)
+        self.fw = fw
+        return fw
+
+    def _forced_info(self, dim_key: str, type_key: str) -> Optional[TensorsInfo]:
+        dims = self.get_property(dim_key)
+        types = self.get_property(type_key)
+        if dims is None or types is None:
+            return None
+        return TensorsInfo.from_str(str(dims), str(types))
+
+    def start(self):
+        super().start()
+        self._open_fw()
+
+    def stop(self):
+        if self.fw is not None:
+            self.fw.close()
+            self.fw = None
+        super().stop()
+
+    # -- negotiation ---------------------------------------------------------
+    def transform_caps(self, pad, caps):
+        cfg = TensorsConfig.from_caps(caps)
+        fw = self._open_fw()
+        in_info, out_info = fw.get_model_info()
+        if cfg.info.is_valid() and in_info is not None and \
+                not cfg.info.is_equal(in_info):
+            raise ValueError(
+                f"{self.name}: incoming tensors {cfg.info!r} do not match "
+                f"model input {in_info!r}"
+            )
+        self._in_model_info = in_info or (cfg.info if cfg.info.is_valid()
+                                          else None)
+        if out_info is None:
+            if self._in_model_info is None:
+                raise ValueError(
+                    f"{self.name}: cannot negotiate — model has no static "
+                    f"info and input caps carry no dimensions"
+                )
+            out_info = fw.set_input_info(self._in_model_info)
+        self._out_model_info = out_info
+        final = self._combined_out_info(out_info)
+        return TensorsConfig(info=final, rate=cfg.rate).to_caps()
+
+    def _combined_out_info(self, out_info: TensorsInfo) -> TensorsInfo:
+        comb = _parse_combination(self.get_property("output_combination"))
+        if comb is None:
+            return out_info
+        in_info = self._in_model_info
+        infos = []
+        for kind, idx in comb:
+            infos.append(out_info[idx] if kind == "o" else in_info[idx])
+        return TensorsInfo(infos)
+
+    # -- hot path ------------------------------------------------------------
+    def chain(self, pad, buf):
+        throttle = int(self.get_property("throttle"))
+        if throttle > 0:
+            import time
+
+            now = time.monotonic()
+            if now - self._last_invoke_t < 1.0 / throttle:
+                return None  # QoS drop (tensor_filter.c:426)
+            self._last_invoke_t = now
+        fw = self.fw or self._open_fw()
+
+        in_comb = _parse_combination(self.get_property("input_combination"))
+        if in_comb is not None:
+            model_inputs = [buf.tensors[i] for _, i in in_comb]
+        else:
+            model_inputs = buf.tensors
+
+        if not fw.KEEP_ON_DEVICE:
+            model_inputs = [np.asarray(x) if not isinstance(x, np.ndarray)
+                            else x for x in model_inputs]
+
+        outputs = fw.invoke(model_inputs)
+
+        out_comb = _parse_combination(self.get_property("output_combination"))
+        if out_comb is not None:
+            final = [outputs[i] if k == "o" else buf.tensors[i]
+                     for k, i in out_comb]
+        else:
+            final = list(outputs)
+        return self.srcpad.push(buf.with_tensors(final))
+
+    # -- events --------------------------------------------------------------
+    def sink_event(self, pad, event: Event):
+        if isinstance(event, CustomEvent) and event.name == "reload_model":
+            if self.fw is not None:
+                self.fw.handle_event("reload_model", event.data)
+                self.log.info("model reloaded")
+            return  # consumed
+        super().sink_event(pad, event)
+
+    def reload_model(self, model: Optional[str] = None) -> None:
+        """App-facing hot reload (reference RELOAD_MODEL event)."""
+        data = {"model": model} if model else {}
+        if model:
+            self._props["model"] = model
+        if self.fw is not None:
+            self.fw.handle_event("reload_model", data)

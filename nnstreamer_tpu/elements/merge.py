@@ -1,0 +1,86 @@
+"""tensor_merge — N single tensors → ONE tensor along a dimension.
+
+Reference: ``gst/nnstreamer/elements/gsttensormerge.c`` (883 LoC), mode
+``linear`` with option = dim index to concatenate along (innermost-first
+dim order), under the shared sync policies. On TPU this is the batcher:
+``tensor_mux``'d streams merged on a new outer dim become ONE batched XLA
+invoke downstream.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from nnstreamer_tpu.elements.collect import CollectPads
+from nnstreamer_tpu.pipeline.element import (
+    CapsEvent,
+    Element,
+    EosEvent,
+    FlowReturn,
+)
+from nnstreamer_tpu.registry import ELEMENT, subplugin
+from nnstreamer_tpu.tensors.buffer import TensorBuffer, is_device_array
+
+
+@subplugin(ELEMENT, "tensor_merge")
+class TensorMerge(Element):
+    ELEMENT_NAME = "tensor_merge"
+    PROPERTIES = {**Element.PROPERTIES, "mode": "linear", "option": "0",
+                  "sync_mode": "slowest", "sync_option": ""}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_src_pad("src")
+        self._collect: Optional[CollectPads] = None
+        self._pad_index = {}
+
+    def request_sink_pad(self):
+        pad = self.add_sink_pad(f"sink_{len(self.sinkpads)}")
+        self._pad_index[pad] = len(self.sinkpads) - 1
+        return pad
+
+    def _get_collect(self):
+        if self._collect is None:
+            self._collect = CollectPads(
+                num_pads=len(self.sinkpads),
+                policy=self.get_property("sync_mode"),
+                option=self.get_property("sync_option"),
+                on_ready=self._emit,
+            )
+        return self._collect
+
+    def chain(self, pad, buf):
+        self._get_collect().push(self._pad_index[pad], buf)
+        return FlowReturn.OK
+
+    def _emit(self, frame):
+        arrays = [buf.tensors[0] for _, buf in frame]
+        dim_idx = int(self.get_property("option"))
+        rank = arrays[0].ndim
+        axis = rank - 1 - dim_idx  # dim order (innermost-first) → numpy axis
+        if any(is_device_array(a) for a in arrays):
+            import jax.numpy as jnp
+
+            merged = jnp.concatenate(arrays, axis=axis)
+        else:
+            merged = np.concatenate(arrays, axis=axis)
+        pts = max((b.pts or 0) for _, b in frame)
+        if self.srcpad.caps is None:
+            from nnstreamer_tpu.tensors.types import TensorsConfig
+
+            self.srcpad.set_caps(TensorsConfig.from_arrays([merged]).to_caps())
+        self.srcpad.push(TensorBuffer([merged], pts=pts))
+
+    def sink_event(self, pad, event):
+        if isinstance(event, CapsEvent):
+            return  # output caps derived from first merged frame
+        if isinstance(event, EosEvent):
+            if self._collect is not None and \
+                    self._collect.set_eos(self._pad_index[pad]):
+                self.srcpad.push_event(event)
+            elif self._collect is None and all(p.eos for p in self.sinkpads):
+                self.srcpad.push_event(event)
+            return
+        super().sink_event(pad, event)

@@ -1,0 +1,111 @@
+"""tensor_mux — N tensor streams → one multi-tensor frame.
+
+Reference: ``gst/nnstreamer/elements/gsttensormux.c`` (657 LoC): collects
+one buffer per sink pad (up to 16) under a sync policy and outputs a single
+``other/tensors`` frame whose tensors are the concatenation of all pads'
+tensors. On TPU this is the batching primitive: mux N sources, then a
+``tensor_merge``/filter batches them into one XLA invoke (SURVEY §2.4.2).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from nnstreamer_tpu.elements.collect import CollectPads
+from nnstreamer_tpu.pipeline.element import (
+    CapsEvent,
+    Element,
+    EosEvent,
+    FlowReturn,
+)
+from nnstreamer_tpu.registry import ELEMENT, subplugin
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
+from nnstreamer_tpu.tensors.types import (
+    NNS_TENSOR_SIZE_LIMIT,
+    TensorsConfig,
+    TensorsInfo,
+)
+
+
+@subplugin(ELEMENT, "tensor_mux")
+class TensorMux(Element):
+    ELEMENT_NAME = "tensor_mux"
+    PROPERTIES = {**Element.PROPERTIES, "sync_mode": "slowest",
+                  "sync_option": ""}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_src_pad("src")
+        self._collect: Optional[CollectPads] = None
+        self._pad_index = {}
+        self._pad_caps = {}
+
+    def request_sink_pad(self):
+        if len(self.sinkpads) >= NNS_TENSOR_SIZE_LIMIT:
+            raise ValueError(f"tensor_mux: max {NNS_TENSOR_SIZE_LIMIT} pads")
+        pad = self.add_sink_pad(f"sink_{len(self.sinkpads)}")
+        self._pad_index[pad] = len(self.sinkpads) - 1
+        return pad
+
+    def _get_collect(self) -> CollectPads:
+        if self._collect is None:
+            self._collect = CollectPads(
+                num_pads=len(self.sinkpads),
+                policy=self.get_property("sync_mode"),
+                option=self.get_property("sync_option"),
+                on_ready=self._emit,
+            )
+        return self._collect
+
+    def chain(self, pad, buf):
+        self._get_collect().push(self._pad_index[pad], buf)
+        return FlowReturn.OK
+
+    def _emit(self, frame):
+        tensors = []
+        pts = None
+        for _, buf in frame:
+            tensors.extend(buf.tensors)
+            if buf.pts is not None:
+                pts = max(pts, buf.pts) if pts is not None else buf.pts
+        if self.srcpad.caps is None:
+            self._announce_caps(frame)
+        self.srcpad.push(TensorBuffer(tensors[:NNS_TENSOR_SIZE_LIMIT],
+                                      pts=pts))
+
+    def _announce_caps(self, frame):
+        cfgs = []
+        for i, _ in frame:
+            caps = self._pad_caps.get(i)
+            if caps is not None:
+                cfgs.append(TensorsConfig.from_caps(caps))
+        if cfgs and all(c.info.is_valid() for c in cfgs):
+            infos = TensorsInfo(
+                [info for c in cfgs for info in c.info.infos]
+            )
+            self.srcpad.set_caps(
+                TensorsConfig(info=infos, rate=cfgs[0].rate).to_caps()
+            )
+        else:
+            _, buf = frame[0]
+            self.srcpad.set_caps(
+                TensorsConfig.from_arrays(
+                    [t for _, b in frame for t in b.tensors]
+                ).to_caps()
+            )
+
+    def sink_event(self, pad, event):
+        if isinstance(event, CapsEvent):
+            self._pad_caps[self._pad_index[pad]] = event.caps
+            return  # output caps derived at first frame-set
+        if isinstance(event, EosEvent):
+            if self._collect is not None:
+                all_eos = self._collect.set_eos(self._pad_index[pad])
+                if all_eos:
+                    for frame in self._collect.flush_remaining():
+                        self._emit(frame)
+                    self.srcpad.push_event(event)
+            elif all(p.eos for p in self.sinkpads):
+                self.srcpad.push_event(event)
+            return
+        super().sink_event(pad, event)

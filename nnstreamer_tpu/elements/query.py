@@ -1,0 +1,225 @@
+"""tensor_query_client / tensor_query_serversrc / tensor_query_serversink —
+distributed pipeline offload elements.
+
+Reference: ``gst/nnstreamer/tensor_query/`` — the client sends each input
+buffer to a remote server pipeline and pushes the returned result
+downstream (tensor_query_client.c:609); the server pipeline is bracketed by
+serversrc (receives client buffers) and serversink (routes each result back
+to its client by client-id meta). Client failover walks a server list
+(``_client_retry_connection``:465; hybrid/MQTT discovery provides the list
+— see ``query.discovery``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+from nnstreamer_tpu.pipeline.element import CapsEvent, Element, FlowReturn
+from nnstreamer_tpu.pipeline.pipeline import SourceElement
+from nnstreamer_tpu.query import protocol as P
+from nnstreamer_tpu.query.server import QueryServer
+from nnstreamer_tpu.registry import ELEMENT, subplugin
+from nnstreamer_tpu.tensors.types import TensorFormat, TensorsConfig
+
+
+@subplugin(ELEMENT, "tensor_query_client")
+class TensorQueryClient(Element):
+    ELEMENT_NAME = "tensor_query_client"
+    PROPERTIES = {
+        **Element.PROPERTIES,
+        "host": "127.0.0.1",
+        "port": 3000,
+        "dest_host": None,   # alias pair (reference uses dest-host/dest-port)
+        "dest_port": None,
+        "servers": None,     # failover list "host1:port1,host2:port2"
+        "timeout": P.DEFAULT_TIMEOUT,
+        "max_retry": 3,
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+        self._sock = None
+        self._client_id = None
+        self._server_idx = 0
+        self._lock = threading.Lock()
+
+    def _server_list(self) -> List[Tuple[str, int]]:
+        servers = self.get_property("servers")
+        if servers:
+            out = []
+            for item in str(servers).split(","):
+                h, p = item.rsplit(":", 1)
+                out.append((h.strip(), int(p)))
+            return out
+        host = self.get_property("dest_host") or self.get_property("host")
+        port = int(self.get_property("dest_port") or self.get_property("port"))
+        return [(host, port)]
+
+    def _connect(self):
+        """Connect with failover across the server list (reference
+        _client_retry_connection)."""
+        servers = self._server_list()
+        last_err = None
+        for attempt in range(int(self.get_property("max_retry")) *
+                             len(servers)):
+            host, port = servers[self._server_idx % len(servers)]
+            try:
+                sock = P.connect(host, port,
+                                 timeout=float(self.get_property("timeout")))
+                caps_repr = repr(self.sinkpad.caps) if self.sinkpad.caps else ""
+                P.send_msg(sock, P.Cmd.REQUEST_INFO, caps_repr.encode())
+                cmd, payload = P.recv_msg(sock)
+                if cmd is P.Cmd.DENY:
+                    raise P.QueryProtocolError(f"server {host}:{port} denied")
+                if cmd is not P.Cmd.APPROVE:
+                    raise P.QueryProtocolError(f"bad handshake reply {cmd}")
+                cmd, payload = P.recv_msg(sock)
+                if cmd is P.Cmd.CLIENT_ID:
+                    self._client_id = int(payload.decode())
+                self._sock = sock
+                return
+            except (OSError, P.QueryProtocolError) as e:
+                last_err = e
+                self._server_idx += 1
+                self.log.warning("connect to %s:%d failed (%s); trying next",
+                                 host, port, e)
+        raise P.QueryProtocolError(
+            f"all query servers unreachable: {last_err}"
+        )
+
+    def stop(self):
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    P.send_msg(self._sock, P.Cmd.BYE)
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+        super().stop()
+
+    def transform_caps(self, pad, caps):
+        return None  # output caps come from the first result buffer
+
+    def chain(self, pad, buf):
+        with self._lock:
+            for attempt in (1, 2):  # one transparent reconnect per frame
+                if self._sock is None:
+                    self._connect()
+                try:
+                    P.send_buffer(self._sock, buf)
+                    cmd, payload = P.recv_msg(self._sock)
+                    if cmd is not P.Cmd.RESULT:
+                        raise P.QueryProtocolError(f"expected RESULT, got {cmd}")
+                    result = P.unpack_buffer(payload)
+                    break
+                except (OSError, P.QueryProtocolError) as e:
+                    self.log.warning("query round-trip failed: %s", e)
+                    self._sock = None
+                    if attempt == 2:
+                        raise
+        result = result.replace(pts=buf.pts, meta=dict(buf.meta))
+        if self.srcpad.caps is None:
+            self.srcpad.set_caps(
+                TensorsConfig.from_arrays(result.tensors).to_caps()
+            )
+        return self.srcpad.push(result)
+
+
+@subplugin(ELEMENT, "tensor_query_serversrc")
+class TensorQueryServerSrc(SourceElement):
+    """Server-side source: accepts client connections and yields received
+    buffers (client id attached as meta for serversink routing)."""
+
+    ELEMENT_NAME = "tensor_query_serversrc"
+    PROPERTIES = {
+        **SourceElement.PROPERTIES,
+        "host": "0.0.0.0",
+        "port": 3000,
+        "id": 0,  # pairs serversrc/serversink (reference `id` property)
+        "num_buffers": -1,
+    }
+
+    _SERVERS = {}
+    _SERVERS_LOCK = threading.Lock()
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.server: Optional[QueryServer] = None
+        self.i = 0
+
+    def start(self):
+        super().start()
+        self.server = QueryServer(
+            host=self.get_property("host"),
+            port=int(self.get_property("port")),
+        ).start()
+        with self._SERVERS_LOCK:
+            self._SERVERS[int(self.get_property("id"))] = self.server
+
+    def stop(self):
+        if self.server is not None:
+            self.server.stop()
+            with self._SERVERS_LOCK:
+                self._SERVERS.pop(int(self.get_property("id")), None)
+            self.server = None
+        super().stop()
+
+    @classmethod
+    def get_server(cls, pair_id: int) -> Optional[QueryServer]:
+        with cls._SERVERS_LOCK:
+            return cls._SERVERS.get(pair_id)
+
+    @property
+    def port(self) -> int:
+        """Bound port (use port=0 to pick a free one in tests)."""
+        return self.server.port if self.server else \
+            int(self.get_property("port"))
+
+    def negotiate(self):
+        self.srcpad.set_caps(
+            TensorsConfig(format=TensorFormat.FLEXIBLE).to_caps()
+        )
+
+    def create(self):
+        n = int(self.get_property("num_buffers"))
+        if 0 <= n <= self.i:
+            return None
+        while not self._stop_evt.is_set():
+            buf = self.server.get_buffer(timeout=0.1)
+            if buf is not None:
+                self.i += 1
+                return buf
+        return None
+
+
+@subplugin(ELEMENT, "tensor_query_serversink")
+class TensorQueryServerSink(Element):
+    """Server-side sink: returns each result to the client that sent the
+    corresponding input (routing by query_client_id meta — the reference's
+    GstMetaQuery client-id routing)."""
+
+    ELEMENT_NAME = "tensor_query_serversink"
+    PROPERTIES = {**Element.PROPERTIES, "id": 0}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad("sink")
+
+    def chain(self, pad, buf):
+        server = TensorQueryServerSrc.get_server(int(self.get_property("id")))
+        if server is None:
+            raise RuntimeError(
+                "tensor_query_serversink: no paired serversrc (check `id`)"
+            )
+        client_id = buf.meta.get("query_client_id")
+        if client_id is None:
+            raise RuntimeError(
+                "tensor_query_serversink: buffer lost its query_client_id "
+                "meta (keep meta intact through the server pipeline)"
+            )
+        server.send_result(int(client_id), buf)
+        return FlowReturn.OK

@@ -1,0 +1,70 @@
+"""tensor_rate — framerate adjustment + QoS throttling.
+
+Reference: ``gst/nnstreamer/elements/gsttensorrate.c`` (997 LoC): converts
+stream framerate by dropping/duplicating frames and, with ``throttle=true``,
+propagates QoS so upstream inference skips work for frames that would be
+dropped (gsttensorrate.c:27-36).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from nnstreamer_tpu.pipeline.element import CapsEvent, Element
+from nnstreamer_tpu.registry import ELEMENT, subplugin
+from nnstreamer_tpu.tensors.types import Fraction, TensorsConfig
+
+
+@subplugin(ELEMENT, "tensor_rate")
+class TensorRate(Element):
+    ELEMENT_NAME = "tensor_rate"
+    PROPERTIES = {**Element.PROPERTIES, "framerate": None, "throttle": True,
+                  "silent_drop": False}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+        self._in_rate: Optional[Fraction] = None
+        self._next_ts = 0.0
+        self.dropped = 0
+        self.duplicated = 0
+        self.out_count = 0
+
+    def _out_rate(self) -> Optional[Fraction]:
+        spec = self.get_property("framerate")
+        return Fraction.parse(spec) if spec else None
+
+    def transform_caps(self, pad, caps):
+        try:
+            cfg = TensorsConfig.from_caps(caps)
+            self._in_rate = cfg.rate
+            out = self._out_rate()
+            if out is not None:
+                cfg.rate = out
+                return cfg.to_caps()
+        except ValueError:
+            pass
+        return caps
+
+    def chain(self, pad, buf):
+        out_rate = self._out_rate()
+        if out_rate is None or out_rate.num <= 0 or buf.pts is None:
+            return self.srcpad.push(buf)
+        period_ns = 1e9 * out_rate.den / out_rate.num
+        ret = None
+        pushed = False
+        # emit one output per elapsed output period; duplicate if input is
+        # slower, drop if faster
+        while buf.pts >= self._next_ts:
+            out = buf.replace(pts=int(self._next_ts),
+                              duration=int(period_ns))
+            ret = self.srcpad.push(out)
+            self._next_ts += period_ns
+            self.out_count += 1
+            if pushed:
+                self.duplicated += 1
+            pushed = True
+        if not pushed:
+            self.dropped += 1
+        return ret

@@ -1,0 +1,164 @@
+"""tensor_repo — named global slots enabling cycles (RNN/LSTM recurrence).
+
+Reference: ``gst/nnstreamer/tensor_repo/`` — ``GstTensorRepo`` (hash of
+slots with GCond push/pull, tensor_repo.h:36-60) + ``tensor_reposink`` /
+``tensor_reposrc`` elements: a DAG-only pipeline gains feedback loops by
+writing each frame's state to a slot and reading it back at the top of the
+next iteration (tests/nnstreamer_repo_rnn).
+
+TPU design: slot payloads may be device ``jax.Array``s — recurrent state
+(e.g. LSTM hidden) stays in HBM across iterations with zero host
+round-trips (SURVEY §5 checkpoint/resume analog). Slots can also be
+snapshotted/restored for stateful-stream checkpointing.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from nnstreamer_tpu.pipeline.element import Element, FlowReturn
+from nnstreamer_tpu.pipeline.pipeline import SourceElement
+from nnstreamer_tpu.registry import ELEMENT, subplugin
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
+
+
+class TensorRepo:
+    """Process-global named slots with blocking get (GCond semantics)."""
+
+    def __init__(self):
+        self._slots: Dict[str, Any] = {}
+        self._cv = threading.Condition()
+
+    def set(self, slot: str, buf: TensorBuffer) -> None:
+        with self._cv:
+            self._slots[slot] = buf
+            self._cv.notify_all()
+
+    def get(self, slot: str, timeout: Optional[float] = None,
+            consume: bool = False) -> Optional[TensorBuffer]:
+        with self._cv:
+            if timeout is not None:
+                import time
+
+                deadline = time.monotonic() + timeout
+                while slot not in self._slots:
+                    left = deadline - time.monotonic()
+                    if left <= 0 or not self._cv.wait(timeout=left):
+                        return None
+            buf = self._slots.get(slot)
+            if consume and slot in self._slots:
+                del self._slots[slot]
+            return buf
+
+    def peek(self, slot: str) -> Optional[TensorBuffer]:
+        with self._cv:
+            return self._slots.get(slot)
+
+    def remove(self, slot: str) -> bool:
+        with self._cv:
+            return self._slots.pop(slot, None) is not None
+
+    def snapshot(self) -> Dict[str, list]:
+        """Host-side snapshot of all slots (checkpoint of stream state)."""
+        with self._cv:
+            return {
+                k: [np.asarray(t) for t in v.tensors]
+                for k, v in self._slots.items()
+            }
+
+    def restore(self, state: Dict[str, list]) -> None:
+        with self._cv:
+            for k, arrays in state.items():
+                self._slots[k] = TensorBuffer(list(arrays))
+            self._cv.notify_all()
+
+
+#: the process-global repo (reference: one static GstTensorRepo)
+GLOBAL_REPO = TensorRepo()
+
+
+@subplugin(ELEMENT, "tensor_reposink")
+class TensorRepoSink(Element):
+    """Writes each buffer into a repo slot (reference tensor_reposink.c)."""
+
+    ELEMENT_NAME = "tensor_reposink"
+    PROPERTIES = {**Element.PROPERTIES, "slot_index": 0, "slot": None}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad("sink")
+
+    def _slot(self) -> str:
+        return str(self.get_property("slot") or
+                   self.get_property("slot_index"))
+
+    def chain(self, pad, buf):
+        GLOBAL_REPO.set(self._slot(), buf)
+        return FlowReturn.OK
+
+
+@subplugin(ELEMENT, "tensor_reposrc")
+class TensorRepoSrc(SourceElement):
+    """Reads a repo slot each iteration (reference tensor_reposrc.c).
+
+    ``initial-dim``/``initial-type``/``initial-value`` provide the frame
+    pushed before the loop produces its first state (the reference reads a
+    caps-sized zero frame)."""
+
+    ELEMENT_NAME = "tensor_reposrc"
+    PROPERTIES = {
+        **SourceElement.PROPERTIES,
+        "slot_index": 0,
+        "slot": None,
+        "num_buffers": -1,
+        "initial_dim": None,
+        "initial_type": "float32",
+        "initial_value": 0.0,
+        "timeout": 10.0,
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.i = 0
+
+    def _slot(self) -> str:
+        return str(self.get_property("slot") or
+                   self.get_property("slot_index"))
+
+    def negotiate(self):
+        dim = self.get_property("initial_dim")
+        if dim:
+            from nnstreamer_tpu.tensors.types import (
+                TensorsConfig,
+                TensorsInfo,
+            )
+
+            info = TensorsInfo.from_str(str(dim),
+                                        str(self.get_property("initial_type")))
+            self.srcpad.set_caps(TensorsConfig(info=info).to_caps())
+
+    def create(self):
+        n = int(self.get_property("num_buffers"))
+        if 0 <= n <= self.i:
+            return None
+        if self.i == 0 and self.get_property("initial_dim"):
+            from nnstreamer_tpu.tensors.types import TensorInfo
+
+            info = TensorInfo.from_str(
+                str(self.get_property("initial_dim")),
+                str(self.get_property("initial_type")),
+            )
+            arr = np.full(info.shape, float(self.get_property("initial_value")),
+                          info.type.np_dtype)
+            self.i += 1
+            return TensorBuffer([arr], pts=0)
+        buf = GLOBAL_REPO.get(self._slot(),
+                              timeout=float(self.get_property("timeout")),
+                              consume=True)
+        if buf is None:
+            return None  # loop source starved → EOS
+        self.i += 1
+        return buf.replace(pts=self.i)

@@ -1,0 +1,129 @@
+"""Sink elements: application callback sink, file sink, fakesink.
+
+Reference: ``tensor_sink`` (gst/nnstreamer/elements/gsttensorsink.c, 644 LoC)
+emits a ``new-data`` GSignal per buffer to the app; gst core filesink/fakesink
+are used throughout the reference's SSAT golden tests (dump + byte-compare).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from nnstreamer_tpu.pipeline.element import Element, EosEvent, FlowReturn
+from nnstreamer_tpu.registry import ELEMENT, subplugin
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
+
+
+@subplugin(ELEMENT, "tensor_sink")
+class TensorSink(Element):
+    """Terminal sink exposing buffers to the application.
+
+    ``connect(cb)`` mirrors the reference's ``new-data`` signal
+    (gsttensorsink "new-data"); buffers are also collected (bounded by
+    ``max_stored``) for pull-style access, and :meth:`wait` blocks until N
+    buffers or EOS.
+    """
+
+    ELEMENT_NAME = "tensor_sink"
+    PROPERTIES = {**Element.PROPERTIES, "sync": False, "max_stored": 4096,
+                  "to_host": True}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad("sink")
+        self.buffers: List[TensorBuffer] = []
+        self._callbacks: List[Callable[[TensorBuffer], None]] = []
+        self._cv = threading.Condition()
+        self.eos = False
+
+    def connect(self, callback: Callable[[TensorBuffer], None]) -> None:
+        """Register a per-buffer callback (reference ``new-data`` signal)."""
+        self._callbacks.append(callback)
+
+    def chain(self, pad, buf):
+        if self.get_property("to_host"):
+            buf = buf.to_host()
+        with self._cv:
+            if len(self.buffers) < int(self.get_property("max_stored")):
+                self.buffers.append(buf)
+            self._cv.notify_all()
+        for cb in self._callbacks:
+            cb(buf)
+        return FlowReturn.OK
+
+    def sink_event(self, pad, event):
+        if isinstance(event, EosEvent):
+            with self._cv:
+                self.eos = True
+                self._cv.notify_all()
+        super().sink_event(pad, event)
+
+    def wait(self, n: int = 1, timeout: float = 30.0) -> List[TensorBuffer]:
+        """Block until at least ``n`` buffers arrived or EOS/timeout."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while len(self.buffers) < n and not self.eos:
+                left = deadline - time.monotonic()
+                if left <= 0 or not self._cv.wait(timeout=left):
+                    break
+            return list(self.buffers)
+
+
+@subplugin(ELEMENT, "filesink")
+class FileSink(Element):
+    """Dump raw tensor bytes to a file (gst filesink) — the SSAT
+    golden-output pattern: run pipeline, byte-compare the dump."""
+
+    ELEMENT_NAME = "filesink"
+    PROPERTIES = {**Element.PROPERTIES, "location": None, "append": False}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad("sink")
+        self._fh = None
+
+    def start(self):
+        super().start()
+        loc = self.get_property("location")
+        if loc is None:
+            raise ValueError("filesink: location not set")
+        mode = "ab" if self.get_property("append") else "wb"
+        self._fh = open(loc, mode)
+
+    def chain(self, pad, buf):
+        buf = buf.to_host()
+        for t in buf.tensors:
+            self._fh.write(np.ascontiguousarray(t).tobytes())
+        return FlowReturn.OK
+
+    def handle_eos(self):
+        if self._fh:
+            self._fh.flush()
+
+    def stop(self):
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+        super().stop()
+
+
+@subplugin(ELEMENT, "fakesink")
+class FakeSink(Element):
+    """Discard buffers (gst fakesink); counts them for tests."""
+
+    ELEMENT_NAME = "fakesink"
+    PROPERTIES = {**Element.PROPERTIES, "sync": False}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad("sink")
+        self.count = 0
+
+    def chain(self, pad, buf):
+        self.count += 1
+        return FlowReturn.OK

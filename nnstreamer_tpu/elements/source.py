@@ -1,0 +1,400 @@
+"""Source elements: test video, files, application push, and sensor capture.
+
+Reference equivalents: gst core ``videotestsrc``/``filesrc``/
+``multifilesrc``/``appsrc`` (used throughout the reference's SSAT pipelines)
+and ``tensor_src_iio`` (``gst/nnstreamer/elements/gsttensorsrciio.c``,
+2604 LoC — Linux Industrial-I/O sensor capture).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+from nnstreamer_tpu.pipeline.caps import Caps
+from nnstreamer_tpu.pipeline.element import Element
+from nnstreamer_tpu.pipeline.pipeline import SourceElement
+from nnstreamer_tpu.registry import ELEMENT, subplugin
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
+from nnstreamer_tpu.tensors.types import Fraction
+
+_VIDEO_CHANNELS = {"RGB": 3, "BGR": 3, "RGBA": 4, "BGRA": 4, "GRAY8": 1}
+
+
+@subplugin(ELEMENT, "videotestsrc")
+class VideoTestSrc(SourceElement):
+    """Deterministic synthetic video source (gst videotestsrc equivalent).
+
+    Patterns: ``smpte`` (deterministic color bars), ``ball`` (moving dot,
+    frame-dependent), ``gradient``, ``black``. Frames are reproducible
+    functions of (pattern, frame index) so golden tests can byte-compare.
+    """
+
+    ELEMENT_NAME = "videotestsrc"
+    PROPERTIES = {
+        **SourceElement.PROPERTIES,
+        "num_buffers": -1,
+        "pattern": "smpte",
+        "width": 320,
+        "height": 240,
+        "format": "RGB",
+        "framerate": "30/1",
+        "is_live": False,
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.i = 0
+
+    def _caps(self) -> Caps:
+        return Caps(
+            "video/x-raw",
+            {
+                "format": self.get_property("format"),
+                "width": int(self.get_property("width")),
+                "height": int(self.get_property("height")),
+                "framerate": str(self.get_property("framerate")),
+            },
+        )
+
+    def negotiate(self):
+        self.srcpad.set_caps(self._caps())
+
+    def _frame(self, i: int) -> np.ndarray:
+        w = int(self.get_property("width"))
+        h = int(self.get_property("height"))
+        fmt = self.get_property("format")
+        ch = _VIDEO_CHANNELS[fmt]
+        pattern = self.get_property("pattern")
+        if pattern == "black":
+            img = np.zeros((h, w, ch), np.uint8)
+        elif pattern == "gradient":
+            row = np.linspace(0, 255, w, dtype=np.uint8)
+            img = np.broadcast_to(row[None, :, None], (h, w, ch)).copy()
+        elif pattern == "ball":
+            img = np.zeros((h, w, ch), np.uint8)
+            cx = (i * 7) % w
+            cy = (i * 5) % h
+            y, x = np.ogrid[:h, :w]
+            mask = (x - cx) ** 2 + (y - cy) ** 2 <= (min(h, w) // 8) ** 2
+            img[mask] = 255
+        else:  # smpte bars
+            bars = np.array(
+                [[255, 255, 255], [255, 255, 0], [0, 255, 255], [0, 255, 0],
+                 [255, 0, 255], [255, 0, 0], [0, 0, 255]], np.uint8
+            )
+            idx = (np.arange(w) * 7 // max(w, 1)).clip(0, 6)
+            rgb = bars[idx]
+            img = np.broadcast_to(rgb[None, :, :], (h, w, 3)).copy()
+            if ch == 1:
+                img = img.mean(axis=2, keepdims=True).astype(np.uint8)
+            elif ch == 4:
+                img = np.concatenate(
+                    [img, np.full((h, w, 1), 255, np.uint8)], axis=2
+                )
+        if img.shape[2] != ch:  # gray/alpha adjust for non-smpte patterns
+            if ch == 1:
+                img = img[:, :, :1]
+            elif ch == 4 and img.shape[2] == 3:
+                img = np.concatenate(
+                    [img, np.full((h, w, 1), 255, np.uint8)], axis=2
+                )
+        return img
+
+    def create(self) -> Optional[TensorBuffer]:
+        n = int(self.get_property("num_buffers"))
+        if 0 <= n <= self.i:
+            return None
+        rate = Fraction.parse(self.get_property("framerate"))
+        dur = rate.frame_duration_ns or 0
+        buf = TensorBuffer([self._frame(self.i)], pts=self.i * dur,
+                           duration=dur)
+        if self.get_property("is_live") and dur:
+            time.sleep(dur / 1e9)
+        self.i += 1
+        return buf
+
+
+@subplugin(ELEMENT, "audiotestsrc")
+class AudioTestSrc(SourceElement):
+    """Deterministic sine-wave audio source (gst audiotestsrc equivalent)."""
+
+    ELEMENT_NAME = "audiotestsrc"
+    PROPERTIES = {
+        **SourceElement.PROPERTIES,
+        "num_buffers": -1,
+        "samplesperbuffer": 1024,
+        "freq": 440.0,
+        "rate": 44100,
+        "channels": 1,
+        "format": "S16LE",
+    }
+
+    _DTYPES = {"S16LE": np.int16, "S8": np.int8, "F32LE": np.float32,
+               "U8": np.uint8}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.i = 0
+
+    def negotiate(self):
+        self.srcpad.set_caps(Caps("audio/x-raw", {
+            "format": self.get_property("format"),
+            "rate": int(self.get_property("rate")),
+            "channels": int(self.get_property("channels")),
+        }))
+
+    def create(self):
+        n = int(self.get_property("num_buffers"))
+        if 0 <= n <= self.i:
+            return None
+        spb = int(self.get_property("samplesperbuffer"))
+        rate = int(self.get_property("rate"))
+        ch = int(self.get_property("channels"))
+        t0 = self.i * spb
+        t = (np.arange(t0, t0 + spb) / rate)
+        wave = np.sin(2 * np.pi * float(self.get_property("freq")) * t)
+        dtype = self._DTYPES[self.get_property("format")]
+        if np.issubdtype(dtype, np.integer):
+            amp = np.iinfo(dtype).max * 0.8
+            samples = (wave * amp).astype(dtype)
+        else:
+            samples = wave.astype(dtype)
+        samples = np.repeat(samples[:, None], ch, axis=1)
+        pts = int(t0 / rate * 1e9)
+        self.i += 1
+        return TensorBuffer([samples], pts=pts,
+                            duration=int(spb / rate * 1e9))
+
+
+@subplugin(ELEMENT, "filesrc")
+class FileSrc(SourceElement):
+    """Whole-file source (gst filesrc): one buffer of raw bytes, caps
+    ``application/octet-stream`` (downstream converter interprets)."""
+
+    ELEMENT_NAME = "filesrc"
+    PROPERTIES = {**SourceElement.PROPERTIES, "location": None,
+                  "blocksize": -1}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._fh = None
+        self._done = False
+
+    def negotiate(self):
+        self.srcpad.set_caps(Caps("application/octet-stream", {}))
+
+    def create(self):
+        loc = self.get_property("location")
+        if loc is None or not os.path.isfile(loc):
+            raise FileNotFoundError(f"filesrc: no such file {loc!r}")
+        bs = int(self.get_property("blocksize"))
+        if self._fh is None:
+            self._fh = open(loc, "rb")
+        if bs <= 0:
+            if self._done:
+                return None
+            data = self._fh.read()
+            self._done = True
+        else:
+            data = self._fh.read(bs)
+            if not data:
+                return None
+        return TensorBuffer([np.frombuffer(data, np.uint8)])
+
+    def stop(self):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        self._done = False
+        super().stop()
+
+
+@subplugin(ELEMENT, "multifilesrc")
+class MultiFileSrc(SourceElement):
+    """Sequence-of-files source (gst multifilesrc): ``location`` is a printf
+    pattern (``img_%03d.raw``) or glob; one buffer per file."""
+
+    ELEMENT_NAME = "multifilesrc"
+    PROPERTIES = {**SourceElement.PROPERTIES, "location": None,
+                  "start_index": 0, "stop_index": -1, "caps": None}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.i = None
+
+    def negotiate(self):
+        caps = self.get_property("caps")
+        if isinstance(caps, str):
+            from nnstreamer_tpu.pipeline.parse import parse_caps_string
+
+            caps = parse_caps_string(caps)
+        self.srcpad.set_caps(caps or Caps("application/octet-stream", {}))
+
+    def _path(self, i: int) -> Optional[str]:
+        loc = self.get_property("location")
+        if "%" in loc:
+            return loc % i
+        files = sorted(glob.glob(loc))
+        return files[i] if i < len(files) else None
+
+    def create(self):
+        if self.i is None:
+            self.i = int(self.get_property("start_index"))
+        stop = int(self.get_property("stop_index"))
+        if 0 <= stop < self.i:
+            return None
+        path = self._path(self.i)
+        if path is None or not os.path.isfile(path):
+            return None
+        with open(path, "rb") as f:
+            data = f.read()
+        buf = TensorBuffer([np.frombuffer(data, np.uint8)], pts=self.i)
+        self.i += 1
+        return buf
+
+    def stop(self):
+        self.i = None
+        super().stop()
+
+
+@subplugin(ELEMENT, "appsrc")
+class AppSrc(SourceElement):
+    """Application push source (gst appsrc): the app calls :meth:`push` /
+    :meth:`end_of_stream`; the streaming thread forwards in order."""
+
+    ELEMENT_NAME = "appsrc"
+    PROPERTIES = {**SourceElement.PROPERTIES, "caps": None,
+                  "max_buffers": 64, "block": True}
+
+    _EOS = object()
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        import queue as _q
+
+        self._q = _q.Queue(maxsize=int(self.get_property("max_buffers")))
+
+    def set_caps(self, caps: Caps):
+        self.set_property("caps", caps)
+
+    def push(self, buf_or_arrays, pts: Optional[int] = None) -> None:
+        """Push a TensorBuffer (or list of arrays) into the stream."""
+        if not isinstance(buf_or_arrays, TensorBuffer):
+            buf_or_arrays = TensorBuffer.from_arrays(buf_or_arrays, pts=pts)
+        self._q.put(buf_or_arrays)
+
+    def end_of_stream(self) -> None:
+        self._q.put(self._EOS)
+
+    def negotiate(self):
+        caps = self.get_property("caps")
+        if isinstance(caps, str):
+            from nnstreamer_tpu.pipeline.parse import parse_caps_string
+
+            caps = parse_caps_string(caps)
+        if caps is not None:
+            self.srcpad.set_caps(caps)
+
+    def create(self):
+        import queue as _q
+
+        while not self._stop_evt.is_set():
+            try:
+                item = self._q.get(timeout=0.1)
+            except _q.Empty:
+                continue
+            if item is self._EOS:
+                return None
+            # announce caps from the first buffer if none were set
+            if self.srcpad.caps is None:
+                from nnstreamer_tpu.tensors.types import TensorsConfig
+
+                self.srcpad.set_caps(
+                    TensorsConfig.from_arrays(item.tensors).to_caps()
+                )
+            return item
+        return None
+
+
+@subplugin(ELEMENT, "tensor_src_iio")
+class TensorSrcIIO(SourceElement):
+    """Linux Industrial-I/O sensor source (reference ``tensor_src_iio``,
+    gst/nnstreamer/elements/gsttensorsrciio.c:18-52).
+
+    Reads sampled channels from ``/sys/bus/iio/devices`` + ``/dev/iio:deviceX``
+    and emits ``other/tensors`` frames [channels, buffer_capacity]. On hosts
+    without IIO hardware (every TPU VM), ``mode=mock`` provides a
+    deterministic synthetic device so pipelines and tests still run — the
+    reference's EdgeTPU ``device_type:dummy`` pattern.
+    """
+
+    ELEMENT_NAME = "tensor_src_iio"
+    PROPERTIES = {
+        **SourceElement.PROPERTIES,
+        "mode": "mock",  # "device" reads sysfs; "mock" synthesizes
+        "device": None,
+        "device_number": -1,
+        "frequency": 100,
+        "buffer_capacity": 1,
+        "channels": 2,
+        "num_buffers": -1,
+    }
+
+    _IIO_BASE = "/sys/bus/iio/devices"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.i = 0
+
+    def negotiate(self):
+        from nnstreamer_tpu.tensors.types import TensorsConfig, TensorsInfo
+
+        ch = int(self.get_property("channels"))
+        cap = int(self.get_property("buffer_capacity"))
+        info = TensorsInfo.from_str(f"{ch}:{cap}", "float32")
+        cfg = TensorsConfig(info=info,
+                            rate=Fraction(int(self.get_property("frequency")), 1))
+        self.srcpad.set_caps(cfg.to_caps())
+
+    def _read_device(self) -> Optional[np.ndarray]:
+        num = int(self.get_property("device_number"))
+        dev_dir = os.path.join(self._IIO_BASE, f"iio:device{num}")
+        if not os.path.isdir(dev_dir):
+            raise FileNotFoundError(
+                f"tensor_src_iio: no IIO device {num} (use mode=mock on "
+                f"hosts without IIO hardware)"
+            )
+        ch = int(self.get_property("channels"))
+        cap = int(self.get_property("buffer_capacity"))
+        vals = np.zeros((cap, ch), np.float32)
+        in_files = sorted(glob.glob(os.path.join(dev_dir, "in_*_raw")))[:ch]
+        for j in range(cap):
+            for c, f in enumerate(in_files):
+                with open(f) as fh:
+                    vals[j, c] = float(fh.read().strip())
+        return vals
+
+    def create(self):
+        n = int(self.get_property("num_buffers"))
+        if 0 <= n <= self.i:
+            return None
+        freq = max(1, int(self.get_property("frequency")))
+        if self.get_property("mode") == "device":
+            vals = self._read_device()
+        else:
+            ch = int(self.get_property("channels"))
+            cap = int(self.get_property("buffer_capacity"))
+            t = self.i * cap + np.arange(cap)
+            vals = np.stack(
+                [np.sin(2 * np.pi * (c + 1) * t / freq) for c in range(ch)],
+                axis=1,
+            ).astype(np.float32)
+            time.sleep(cap / freq / 100.0)  # mock pacing, 100x realtime
+        buf = TensorBuffer([vals], pts=int(self.i * 1e9 / freq))
+        self.i += 1
+        return buf

@@ -1,0 +1,92 @@
+"""tensor_sparse_enc / tensor_sparse_dec — dense↔sparse transcoding.
+
+Reference: ``gst/nnstreamer/elements/gsttensorsparseenc.c`` (414 LoC) /
+``...dec.c`` (408) + ``tensor_sparse_util.c``: COO-style encoding (nnz
+indices + values) of mostly-zero tensors to save transport bandwidth,
+emitted as flexible-format buffers with self-describing headers.
+
+Wire layout per tensor (after the TensorMetaInfo header, which carries the
+dense dim/type and nnz): uint32 flat indices [nnz] then values [nnz].
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from nnstreamer_tpu.pipeline.element import Element
+from nnstreamer_tpu.registry import ELEMENT, subplugin
+from nnstreamer_tpu.tensors.meta import HEADER_SIZE, TensorMetaInfo
+from nnstreamer_tpu.tensors.types import (
+    TensorFormat,
+    TensorInfo,
+    TensorsConfig,
+)
+
+
+def sparse_encode(arr: np.ndarray) -> bytes:
+    arr = np.ascontiguousarray(np.asarray(arr))
+    flat = arr.reshape(-1)
+    nz = np.flatnonzero(flat)
+    meta = TensorMetaInfo.from_info(
+        TensorInfo.from_array(arr), format=TensorFormat.SPARSE,
+        sparse_nnz=int(nz.size),
+    )
+    return (meta.pack() + nz.astype(np.uint32).tobytes() +
+            flat[nz].tobytes())
+
+
+def sparse_decode(blob: bytes, offset: int = 0):
+    meta = TensorMetaInfo.unpack(blob[offset:offset + HEADER_SIZE])
+    if meta.format is not TensorFormat.SPARSE:
+        raise ValueError("sparse_decode: not a sparse payload")
+    nnz = meta.sparse_nnz
+    dtype = meta.type.np_dtype
+    p = offset + HEADER_SIZE
+    idx = np.frombuffer(blob[p:p + 4 * nnz], np.uint32)
+    p += 4 * nnz
+    vals = np.frombuffer(blob[p:p + dtype.itemsize * nnz], dtype)
+    p += dtype.itemsize * nnz
+    info = meta.to_info()
+    dense = np.zeros(info.num_elements, dtype)
+    dense[idx] = vals
+    return dense.reshape(info.shape), p
+
+
+@subplugin(ELEMENT, "tensor_sparse_enc")
+class TensorSparseEnc(Element):
+    ELEMENT_NAME = "tensor_sparse_enc"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+
+    def transform_caps(self, pad, caps):
+        return TensorsConfig(format=TensorFormat.SPARSE).to_caps()
+
+    def chain(self, pad, buf):
+        blobs = [np.frombuffer(sparse_encode(t), np.uint8)
+                 for t in buf.to_host().tensors]
+        return self.srcpad.push(buf.with_tensors(blobs))
+
+
+@subplugin(ELEMENT, "tensor_sparse_dec")
+class TensorSparseDec(Element):
+    ELEMENT_NAME = "tensor_sparse_dec"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+
+    def transform_caps(self, pad, caps):
+        return None  # static caps derive from the first decoded frame
+
+    def chain(self, pad, buf):
+        outs = []
+        for t in buf.to_host().tensors:
+            dense, _ = sparse_decode(np.ascontiguousarray(t).tobytes())
+            outs.append(dense)
+        if self.srcpad.caps is None:
+            self.srcpad.set_caps(TensorsConfig.from_arrays(outs).to_caps())
+        return self.srcpad.push(buf.with_tensors(outs))

@@ -1,0 +1,40 @@
+"""tee — 1-to-N stream fan-out (gst core ``tee``).
+
+Used throughout the reference's composite-model pipelines (one camera, N
+models). Buffers are pushed to every src pad; payload arrays are shared
+(buffers are immutable by convention), so fan-out of device arrays is free.
+"""
+
+from __future__ import annotations
+
+from nnstreamer_tpu.pipeline.element import CapsEvent, Element, FlowReturn
+from nnstreamer_tpu.registry import ELEMENT, subplugin
+
+
+@subplugin(ELEMENT, "tee")
+class Tee(Element):
+    ELEMENT_NAME = "tee"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad("sink")
+
+    def link(self, downstream):
+        # allocate a new src pad per link
+        src = self.add_src_pad(f"src_{len(self.srcpads)}")
+        sink = next((p for p in downstream.sinkpads if p.peer is None), None)
+        if sink is None:
+            sink = downstream.request_sink_pad()
+        src.link(sink)
+        # replay caps already seen
+        if self.sinkpads[0].caps is not None:
+            src.set_caps(self.sinkpads[0].caps)
+        return downstream
+
+    def chain(self, pad, buf):
+        ret = FlowReturn.OK
+        for sp in self.srcpads:
+            r = sp.push(buf)
+            if r is FlowReturn.EOS:
+                ret = r
+        return ret

@@ -1,0 +1,10 @@
+"""L4/L5 — filter-framework API and backend subplugins."""
+
+from nnstreamer_tpu.filters.api import (  # noqa: F401
+    FilterFramework,
+    FilterProperties,
+    shared_model_get,
+    shared_model_insert,
+    shared_model_remove,
+)
+from nnstreamer_tpu.filters.custom import register_custom_easy  # noqa: F401

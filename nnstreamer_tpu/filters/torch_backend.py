@@ -1,0 +1,77 @@
+"""PyTorch filter backend (reference ``tensor_filter_pytorch.cc``, 711 LoC).
+
+Loads TorchScript (``.pt``/``.pth`` via ``torch.jit.load``) or pickled
+``nn.Module``s and invokes on CPU (this image ships CPU torch; the TPU path
+is the jax backend — torch parity exists so reference users can run their
+torch models unchanged while migrating)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from nnstreamer_tpu.filters.api import FilterFramework, FilterProperties
+from nnstreamer_tpu.registry import FILTER, subplugin
+from nnstreamer_tpu.tensors.types import TensorInfo, TensorsInfo, TensorType
+
+
+@subplugin(FILTER, "torch")
+class TorchFilter(FilterFramework):
+    NAME = "torch"
+
+    def __init__(self):
+        super().__init__()
+        self._module = None
+
+    def open(self, props: FilterProperties) -> None:
+        super().open(props)
+        import torch
+
+        path = props.model
+        try:
+            self._module = torch.jit.load(path, map_location="cpu")
+        except Exception:
+            loaded = torch.load(path, map_location="cpu", weights_only=False)
+            if not isinstance(loaded, torch.nn.Module):
+                raise ValueError(
+                    f"torch: {path!r} is neither TorchScript nor an nn.Module"
+                )
+            self._module = loaded
+        self._module.eval()
+
+    def close(self) -> None:
+        self._module = None
+        super().close()
+
+    def get_model_info(self):
+        return self.props.input_info, self.props.output_info
+
+    def set_input_info(self, in_info: TensorsInfo) -> TensorsInfo:
+        """Probe output shapes with a zero forward pass (torch has no
+        abstract shape eval)."""
+        import torch
+
+        zeros = [torch.zeros(i.shape,
+                             dtype=getattr(torch, i.type.value))
+                 for i in in_info]
+        with torch.no_grad():
+            out = self._module(*zeros)
+        if isinstance(out, torch.Tensor):
+            out = [out]
+        return TensorsInfo([
+            TensorInfo(dim=tuple(reversed(tuple(o.shape))),
+                       type=TensorType.from_any(str(o.dtype).split(".")[-1]))
+            for o in out
+        ])
+
+    def invoke(self, inputs: Sequence) -> List:
+        import torch
+
+        tins = [torch.from_numpy(np.ascontiguousarray(np.asarray(x)))
+                for x in inputs]
+        with self.global_stats().measure(), torch.no_grad():
+            out = self._module(*tins)
+        if isinstance(out, torch.Tensor):
+            out = [out]
+        return [o.numpy() for o in out]

@@ -167,12 +167,22 @@ class Element:
     ELEMENT_NAME = "element"
     PROPERTIES: Dict[str, Any] = {"silent": True, "name": None}
 
+    _instance_counter: Dict[str, int] = {}
+    _instance_counter_lock = threading.Lock()
+
+    @classmethod
+    def _next_auto_name(cls) -> str:
+        with Element._instance_counter_lock:
+            n = Element._instance_counter.get(cls.ELEMENT_NAME, 0)
+            Element._instance_counter[cls.ELEMENT_NAME] = n + 1
+        return f"{cls.ELEMENT_NAME}{n}"
+
     def __init__(self, name: Optional[str] = None, **props):
         cls_props: Dict[str, Any] = {}
         for klass in reversed(type(self).__mro__):
             cls_props.update(getattr(klass, "PROPERTIES", {}))
         self._props = dict(cls_props)
-        self.name = name or f"{self.ELEMENT_NAME}{id(self) & 0xFFFF:x}"
+        self.name = name or self._next_auto_name()
         self.log = get_logger(self.name)
         self.sinkpads: List[Pad] = []
         self.srcpads: List[Pad] = []

@@ -60,9 +60,28 @@ class CapsFilter(Element):
         return merged.fixate()
 
 
+def _split_caps_fields(text: str) -> List[str]:
+    """Split a caps string on commas, respecting double-quoted values so
+    multi-tensor fields like dimensions="3:224:224:1,3:300:300:1" stay
+    whole."""
+    parts, cur, in_q = [], [], False
+    for ch in text:
+        if ch == '"':
+            in_q = not in_q
+            cur.append(ch)
+        elif ch == "," and not in_q:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    parts.append("".join(cur))
+    return parts
+
+
 def parse_caps_string(text: str) -> Caps:
-    """Parse ``media/type,k=v,k2=v2`` into Caps (values kept as str/int)."""
-    parts = text.split(",")
+    """Parse ``media/type,k=v,k2=v2`` into Caps (values kept as str/int).
+    Quoted values may contain commas (multi-tensor dims/types)."""
+    parts = _split_caps_fields(text)
     name = parts[0].strip()
     fields = {}
     for item in parts[1:]:
@@ -105,58 +124,68 @@ def _make_element(factory_name: str, props: List[Tuple[str, str]]) -> Element:
 
 def parse_launch(description: str, pipeline: Optional[Pipeline] = None
                  ) -> Pipeline:
-    """Build a Pipeline from a gst-launch-style description."""
+    """Build a Pipeline from a gst-launch-style description.
+
+    Two-pass like gst_parse_launch: first build all elements and record the
+    link structure (so ``... ! mux.`` may reference an element defined later
+    in the description), then resolve links.
+    """
     pipe = pipeline or Pipeline()
     lexer = shlex.shlex(description, posix=True, punctuation_chars="!")
     lexer.whitespace_split = True
     tokens = list(lexer)
 
-    prev: Optional[Element] = None  # element whose src feeds the next link
-    pending_props: List[Tuple[str, str]] = []
+    # -- pass 1: nodes & chains ---------------------------------------------
+    # node: ("el", Element) | ("ref", name)
+    chains: List[List[tuple]] = [[]]
     current: Optional[Element] = None
-    link_pending = False
+    linked = False  # was the previous token a "!"?
 
-    def finish_current():
-        nonlocal current, prev, link_pending
-        if current is None:
-            return
-        pipe.add(current)
-        if link_pending and prev is not None:
-            prev.link(current)
-        prev = current
-        link_pending = False
-        current = None
+    def close_element():
+        nonlocal current
+        if current is not None:
+            pipe.add(current)
+            chains[-1].append(("el", current))
+            current = None
 
-    i = 0
-    while i < len(tokens):
-        tok = tokens[i]
+    for tok in tokens:
         if tok == "!":
-            finish_current()
-            link_pending = True
-        elif "=" in tok and current is not None and not _is_caps_token(tok):
+            close_element()
+            linked = True
+            continue
+        if "=" in tok and current is not None and not _is_caps_token(tok):
             k, v = tok.split("=", 1)
             if k == "name":
-                current.name = v
+                current.name = v  # set before close_element registers it
             elif k == "caps" and isinstance(current, CapsFilter):
                 current.set_property("caps", parse_caps_string(v))
             else:
                 current.set_property(k, v)
-        elif tok.endswith(".") and len(tok) > 1:
-            # branch point: continue from a named element
-            finish_current()
-            ref = tok[:-1]
-            if ref not in pipe.by_name:
-                raise ValueError(f"unknown element reference {ref!r}")
-            prev = pipe.by_name[ref]
-            link_pending = False
+            continue
+        # a new node begins; if no "!" came before it, start a new chain
+        close_element()
+        if not linked and chains[-1]:
+            chains.append([])
+        linked = False
+        if tok.endswith(".") and len(tok) > 1 and "=" not in tok:
+            chains[-1].append(("ref", tok[:-1]))
         elif _is_caps_token(tok):
-            finish_current()
-            cf = CapsFilter()
-            cf.set_property("caps", parse_caps_string(tok))
-            current = cf
+            current = CapsFilter()
+            current.set_property("caps", parse_caps_string(tok))
         else:
-            finish_current()
             current = _make_element(tok, [])
-        i += 1
-    finish_current()
+    close_element()
+
+    # -- pass 2: resolve links ----------------------------------------------
+    def resolve(node) -> Element:
+        kind, val = node
+        if kind == "el":
+            return val
+        if val not in pipe.by_name:
+            raise ValueError(f"unknown element reference {val!r}")
+        return pipe.by_name[val]
+
+    for chain in chains:
+        for a, b in zip(chain, chain[1:]):
+            resolve(a).link(resolve(b))
     return pipe

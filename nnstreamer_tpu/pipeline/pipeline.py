@@ -26,6 +26,7 @@ from nnstreamer_tpu.log import get_logger
 from nnstreamer_tpu.pipeline.element import (
     Element,
     EosEvent,
+    Event,
     FlowError,
     FlowReturn,
     Pad,
@@ -167,14 +168,19 @@ class Queue(Element):
             return FlowReturn.EOS
 
     def sink_event(self, pad, event):
-        if isinstance(event, EosEvent) and self._worker is not None:
+        if self._worker is None:
+            super().sink_event(pad, event)
+            return
+        if isinstance(event, EosEvent):
             # EOS is serialized: enqueue the sentinel in-order, then block
             # until the worker has drained everything ahead of it and
             # forwarded EOS downstream (gst serialized-event semantics).
             self._q.put(self._EOS)
             self._eos_done.wait(timeout=30)
         else:
-            super().sink_event(pad, event)
+            # all other events are serialized with the data flow too —
+            # a CapsEvent must not overtake buffers queued ahead of it
+            self._q.put(event)
 
     def _drain(self):
         while not self._stop_evt.is_set():
@@ -187,7 +193,10 @@ class Queue(Element):
                 self._eos_done.set()
                 return
             try:
-                self.srcpad.push(item)
+                if isinstance(item, Event):
+                    self.srcpad.push_event(item)
+                else:
+                    self.srcpad.push(item)
             except FlowError as e:
                 self.post_error(e)
                 self._eos_done.set()  # unblock a waiting EOS pusher

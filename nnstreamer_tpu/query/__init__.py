@@ -1,0 +1,1 @@
+"""L6 — distributed offload: query protocol/client/server, pub/sub, gRPC."""

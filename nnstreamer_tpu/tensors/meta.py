@@ -85,6 +85,10 @@ class TensorMetaInfo:
             raise ValueError(f"bad tensor header magic: {magic:#x}")
         if rank < 1 or rank > NNS_TENSOR_RANK_LIMIT:
             raise ValueError(f"bad rank {rank}")
+        if type_i >= len(_TYPE_ORDER):
+            raise ValueError(f"bad tensor type index {type_i}")
+        if fmt_i >= len(_FORMAT_ORDER):
+            raise ValueError(f"bad tensor format index {fmt_i}")
         dim = tuple(int(d) for d in fields[4:4 + rank])
         return cls(
             type=_TYPE_ORDER[type_i],

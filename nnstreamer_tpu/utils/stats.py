@@ -75,13 +75,14 @@ class InvokeStats:
             return int((n - 1) / span * 1000)
 
     def snapshot(self) -> dict:
-        with self._lock:
-            return {
-                "latency_us": self.latency_us,
-                "throughput_milli": self.throughput_milli,
-                "total_invokes": self.total_invokes,
-                "total_latency_s": self.total_latency_s,
-            }
+        # read the properties outside the lock — they acquire it themselves
+        # (the lock is non-reentrant)
+        return {
+            "latency_us": self.latency_us,
+            "throughput_milli": self.throughput_milli,
+            "total_invokes": self.total_invokes,
+            "total_latency_s": self.total_latency_s,
+        }
 
 
 class _Measure:

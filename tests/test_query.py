@@ -1,0 +1,132 @@
+"""Distributed tensor_query tests — server+client pipelines in one process
+over 127.0.0.1 (the reference's loopback multi-node pattern,
+tests/nnstreamer_query/unittest_query.cc:21-175)."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import parse_launch
+from nnstreamer_tpu.query import protocol as P
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
+
+
+class TestProtocol:
+    def test_buffer_roundtrip(self, rng):
+        buf = TensorBuffer(
+            [rng.standard_normal((2, 3)).astype(np.float32),
+             np.arange(5, dtype=np.uint8)],
+            pts=123, duration=456,
+        )
+        back = P.unpack_buffer(P.pack_buffer(buf))
+        assert back.pts == 123 and back.duration == 456
+        assert back.num_tensors == 2
+        np.testing.assert_array_equal(back[0], buf[0])
+        np.testing.assert_array_equal(back[1], buf[1])
+
+    def test_unset_timestamps(self):
+        back = P.unpack_buffer(P.pack_buffer(TensorBuffer([np.zeros(1)])))
+        assert back.pts is None and back.dts is None
+
+
+class TestQueryLoopback:
+    def test_offload_roundtrip(self):
+        """Server pipeline doubles values; client offloads and receives."""
+        from nnstreamer_tpu.filters import register_custom_easy
+        from nnstreamer_tpu.tensors.types import TensorsInfo
+
+        info = TensorsInfo.from_str("3:8:8:1", "uint8")
+        register_custom_easy(
+            "double_u8",
+            lambda ins: [(np.asarray(ins[0]) * 2).astype(np.uint8)],
+            info, info,
+        )
+        server = parse_launch(
+            "tensor_query_serversrc name=ssrc port=0 ! "
+            "tensor_filter framework=custom-easy model=double_u8 ! "
+            "tensor_query_serversink"
+        )
+        server.start()
+        try:
+            port = server.get("ssrc").port
+            client = parse_launch(
+                "videotestsrc num-buffers=4 width=8 height=8 pattern=gradient ! "
+                "tensor_converter ! "
+                f"tensor_query_client dest-host=127.0.0.1 dest-port={port} ! "
+                "tensor_sink name=out"
+            )
+            msg = client.run(timeout=30)
+            assert msg.kind == "eos"
+            outs = client.get("out").buffers
+            assert len(outs) == 4
+            # verify the server actually transformed the data
+            ref = parse_launch(
+                "videotestsrc num-buffers=1 width=8 height=8 pattern=gradient ! "
+                "tensor_converter ! tensor_sink name=out"
+            )
+            ref.run(timeout=15)
+            expected = (np.asarray(ref.get("out").buffers[0][0]) * 2).astype(
+                np.uint8
+            )
+            np.testing.assert_array_equal(outs[0][0], expected)
+        finally:
+            server.stop()
+
+    def test_client_failover_to_live_server(self):
+        from nnstreamer_tpu.filters import register_custom_easy
+        from nnstreamer_tpu.tensors.types import TensorsInfo
+
+        info = TensorsInfo.from_str("4", "float32")
+        register_custom_easy("passf", lambda ins: [np.asarray(ins[0])],
+                             info, info)
+        server = parse_launch(
+            "tensor_query_serversrc name=ssrc port=0 ! "
+            "tensor_filter framework=custom-easy model=passf ! "
+            "tensor_query_serversink"
+        )
+        server.start()
+        try:
+            port = server.get("ssrc").port
+            # first server in the list is dead; client must fail over
+            client = parse_launch(
+                "tensor_query_client name=c "
+                f"servers=127.0.0.1:1,127.0.0.1:{port} timeout=2"
+            )
+            from nnstreamer_tpu.elements.sink import TensorSink
+            from nnstreamer_tpu.elements.source import AppSrc
+
+            src, sink = AppSrc(name="src"), TensorSink(name="out")
+            client.add(src, sink)
+            src.link(client.get("c"))
+            client.get("c").link(sink)
+            client.start()
+            src.push([np.arange(4, dtype=np.float32)], pts=0)
+            src.end_of_stream()
+            msg = client.wait(timeout=30)
+            assert msg is not None and msg.kind == "eos", str(msg)
+            assert len(sink.buffers) == 1
+            np.testing.assert_array_equal(sink.buffers[0][0],
+                                          np.arange(4, dtype=np.float32))
+        finally:
+            client.stop()
+            server.stop()
+
+    def test_client_all_servers_down(self):
+        from nnstreamer_tpu.pipeline.element import FlowError
+        from nnstreamer_tpu.elements.sink import TensorSink
+        from nnstreamer_tpu.elements.source import AppSrc
+
+        client = parse_launch(
+            "tensor_query_client name=c servers=127.0.0.1:1 timeout=0.3 "
+            "max-retry=1"
+        )
+        src, sink = AppSrc(name="src"), TensorSink(name="out")
+        client.add(src, sink)
+        src.link(client.get("c"))
+        client.get("c").link(sink)
+        client.start()
+        src.push([np.zeros(2, np.float32)], pts=0)
+        src.end_of_stream()
+        msg = client.wait(timeout=30)
+        client.stop()
+        assert msg is not None and msg.kind == "error"
+        assert "unreachable" in str(msg.error)

@@ -1,0 +1,279 @@
+"""Tests for mux/demux/merge/split/tee/join + sync policies, aggregator,
+rate, tensor_if, crop, repo recurrence, sparse enc/dec (reference test
+groups: nnstreamer_mux, nnstreamer_demux, nnstreamer_merge, nnstreamer_split,
+nnstreamer_if, nnstreamer_repo_*, transform_*, unittest_rate)."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import parse_launch
+from nnstreamer_tpu.pipeline.pipeline import Pipeline
+
+
+def run_pipeline(desc, timeout=30):
+    pipe = parse_launch(desc)
+    msg = pipe.run(timeout=timeout)
+    assert msg is not None and msg.kind == "eos", f"pipeline failed: {msg}"
+    return pipe
+
+
+class TestMuxDemux:
+    def test_mux_two_sources(self):
+        pipe = run_pipeline(
+            "videotestsrc num-buffers=4 width=8 height=8 ! tensor_converter ! mux.  "
+            "videotestsrc num-buffers=4 width=4 height=4 ! tensor_converter ! mux.  "
+            "tensor_mux name=mux ! tensor_sink name=out"
+        )
+        bufs = pipe.get("out").buffers
+        assert len(bufs) == 4
+        assert bufs[0].num_tensors == 2
+        assert bufs[0][0].shape == (1, 8, 8, 3)
+        assert bufs[0][1].shape == (1, 4, 4, 3)
+
+    def test_mux_caps_announced(self):
+        pipe = run_pipeline(
+            "videotestsrc num-buffers=2 width=8 height=8 ! tensor_converter ! mux.  "
+            "videotestsrc num-buffers=2 width=4 height=4 ! tensor_converter ! mux.  "
+            "tensor_mux name=mux ! tensor_sink name=out"
+        )
+        caps = pipe.get("out").sinkpad.caps
+        assert caps["num_tensors"] == 2
+        assert caps["dimensions"] == "3:8:8:1,3:4:4:1"
+
+    def test_demux_tensorpick(self):
+        pipe = run_pipeline(
+            "videotestsrc num-buffers=3 width=8 height=8 ! tensor_converter ! mux.  "
+            "videotestsrc num-buffers=3 width=4 height=4 ! tensor_converter ! mux.  "
+            "tensor_mux name=mux ! tensor_demux name=d tensorpick=1 ! "
+            "tensor_sink name=out"
+        )
+        bufs = pipe.get("out").buffers
+        assert len(bufs) == 3
+        assert bufs[0].num_tensors == 1
+        assert bufs[0][0].shape == (1, 4, 4, 3)
+
+    def test_demux_two_branches(self):
+        from nnstreamer_tpu.pipeline.parse import parse_launch as pl
+
+        pipe = pl(
+            "videotestsrc num-buffers=2 width=8 height=8 ! tensor_converter ! mux.  "
+            "audiotestsrc num-buffers=2 samplesperbuffer=64 ! tensor_converter ! mux.  "
+            "tensor_mux name=mux ! tensor_demux name=d  "
+            "d. ! tensor_sink name=video_out  "
+            "d. ! tensor_sink name=audio_out"
+        )
+        msg = pipe.run(timeout=30)
+        assert msg.kind == "eos"
+        assert pipe.get("video_out").buffers[0][0].dtype == np.uint8
+        assert pipe.get("audio_out").buffers[0][0].dtype == np.int16
+
+
+class TestMergeSplit:
+    def test_merge_batches_on_dim(self):
+        # two 8x8 frames merged along dim 3 (outermost/N) -> batch of 2
+        pipe = run_pipeline(
+            "videotestsrc num-buffers=3 width=8 height=8 ! tensor_converter ! m.  "
+            "videotestsrc num-buffers=3 width=8 height=8 pattern=black ! "
+            "tensor_converter ! m.  "
+            "tensor_merge name=m mode=linear option=3 ! tensor_sink name=out"
+        )
+        bufs = pipe.get("out").buffers
+        assert len(bufs) == 3
+        assert bufs[0][0].shape == (2, 8, 8, 3)  # batched!
+
+    def test_split_inverse_of_merge(self):
+        pipe = run_pipeline(
+            "videotestsrc num-buffers=2 width=8 height=8 ! tensor_converter ! "
+            "tensor_split name=s tensorseg=4,4 dimension=1 ! "
+            "tensor_sink name=o1  s. ! tensor_sink name=o2"
+        )
+        o1, o2 = pipe.get("o1").buffers, pipe.get("o2").buffers
+        assert o1[0][0].shape == (1, 8, 4, 3)
+        assert o2[0][0].shape == (1, 8, 4, 3)
+
+    def test_split_bad_seg_errors(self):
+        from nnstreamer_tpu.pipeline.element import FlowError
+
+        pipe = parse_launch(
+            "videotestsrc num-buffers=1 width=8 height=8 ! tensor_converter ! "
+            "tensor_split tensorseg=3,3 dimension=1 ! fakesink"
+        )
+        with pytest.raises(FlowError, match="tensorseg sums"):
+            pipe.run(timeout=15)
+
+
+class TestTeeJoin:
+    def test_tee_fanout(self):
+        pipe = run_pipeline(
+            "videotestsrc num-buffers=3 width=8 height=8 ! tensor_converter ! "
+            "tee name=t  t. ! tensor_sink name=a  t. ! tensor_sink name=b"
+        )
+        assert len(pipe.get("a").buffers) == 3
+        assert len(pipe.get("b").buffers) == 3
+
+    def test_join_interleaves(self):
+        pipe = run_pipeline(
+            "videotestsrc num-buffers=2 width=8 height=8 ! tensor_converter ! j.  "
+            "videotestsrc num-buffers=2 width=8 height=8 ! tensor_converter ! j.  "
+            "join name=j ! tensor_sink name=out"
+        )
+        assert len(pipe.get("out").buffers) == 4
+
+
+class TestAggregator:
+    def test_sliding_window(self):
+        # 8 frames of 16 samples -> windows of 32 samples, flush 16 (overlap)
+        pipe = run_pipeline(
+            "audiotestsrc num-buffers=8 samplesperbuffer=16 ! "
+            "tensor_converter ! "
+            "tensor_aggregator frames-in=16 frames-out=32 frames-flush=16 "
+            "frames-dim=1 ! tensor_sink name=out"
+        )
+        bufs = pipe.get("out").buffers
+        assert len(bufs) == 7  # sliding: (128-32)/16 + 1
+        assert bufs[0][0].shape == (32, 1)
+
+    def test_disaggregate(self):
+        pipe = run_pipeline(
+            "audiotestsrc num-buffers=2 samplesperbuffer=64 ! "
+            "tensor_converter ! "
+            "tensor_aggregator frames-in=64 frames-out=16 frames-dim=1 ! "
+            "tensor_sink name=out"
+        )
+        bufs = pipe.get("out").buffers
+        assert len(bufs) == 8
+        assert bufs[0][0].shape == (16, 1)
+
+
+class TestRate:
+    def test_downsample(self):
+        pipe = run_pipeline(
+            "videotestsrc num-buffers=30 width=4 height=4 framerate=30/1 ! "
+            "tensor_converter ! tensor_rate name=r framerate=10/1 ! "
+            "tensor_sink name=out"
+        )
+        n = len(pipe.get("out").buffers)
+        assert 9 <= n <= 11
+        assert pipe.get("r").dropped > 0
+        caps = pipe.get("out").sinkpad.caps
+        assert caps["framerate"] == "10/1"
+
+
+class TestIf:
+    def test_average_branch(self):
+        # smpte bars have high average; black is 0 → then=passthrough for
+        # bright frames only
+        pipe = run_pipeline(
+            "videotestsrc num-buffers=4 width=8 height=8 pattern=black ! "
+            "tensor_converter ! "
+            "tensor_if name=i compared-value=TENSOR_AVERAGE_VALUE "
+            "compared-value-option=0 operator=gt supplied-value=10 "
+            "then=PASSTHROUGH else=SKIP ! tensor_sink name=bright"
+        )
+        assert len(pipe.get("bright").buffers) == 0  # black never passes
+
+        pipe2 = run_pipeline(
+            "videotestsrc num-buffers=4 width=8 height=8 pattern=smpte ! "
+            "tensor_converter ! "
+            "tensor_if compared-value=TENSOR_AVERAGE_VALUE "
+            "compared-value-option=0 operator=gt supplied-value=10 "
+            "then=PASSTHROUGH else=SKIP ! tensor_sink name=bright"
+        )
+        assert len(pipe2.get("bright").buffers) == 4
+
+    def test_custom_condition(self):
+        from nnstreamer_tpu.elements.cond import register_if_condition
+
+        register_if_condition("every_other",
+                              lambda buf: (buf.pts or 0) % 2 == 0)
+        pipe = run_pipeline(
+            "videotestsrc num-buffers=4 width=4 height=4 ! tensor_converter ! "
+            "tensor_if compared-value=CUSTOM compared-value-option=every_other "
+            "then=PASSTHROUGH else=SKIP ! tensor_sink name=out"
+        )
+        assert len(pipe.get("out").buffers) == 2
+
+
+class TestRepoRecurrence:
+    def test_loop_accumulates(self):
+        """RNN-style loop: state' = state + 1 each iteration via repo
+        (reference tests/nnstreamer_repo_rnn pattern with a trivial model)."""
+        from nnstreamer_tpu.filters import register_custom_easy
+        from nnstreamer_tpu.tensors.types import TensorsInfo
+
+        info = TensorsInfo.from_str("2", "float32")
+        register_custom_easy(
+            "inc", lambda ins: [np.asarray(ins[0]) + 1.0], info, info
+        )
+        pipe = run_pipeline(
+            "tensor_reposrc slot=loop0 num-buffers=5 initial-dim=2 "
+            "initial-type=float32 initial-value=0 timeout=5 ! "
+            "tensor_filter framework=custom-easy model=inc ! "
+            "tee name=t  t. ! tensor_reposink slot=loop0  "
+            "t. ! tensor_sink name=out"
+        )
+        outs = pipe.get("out").buffers
+        assert len(outs) == 5
+        np.testing.assert_array_equal(outs[-1][0],
+                                      np.full((2,), 5.0, np.float32))
+
+
+class TestSparse:
+    def test_roundtrip_pipeline(self):
+        from nnstreamer_tpu.filters import register_custom_easy
+        from nnstreamer_tpu.tensors.types import TensorsInfo
+
+        info = TensorsInfo.from_str("3:8:8:1", "uint8")
+        register_custom_easy(
+            "sparsify",
+            lambda ins: [np.where(np.asarray(ins[0]) > 200,
+                                  np.asarray(ins[0]), 0)],
+            info, info,
+        )
+        pipe = run_pipeline(
+            "videotestsrc num-buffers=2 width=8 height=8 ! tensor_converter ! "
+            "tensor_filter framework=custom-easy model=sparsify ! "
+            "tee name=t  t. ! tensor_sink name=ref  "
+            "t. ! tensor_sparse_enc ! tensor_sparse_dec ! tensor_sink name=out"
+        )
+        ref = pipe.get("ref").buffers
+        out = pipe.get("out").buffers
+        for r, o in zip(ref, out):
+            np.testing.assert_array_equal(np.asarray(r[0]), o[0])
+
+    def test_sparse_smaller_for_sparse_data(self):
+        from nnstreamer_tpu.elements.sparse import sparse_encode
+
+        dense = np.zeros((100, 100), np.float32)
+        dense[3, 7] = 1.0
+        assert len(sparse_encode(dense)) < dense.nbytes // 10
+
+
+class TestCrop:
+    def test_crop_regions(self):
+        from nnstreamer_tpu.pipeline.pipeline import Pipeline
+        from nnstreamer_tpu.elements.source import AppSrc
+        from nnstreamer_tpu.elements.crop import TensorCrop
+        from nnstreamer_tpu.elements.sink import TensorSink
+
+        img_src, info_src = AppSrc(name="img"), AppSrc(name="info")
+        crop, sink = TensorCrop(), TensorSink()
+        pipe = Pipeline().add(img_src, info_src, crop, sink)
+        img_src.srcpad.link(crop.raw_pad)
+        info_src.srcpad.link(crop.info_pad)
+        crop.link(sink)
+
+        img = np.arange(16 * 16 * 3, dtype=np.uint8).reshape(1, 16, 16, 3)
+        regions = np.array([[2, 3, 4, 5], [0, 0, 8, 8]], np.int32)
+        pipe.start()
+        img_src.push([img], pts=0)
+        info_src.push([regions], pts=0)
+        img_src.end_of_stream()
+        info_src.end_of_stream()
+        pipe.wait(timeout=15)
+        pipe.stop()
+        out = sink.buffers[0]
+        assert out.num_tensors == 2
+        assert out[0].shape == (5, 4, 3)
+        assert out[1].shape == (8, 8, 3)
+        np.testing.assert_array_equal(out[1], img[0, :8, :8])
