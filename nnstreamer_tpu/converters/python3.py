@@ -1,0 +1,33 @@
+"""python3 converter — user-script media→tensor converters (reference
+``tensor_converter/tensor_converter_python3.cc``, 404 LoC). The script
+(named by the converter mode string after the colon, or via conf) defines::
+
+    class Converter:
+        def get_out_config(self, caps): ...   # optional
+        def convert(self, buf, in_caps): ...
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+from nnstreamer_tpu.registry import CONVERTER, register_subplugin
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
+
+
+def load_python_converter(name: str, path: str) -> None:
+    """Load a converter script and register it under ``name`` (apps call
+    this; tensor_converter mode=custom-code:<name> then finds it)."""
+    if not os.path.isfile(path):
+        raise FileNotFoundError(path)
+    spec = importlib.util.spec_from_file_location(
+        f"nnstreamer_tpu_pyconv_{name}", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    cls = getattr(mod, "Converter", None)
+    if cls is None:
+        raise ValueError(f"{path!r} must define class Converter")
+    register_subplugin(CONVERTER, name, cls())

@@ -1,0 +1,195 @@
+"""bounding_boxes decoder — detection tensors → boxes (+ overlay video).
+
+Reference: ``ext/nnstreamer/tensor_decoder/tensordec-boundingbox.c``
+(1427 LoC) — modes mobilenet-ssd (anchor-decode + NMS), -postprocess
+(pre-decoded boxes), yolov5/yolov8 (tensordec-boundingbox.c:128-139).
+Output: either RGBA overlay video (reference behavior) or, with
+``option7=meta``, the raw box list in buffer meta (TPU pipelines usually
+want the structured result, not pixels).
+
+Options (mirroring the reference's option1..N):
+  option1: mode — mobilenet-ssd | mobilenet-ssd-postprocess | yolov5
+  option2: labels file
+  option3: score threshold (default 0.5)        [reference: custom props]
+  option4: video WIDTH:HEIGHT for overlay scaling (default 300:300)
+  option5: iou threshold for NMS (default 0.5)
+  option7: "meta" → no overlay, boxes in meta only
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from nnstreamer_tpu.pipeline.caps import Caps
+from nnstreamer_tpu.registry import DECODER, subplugin
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
+
+
+def nms(boxes: np.ndarray, scores: np.ndarray, iou_thresh: float = 0.5,
+        max_out: int = 100) -> List[int]:
+    """Greedy non-max suppression; boxes [N,4] as (y1,x1,y2,x2)."""
+    order = np.argsort(-scores)
+    keep: List[int] = []
+    while order.size and len(keep) < max_out:
+        i = order[0]
+        keep.append(int(i))
+        if order.size == 1:
+            break
+        rest = order[1:]
+        yy1 = np.maximum(boxes[i, 0], boxes[rest, 0])
+        xx1 = np.maximum(boxes[i, 1], boxes[rest, 1])
+        yy2 = np.minimum(boxes[i, 2], boxes[rest, 2])
+        xx2 = np.minimum(boxes[i, 3], boxes[rest, 3])
+        inter = np.maximum(0, yy2 - yy1) * np.maximum(0, xx2 - xx1)
+        area_i = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+        area_r = (boxes[rest, 2] - boxes[rest, 0]) * \
+            (boxes[rest, 3] - boxes[rest, 1])
+        iou = inter / np.maximum(area_i + area_r - inter, 1e-9)
+        order = rest[iou <= iou_thresh]
+    return keep
+
+
+def decode_ssd(box_enc: np.ndarray, scores: np.ndarray,
+               anchors: np.ndarray, score_thresh: float,
+               iou_thresh: float) -> List[dict]:
+    """Anchor-relative SSD decode (reference mobilenet-ssd mode math):
+    box_enc [A,4] as (ty,tx,th,tw) vs anchors [A,4] (cy,cx,h,w)."""
+    cy = box_enc[:, 0] / 10.0 * anchors[:, 2] + anchors[:, 0]
+    cx = box_enc[:, 1] / 10.0 * anchors[:, 3] + anchors[:, 1]
+    h = np.exp(box_enc[:, 2] / 5.0) * anchors[:, 2]
+    w = np.exp(box_enc[:, 3] / 5.0) * anchors[:, 3]
+    boxes = np.stack([cy - h / 2, cx - w / 2, cy + h / 2, cx + w / 2], axis=1)
+    probs = 1.0 / (1.0 + np.exp(-scores))  # sigmoid scores
+    out = []
+    for cls in range(1, probs.shape[1]):  # class 0 = background
+        mask = probs[:, cls] >= score_thresh
+        if not mask.any():
+            continue
+        cls_boxes, cls_scores = boxes[mask], probs[mask, cls]
+        for i in nms(cls_boxes, cls_scores, iou_thresh):
+            out.append({
+                "class": cls,
+                "score": float(cls_scores[i]),
+                "box": [float(v) for v in cls_boxes[i]],  # y1,x1,y2,x2 ∈[0,1]
+            })
+    out.sort(key=lambda d: -d["score"])
+    return out
+
+
+def draw_boxes(width: int, height: int, detections: List[dict]
+               ) -> np.ndarray:
+    """RGBA overlay frame (transparent except box outlines) — the
+    reference's output form for compositing over video."""
+    img = np.zeros((height, width, 4), np.uint8)
+    for det in detections:
+        y1, x1, y2, x2 = det["box"]
+        xi1, yi1 = int(np.clip(x1 * width, 0, width - 1)), \
+            int(np.clip(y1 * height, 0, height - 1))
+        xi2, yi2 = int(np.clip(x2 * width, 0, width - 1)), \
+            int(np.clip(y2 * height, 0, height - 1))
+        color = np.array([0, 255, 0, 255], np.uint8)
+        img[yi1:yi2 + 1, xi1] = color
+        img[yi1:yi2 + 1, xi2] = color
+        img[yi1, xi1:xi2 + 1] = color
+        img[yi2, xi1:xi2 + 1] = color
+    return img
+
+
+@subplugin(DECODER, "bounding_boxes")
+class BoundingBoxes:
+    def __init__(self):
+        self._labels = None
+        self._anchors = None
+
+    def _opts(self, options: Dict[str, str]) -> dict:
+        size = (options.get("option4") or "300:300").split(":")
+        return dict(
+            mode=options.get("option1", "mobilenet-ssd"),
+            labels_path=options.get("option2"),
+            score_thresh=float(options.get("option3") or 0.5),
+            width=int(size[0]), height=int(size[1]),
+            iou_thresh=float(options.get("option5") or 0.5),
+            meta_only=(options.get("option7") == "meta"),
+        )
+
+    def out_caps(self, config, options) -> Caps:
+        o = self._opts(options)
+        if o["meta_only"]:
+            return Caps("other/tensors", {"format": "flexible"})
+        return Caps("video/x-raw", {"format": "RGBA", "width": o["width"],
+                                    "height": o["height"]})
+
+    def _get_anchors(self, num_anchors: int, image_size: int) -> np.ndarray:
+        if self._anchors is None or self._anchors.shape[0] != num_anchors:
+            from nnstreamer_tpu.models.ssd_mobilenet import anchor_grid
+
+            self._anchors = anchor_grid(image_size)
+            if self._anchors.shape[0] != num_anchors:
+                raise ValueError(
+                    f"bounding_boxes: anchor grid {self._anchors.shape[0]} "
+                    f"!= model anchors {num_anchors}"
+                )
+        return self._anchors
+
+    def decode(self, buf: TensorBuffer, config, options) -> TensorBuffer:
+        o = self._opts(options)
+        mode = o["mode"]
+        if mode == "mobilenet-ssd":
+            box_enc = np.asarray(buf[0], np.float32)
+            scores = np.asarray(buf[1], np.float32)
+            if box_enc.ndim == 3:  # [N, A, 4] batch of 1
+                box_enc, scores = box_enc[0], scores[0]
+            anchors = self._get_anchors(box_enc.shape[0], o["width"])
+            dets = decode_ssd(box_enc, scores, anchors,
+                              o["score_thresh"], o["iou_thresh"])
+        elif mode == "mobilenet-ssd-postprocess":
+            # already-decoded boxes [A,4] + scores [A] + classes [A]
+            boxes = np.asarray(buf[0], np.float32).reshape(-1, 4)
+            scores = np.asarray(buf[1], np.float32).reshape(-1)
+            classes = (np.asarray(buf[2]).reshape(-1).astype(int)
+                       if buf.num_tensors > 2 else np.ones(len(scores), int))
+            mask = scores >= o["score_thresh"]
+            dets = [{"class": int(c), "score": float(s),
+                     "box": [float(v) for v in b]}
+                    for b, s, c in zip(boxes[mask], scores[mask],
+                                       classes[mask])]
+        elif mode == "yolov5":
+            # [A, 5+classes]: cx,cy,w,h,objectness,class-scores
+            pred = np.asarray(buf[0], np.float32)
+            if pred.ndim == 3:
+                pred = pred[0]
+            obj = 1 / (1 + np.exp(-pred[:, 4]))
+            cls_p = 1 / (1 + np.exp(-pred[:, 5:])) * obj[:, None]
+            best = cls_p.argmax(axis=1)
+            score = cls_p[np.arange(len(best)), best]
+            mask = score >= o["score_thresh"]
+            cx, cy, w, h = (pred[mask, i] for i in range(4))
+            boxes = np.stack([cy - h / 2, cx - w / 2, cy + h / 2,
+                              cx + w / 2], axis=1)
+            keep = nms(boxes, score[mask], o["iou_thresh"])
+            bi, ci = np.flatnonzero(mask), best[mask]
+            dets = [{"class": int(ci[i]), "score": float(score[mask][i]),
+                     "box": [float(v) for v in boxes[i]]} for i in keep]
+        else:
+            raise ValueError(f"bounding_boxes: unknown mode {mode!r}")
+
+        if self._labels is None and o["labels_path"]:
+            from nnstreamer_tpu.decoders.image_labeling import load_labels
+
+            self._labels = load_labels(o["labels_path"])
+        if self._labels:
+            for d in dets:
+                if d["class"] < len(self._labels):
+                    d["label"] = self._labels[d["class"]]
+
+        meta = {**buf.meta, "detections": dets}
+        if o["meta_only"]:
+            flat = np.asarray(
+                [[d["box"][0], d["box"][1], d["box"][2], d["box"][3],
+                  d["class"], d["score"]] for d in dets], np.float32
+            ).reshape(-1, 6) if dets else np.zeros((0, 6), np.float32)
+            return buf.with_tensors([flat]).replace(meta=meta)
+        overlay = draw_boxes(o["width"], o["height"], dets)
+        return buf.with_tensors([overlay]).replace(meta=meta)

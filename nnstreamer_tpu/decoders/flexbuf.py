@@ -1,0 +1,51 @@
+"""flexbuf decoder — tensors → serialized self-describing byte stream.
+
+Reference: ``ext/nnstreamer/tensor_decoder/tensordec-flexbuf.c`` (230 LoC)
+serializes tensors with FlexBuffers. Our wire format is the framework's
+own flex-header framing (``tensors.meta``): u32 num_tensors, i64 pts, then
+per-tensor header+payload — compact, schema-free, and identical to what
+the query protocol uses, so flexbuf-encoded streams interoperate with
+every other serialized path in the framework. The matching converter
+(``converters.flexbuf``) reverses it.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from nnstreamer_tpu.pipeline.caps import Caps
+from nnstreamer_tpu.registry import DECODER, subplugin
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
+from nnstreamer_tpu.tensors.meta import pack_tensor, unpack_tensor
+
+_HDR = struct.Struct("<Iq")
+
+
+def encode_flex(buf: TensorBuffer) -> bytes:
+    host = buf.to_host()
+    parts = [_HDR.pack(host.num_tensors,
+                       -1 if buf.pts is None else buf.pts)]
+    parts += [pack_tensor(t) for t in host.tensors]
+    return b"".join(parts)
+
+
+def decode_flex(blob: bytes) -> TensorBuffer:
+    n, pts = _HDR.unpack_from(blob)
+    offset = _HDR.size
+    tensors = []
+    for _ in range(n):
+        arr, offset = unpack_tensor(blob, offset)
+        tensors.append(arr)
+    return TensorBuffer(tensors, pts=None if pts < 0 else pts)
+
+
+@subplugin(DECODER, "flexbuf")
+class FlexBufDecoder:
+    def out_caps(self, config, options) -> Caps:
+        return Caps("application/octet-stream", {"encoding": "flexbuf"})
+
+    def decode(self, buf: TensorBuffer, config, options) -> TensorBuffer:
+        blob = encode_flex(buf)
+        return buf.with_tensors([np.frombuffer(blob, np.uint8)])

@@ -1,0 +1,102 @@
+"""pose_estimation decoder — keypoint heatmaps → skeleton keypoints.
+
+Reference: ``ext/nnstreamer/tensor_decoder/tensordec-pose.c`` (824 LoC):
+consumes PoseNet heatmaps (+offsets), finds per-keypoint argmax, refines
+with offsets, outputs either an overlay or keypoint metadata.
+
+Options: option1 = video WIDTH:HEIGHT (overlay size), option2 = "meta"
+for structured output only, option3 = score threshold.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from nnstreamer_tpu.pipeline.caps import Caps
+from nnstreamer_tpu.registry import DECODER, subplugin
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
+
+# COCO keypoint skeleton edges (for overlay drawing)
+EDGES = [(0, 1), (0, 2), (1, 3), (2, 4), (5, 6), (5, 7), (7, 9), (6, 8),
+         (8, 10), (5, 11), (6, 12), (11, 12), (11, 13), (13, 15), (12, 14),
+         (14, 16)]
+
+
+def decode_pose(heatmaps: np.ndarray, offsets=None, threshold: float = 0.3):
+    """heatmaps [H, W, K] (+optional offsets [H, W, 2K]) → list of
+    {keypoint, y, x, score} with y/x normalized to [0,1]."""
+    H, W, K = heatmaps.shape
+    out = []
+    for k in range(K):
+        hm = heatmaps[:, :, k]
+        idx = np.unravel_index(np.argmax(hm), hm.shape)
+        score = float(hm[idx])
+        y, x = float(idx[0]), float(idx[1])
+        if offsets is not None:
+            y += float(offsets[idx[0], idx[1], k])
+            x += float(offsets[idx[0], idx[1], K + k])
+        out.append({
+            "keypoint": k,
+            "y": y / max(H - 1, 1),
+            "x": x / max(W - 1, 1),
+            "score": score,
+            "visible": score >= threshold,
+        })
+    return out
+
+
+def draw_pose(width: int, height: int, keypoints) -> np.ndarray:
+    img = np.zeros((height, width, 4), np.uint8)
+    pts = {}
+    for kp in keypoints:
+        if not kp["visible"]:
+            continue
+        xi = int(np.clip(kp["x"] * (width - 1), 0, width - 1))
+        yi = int(np.clip(kp["y"] * (height - 1), 0, height - 1))
+        pts[kp["keypoint"]] = (yi, xi)
+        img[max(0, yi - 1):yi + 2, max(0, xi - 1):xi + 2] = \
+            [255, 0, 0, 255]
+    for a, b in EDGES:
+        if a in pts and b in pts:
+            (y1, x1), (y2, x2) = pts[a], pts[b]
+            n = max(abs(y2 - y1), abs(x2 - x1), 1)
+            ys = np.linspace(y1, y2, n + 1).astype(int)
+            xs = np.linspace(x1, x2, n + 1).astype(int)
+            img[ys, xs] = [0, 255, 0, 255]
+    return img
+
+
+@subplugin(DECODER, "pose_estimation")
+class PoseEstimation:
+    def _opts(self, options):
+        size = (options.get("option1") or "257:257").split(":")
+        return dict(width=int(size[0]), height=int(size[1]),
+                    meta_only=(options.get("option2") == "meta"),
+                    threshold=float(options.get("option3") or 0.3))
+
+    def out_caps(self, config, options) -> Caps:
+        o = self._opts(options)
+        if o["meta_only"]:
+            return Caps("other/tensors", {"format": "flexible"})
+        return Caps("video/x-raw", {"format": "RGBA", "width": o["width"],
+                                    "height": o["height"]})
+
+    def decode(self, buf: TensorBuffer, config, options) -> TensorBuffer:
+        o = self._opts(options)
+        heat = np.asarray(buf[0], np.float32)
+        if heat.ndim == 4:
+            heat = heat[0]
+        offs = None
+        if buf.num_tensors > 1:
+            offs = np.asarray(buf[1], np.float32)
+            if offs.ndim == 4:
+                offs = offs[0]
+        kps = decode_pose(heat, offs, o["threshold"])
+        meta = {**buf.meta, "keypoints": kps}
+        if o["meta_only"]:
+            flat = np.asarray([[kp["y"], kp["x"], kp["score"]] for kp in kps],
+                              np.float32)
+            return buf.with_tensors([flat]).replace(meta=meta)
+        return buf.with_tensors(
+            [draw_pose(o["width"], o["height"], kps)]
+        ).replace(meta=meta)

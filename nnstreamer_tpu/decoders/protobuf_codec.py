@@ -1,0 +1,102 @@
+"""protobuf decoder — tensors → serialized protobuf messages.
+
+Reference: ``ext/nnstreamer/tensor_decoder/tensordec-protobuf.c`` (117 LoC)
+with the ``Tensors`` message from ``nnstreamer.proto``:43-49. We build the
+equivalent message dynamically with ``google.protobuf`` (descriptor_pb2) so
+no generated code is shipped; the schema mirrors the reference's:
+
+    message Tensor { string name=1; int32 type=2; repeated uint32
+                     dimension=3; bytes data=4; }
+    message Tensors { uint32 num_tensor=1; repeated Tensor tensor=2; }
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from nnstreamer_tpu.pipeline.caps import Caps
+from nnstreamer_tpu.registry import DECODER, subplugin
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
+from nnstreamer_tpu.tensors.types import TensorInfo, TensorType
+
+_TYPE_ORDER = list(TensorType)
+_lock = threading.Lock()
+_msgs = None
+
+
+def _get_messages():
+    """Build Tensor/Tensors message classes once (dynamic descriptor)."""
+    global _msgs
+    with _lock:
+        if _msgs is not None:
+            return _msgs
+        from google.protobuf import descriptor_pb2, descriptor_pool, \
+            message_factory
+
+        fdp = descriptor_pb2.FileDescriptorProto()
+        fdp.name = "nnstreamer_tpu_tensors.proto"
+        fdp.package = "nnstreamer_tpu"
+        t = fdp.message_type.add()
+        t.name = "Tensor"
+        f = t.field.add(); f.name = "name"; f.number = 1; \
+            f.type = f.TYPE_STRING; f.label = f.LABEL_OPTIONAL
+        f = t.field.add(); f.name = "type"; f.number = 2; \
+            f.type = f.TYPE_INT32; f.label = f.LABEL_OPTIONAL
+        f = t.field.add(); f.name = "dimension"; f.number = 3; \
+            f.type = f.TYPE_UINT32; f.label = f.LABEL_REPEATED
+        f = t.field.add(); f.name = "data"; f.number = 4; \
+            f.type = f.TYPE_BYTES; f.label = f.LABEL_OPTIONAL
+        ts = fdp.message_type.add()
+        ts.name = "Tensors"
+        f = ts.field.add(); f.name = "num_tensor"; f.number = 1; \
+            f.type = f.TYPE_UINT32; f.label = f.LABEL_OPTIONAL
+        f = ts.field.add(); f.name = "tensor"; f.number = 2; \
+            f.type = f.TYPE_MESSAGE; f.label = f.LABEL_REPEATED; \
+            f.type_name = ".nnstreamer_tpu.Tensor"
+        pool = descriptor_pool.DescriptorPool()
+        fd = pool.Add(fdp)
+        tensor_cls = message_factory.GetMessageClass(
+            fd.message_types_by_name["Tensor"])
+        tensors_cls = message_factory.GetMessageClass(
+            fd.message_types_by_name["Tensors"])
+        _msgs = (tensor_cls, tensors_cls)
+        return _msgs
+
+
+def encode_protobuf(buf: TensorBuffer) -> bytes:
+    Tensor, Tensors = _get_messages()
+    msg = Tensors()
+    host = buf.to_host()
+    msg.num_tensor = host.num_tensors
+    for t in host.tensors:
+        info = TensorInfo.from_array(t)
+        tm = msg.tensor.add()
+        tm.type = _TYPE_ORDER.index(info.type)
+        tm.dimension.extend(info.dim)
+        tm.data = np.ascontiguousarray(t).tobytes()
+    return msg.SerializeToString()
+
+
+def decode_protobuf(blob: bytes) -> TensorBuffer:
+    Tensor, Tensors = _get_messages()
+    msg = Tensors()
+    msg.ParseFromString(bytes(blob))
+    tensors = []
+    for tm in msg.tensor:
+        ttype = _TYPE_ORDER[tm.type]
+        shape = tuple(reversed(list(tm.dimension)))
+        tensors.append(np.frombuffer(tm.data,
+                                     ttype.np_dtype).reshape(shape))
+    return TensorBuffer(tensors)
+
+
+@subplugin(DECODER, "protobuf")
+class ProtobufDecoder:
+    def out_caps(self, config, options) -> Caps:
+        return Caps("application/octet-stream", {"encoding": "protobuf"})
+
+    def decode(self, buf: TensorBuffer, config, options) -> TensorBuffer:
+        blob = encode_protobuf(buf)
+        return buf.with_tensors([np.frombuffer(blob, np.uint8)])

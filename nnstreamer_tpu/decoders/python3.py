@@ -1,0 +1,59 @@
+"""python3 decoder — user-script decoders.
+
+Reference: ``ext/nnstreamer/tensor_decoder/tensordec-python3.cc`` (405 LoC):
+loads a user script whose class implements getOutCaps/decode. Here the
+script (option1) defines::
+
+    class Decoder:
+        def out_caps(self, config, options): ...   # optional
+        def decode(self, buf, config, options): ...
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+from nnstreamer_tpu.pipeline.caps import Caps
+from nnstreamer_tpu.registry import DECODER, subplugin
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
+
+
+@subplugin(DECODER, "python3")
+class Python3Decoder:
+    def __init__(self):
+        self._obj = None
+        self._path = None
+
+    def _load(self, options):
+        path = options.get("option1")
+        if not path:
+            raise ValueError("python3 decoder: option1=<script.py> required")
+        if self._obj is None or path != self._path:
+            if not os.path.isfile(path):
+                raise FileNotFoundError(f"python3 decoder: {path!r}")
+            spec = importlib.util.spec_from_file_location(
+                f"nnstreamer_tpu_pydec_{os.path.basename(path).replace('.', '_')}",
+                path,
+            )
+            mod = importlib.util.module_from_spec(spec)
+            sys.modules[spec.name] = mod
+            spec.loader.exec_module(mod)
+            cls = getattr(mod, "Decoder", None)
+            if cls is None:
+                raise ValueError(
+                    f"python3 decoder: {path!r} must define class Decoder"
+                )
+            self._obj = cls()
+            self._path = path
+        return self._obj
+
+    def out_caps(self, config, options) -> Caps:
+        obj = self._load(options)
+        if hasattr(obj, "out_caps"):
+            return obj.out_caps(config, options)
+        return Caps("other/tensors", {"format": "flexible"})
+
+    def decode(self, buf: TensorBuffer, config, options) -> TensorBuffer:
+        return self._load(options).decode(buf, config, options)

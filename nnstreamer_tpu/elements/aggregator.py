@@ -67,20 +67,25 @@ class TensorAggregator(Element):
         ret = None
         while len(self._window) >= fout:
             chunk = self._window[:fout]
-            if is_device_array(chunk[0]):
-                import jax.numpy as jnp
+            if self.get_property("concat"):
+                if is_device_array(chunk[0]):
+                    import jax.numpy as jnp
 
-                out = jnp.concatenate(chunk, axis=axis)
+                    outs = [jnp.concatenate(chunk, axis=axis)]
+                else:
+                    outs = [np.concatenate(chunk, axis=axis)]
             else:
-                out = np.concatenate(chunk, axis=axis)
+                # concat=false: collected frames stay separate tensors
+                # (reference tensor_aggregator concat property)
+                outs = list(chunk)
             if self.srcpad.caps is None:
                 from nnstreamer_tpu.tensors.types import TensorsConfig
 
                 self.srcpad.set_caps(
-                    TensorsConfig.from_arrays([out]).to_caps()
+                    TensorsConfig.from_arrays(outs).to_caps()
                 )
             ret = self.srcpad.push(
-                TensorBuffer([out], pts=self._pts)
+                TensorBuffer(outs, pts=self._pts)
             )
             self._window = self._window[flush:]
             self._pts = buf.pts

@@ -92,8 +92,20 @@ class TensorFilter(Element):
         self.add_src_pad("src")
         self.fw: Optional[FilterFramework] = None
         self._in_model_info: Optional[TensorsInfo] = None
+        self._in_full_info: Optional[TensorsInfo] = None
         self._out_model_info: Optional[TensorsInfo] = None
         self._last_invoke_t = 0.0
+        self._comb_cache: dict = {}
+
+    def _combination(self, key: str):
+        """Parsed input/output combination, cached off the hot path."""
+        if key not in self._comb_cache:
+            self._comb_cache[key] = _parse_combination(self.get_property(key))
+        return self._comb_cache[key]
+
+    def property_changed(self, key):
+        if key in ("input_combination", "output_combination"):
+            self._comb_cache.pop(key, None)
 
     # -- backend lifecycle ---------------------------------------------------
     def _open_fw(self) -> FilterFramework:
@@ -151,14 +163,23 @@ class TensorFilter(Element):
         cfg = TensorsConfig.from_caps(caps)
         fw = self._open_fw()
         in_info, out_info = fw.get_model_info()
-        if cfg.info.is_valid() and in_info is not None and \
-                not cfg.info.is_equal(in_info):
-            raise ValueError(
-                f"{self.name}: incoming tensors {cfg.info!r} do not match "
-                f"model input {in_info!r}"
+        # the model sees the combination-selected subset, so compare that
+        in_comb = self._combination("input_combination")
+        model_in_cfg_info = cfg.info
+        if in_comb is not None and cfg.info.is_valid():
+            model_in_cfg_info = TensorsInfo(
+                [cfg.info[i] for _, i in in_comb]
             )
-        self._in_model_info = in_info or (cfg.info if cfg.info.is_valid()
-                                          else None)
+        if model_in_cfg_info.is_valid() and in_info is not None and \
+                not model_in_cfg_info.is_equal(in_info):
+            raise ValueError(
+                f"{self.name}: incoming tensors {model_in_cfg_info!r} do "
+                f"not match model input {in_info!r}"
+            )
+        self._in_model_info = in_info or (
+            model_in_cfg_info if model_in_cfg_info.is_valid() else None
+        )
+        self._in_full_info = cfg.info if cfg.info.is_valid() else None
         if out_info is None:
             if self._in_model_info is None:
                 raise ValueError(
@@ -171,10 +192,10 @@ class TensorFilter(Element):
         return TensorsConfig(info=final, rate=cfg.rate).to_caps()
 
     def _combined_out_info(self, out_info: TensorsInfo) -> TensorsInfo:
-        comb = _parse_combination(self.get_property("output_combination"))
+        comb = self._combination("output_combination")
         if comb is None:
             return out_info
-        in_info = self._in_model_info
+        in_info = self._in_full_info or self._in_model_info
         infos = []
         for kind, idx in comb:
             infos.append(out_info[idx] if kind == "o" else in_info[idx])
@@ -192,7 +213,7 @@ class TensorFilter(Element):
             self._last_invoke_t = now
         fw = self.fw or self._open_fw()
 
-        in_comb = _parse_combination(self.get_property("input_combination"))
+        in_comb = self._combination("input_combination")
         if in_comb is not None:
             model_inputs = [buf.tensors[i] for _, i in in_comb]
         else:
@@ -204,7 +225,7 @@ class TensorFilter(Element):
 
         outputs = fw.invoke(model_inputs)
 
-        out_comb = _parse_combination(self.get_property("output_combination"))
+        out_comb = self._combination("output_combination")
         if out_comb is not None:
             final = [outputs[i] if k == "o" else buf.tensors[i]
                      for k, i in out_comb]

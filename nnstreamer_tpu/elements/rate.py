@@ -26,7 +26,7 @@ class TensorRate(Element):
         self.add_sink_pad("sink")
         self.add_src_pad("src")
         self._in_rate: Optional[Fraction] = None
-        self._next_ts = 0.0
+        self._next_ts: Optional[float] = None  # set from first buffer's pts
         self.dropped = 0
         self.duplicated = 0
         self.out_count = 0
@@ -52,6 +52,9 @@ class TensorRate(Element):
         if out_rate is None or out_rate.num <= 0 or buf.pts is None:
             return self.srcpad.push(buf)
         period_ns = 1e9 * out_rate.den / out_rate.num
+        if self._next_ts is None:
+            self._next_ts = float(buf.pts)  # clock starts at the stream's
+            # first timestamp (streams may carry wall-clock pts)
         ret = None
         pushed = False
         # emit one output per elapsed output period; duplicate if input is

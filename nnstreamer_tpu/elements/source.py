@@ -226,6 +226,7 @@ class MultiFileSrc(SourceElement):
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self.i = None
+        self._listing = None  # cached sorted glob listing
 
     def negotiate(self):
         caps = self.get_property("caps")
@@ -239,8 +240,9 @@ class MultiFileSrc(SourceElement):
         loc = self.get_property("location")
         if "%" in loc:
             return loc % i
-        files = sorted(glob.glob(loc))
-        return files[i] if i < len(files) else None
+        if self._listing is None:
+            self._listing = sorted(glob.glob(loc))  # scan once per run
+        return self._listing[i] if i < len(self._listing) else None
 
     def create(self):
         if self.i is None:
@@ -259,6 +261,7 @@ class MultiFileSrc(SourceElement):
 
     def stop(self):
         self.i = None
+        self._listing = None
         super().stop()
 
 
@@ -282,11 +285,23 @@ class AppSrc(SourceElement):
     def set_caps(self, caps: Caps):
         self.set_property("caps", caps)
 
-    def push(self, buf_or_arrays, pts: Optional[int] = None) -> None:
-        """Push a TensorBuffer (or list of arrays) into the stream."""
+    def push(self, buf_or_arrays, pts: Optional[int] = None) -> bool:
+        """Push a TensorBuffer (or list of arrays) into the stream.
+
+        With ``block=false`` (gst appsrc semantics) a full queue drops the
+        buffer and returns False instead of blocking the caller."""
+        import queue as _q
+
         if not isinstance(buf_or_arrays, TensorBuffer):
             buf_or_arrays = TensorBuffer.from_arrays(buf_or_arrays, pts=pts)
-        self._q.put(buf_or_arrays)
+        if self.get_property("block"):
+            self._q.put(buf_or_arrays)
+            return True
+        try:
+            self._q.put_nowait(buf_or_arrays)
+            return True
+        except _q.Full:
+            return False
 
     def end_of_stream(self) -> None:
         self._q.put(self._EOS)

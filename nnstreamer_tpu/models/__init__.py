@@ -1,0 +1,20 @@
+"""Model zoo — TPU-native networks for the benchmark configs.
+
+The reference ships no models (they are external .tflite files under
+tests/test_models); its benchmark pipelines use MobileNetV2 classification,
+SSD-MobileNet detection, PoseNet, and LSTM recurrence (BASELINE.md). This
+package provides those families natively in flax/JAX so the jax filter
+backend serves them on TPU, plus a decoder-only transformer exercising the
+long-context / multi-chip parallel paths.
+
+Each factory returns ``(apply_fn, params, in_info, out_info)`` where
+``apply_fn(params, *inputs)`` is jittable — exactly what
+``filters.jax_backend`` consumes (also via ``custom=module:<factory>`` for
+.msgpack checkpoints).
+"""
+
+from nnstreamer_tpu.models.mobilenet_v2 import mobilenet_v2  # noqa: F401
+from nnstreamer_tpu.models.ssd_mobilenet import ssd_mobilenet  # noqa: F401
+from nnstreamer_tpu.models.posenet import posenet  # noqa: F401
+from nnstreamer_tpu.models.lstm import lstm_cell  # noqa: F401
+from nnstreamer_tpu.models.transformer import transformer_lm  # noqa: F401

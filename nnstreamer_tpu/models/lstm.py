@@ -1,0 +1,57 @@
+"""LSTM cell — the recurrent model for tensor_repo loops (benchmark
+config #5; reference tests/nnstreamer_repo_lstm with a fake LSTM custom
+filter).
+
+The step function is shaped for the repo-loop pipeline: one invoke per
+frame, hidden/cell state flowing through repo slots as device-resident
+arrays (state never leaves HBM between iterations — SURVEY §5's
+"device-resident state" requirement).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from nnstreamer_tpu.tensors.types import TensorsInfo
+
+
+class LSTMCellModel(nn.Module):
+    hidden: int = 128
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, h, c):
+        gates = nn.Dense(4 * self.hidden, dtype=self.dtype)(
+            jnp.concatenate([x, h], axis=-1)
+        )
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c2 = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h2 = jax.nn.sigmoid(o) * jnp.tanh(c2)
+        return h2.astype(jnp.float32), h2.astype(jnp.float32), \
+            c2.astype(jnp.float32)
+
+
+def lstm_cell(input_dim: int = 128, hidden: int = 128, batch: int = 1,
+              dtype=jnp.float32, seed: int = 0
+              ) -> Tuple[Callable, Any, TensorsInfo, TensorsInfo]:
+    """Factory: apply_fn(params, x, h, c) -> (y, h', c')."""
+    model = LSTMCellModel(hidden=hidden, dtype=dtype)
+    rng = jax.random.PRNGKey(seed)
+    zeros = (jnp.zeros((batch, input_dim)), jnp.zeros((batch, hidden)),
+             jnp.zeros((batch, hidden)))
+    variables = model.init(rng, *zeros)
+
+    def apply_fn(params, x, h, c):
+        return model.apply(params, x, h, c)
+
+    in_info = TensorsInfo.from_str(
+        f"{input_dim}:{batch},{hidden}:{batch},{hidden}:{batch}",
+        "float32,float32,float32")
+    out_info = TensorsInfo.from_str(
+        f"{hidden}:{batch},{hidden}:{batch},{hidden}:{batch}",
+        "float32,float32,float32")
+    return apply_fn, variables, in_info, out_info

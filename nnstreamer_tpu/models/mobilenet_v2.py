@@ -1,0 +1,114 @@
+"""MobileNetV2 — the north-star classification model (benchmark config #1).
+
+The reference benches ``mobilenet_v2_1.0_224_quant.tflite`` through its
+tflite subplugin; here the same architecture (Sandler et al. 2018:
+inverted residuals, linear bottlenecks) is native flax, with TPU choices:
+
+- NHWC layout and channel counts padded to multiples of 8 so conv lowering
+  tiles cleanly onto the MXU;
+- optional bfloat16 activations/weights (``dtype=jnp.bfloat16``) — fp32
+  accumulation is XLA's default for bf16 convs on TPU;
+- no dynamic shapes anywhere; one jit specialization per batch size.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nnstreamer_tpu.tensors.types import TensorsInfo
+
+
+def _make_divisible(v: float, divisor: int = 8) -> int:
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class InvertedResidual(nn.Module):
+    out_ch: int
+    stride: int
+    expand: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        in_ch = x.shape[-1]
+        hidden = in_ch * self.expand
+        identity = x
+        if self.expand != 1:
+            x = nn.Conv(hidden, (1, 1), use_bias=False, dtype=self.dtype)(x)
+            x = nn.BatchNorm(use_running_average=True, dtype=self.dtype)(x)
+            x = nn.relu6(x)
+        x = nn.Conv(hidden, (3, 3), strides=(self.stride, self.stride),
+                    padding="SAME", feature_group_count=hidden,
+                    use_bias=False, dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=True, dtype=self.dtype)(x)
+        x = nn.relu6(x)
+        x = nn.Conv(self.out_ch, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=True, dtype=self.dtype)(x)
+        if self.stride == 1 and in_ch == self.out_ch:
+            x = x + identity
+        return x
+
+
+class MobileNetV2(nn.Module):
+    num_classes: int = 1001
+    width: float = 1.0
+    dtype: Any = jnp.float32
+
+    # (expand, out_ch, repeats, stride) — the paper's table 2
+    CFG = [
+        (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+        (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1),
+    ]
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        ch = _make_divisible(32 * self.width)
+        x = nn.Conv(ch, (3, 3), strides=(2, 2), padding="SAME",
+                    use_bias=False, dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=True, dtype=self.dtype)(x)
+        x = nn.relu6(x)
+        for expand, out_ch, repeats, stride in self.CFG:
+            out_ch = _make_divisible(out_ch * self.width)
+            for i in range(repeats):
+                x = InvertedResidual(
+                    out_ch, stride if i == 0 else 1, expand, self.dtype
+                )(x)
+        last = _make_divisible(1280 * max(self.width, 1.0))
+        x = nn.Conv(last, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=True, dtype=self.dtype)(x)
+        x = nn.relu6(x)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+def mobilenet_v2(num_classes: int = 1001, width: float = 1.0,
+                 image_size: int = 224, batch: int = 1,
+                 dtype=jnp.bfloat16, seed: int = 0
+                 ) -> Tuple[Callable, Any, TensorsInfo, TensorsInfo]:
+    """Factory: (apply_fn, params, in_info, out_info).
+
+    Input: float32 NHWC in [0,1]·any-normalization (the pipeline's
+    tensor_transform owns preprocessing, like the reference pipelines do).
+    """
+    model = MobileNetV2(num_classes=num_classes, width=width, dtype=dtype)
+    rng = jax.random.PRNGKey(seed)
+    dummy = jnp.zeros((batch, image_size, image_size, 3), jnp.float32)
+    variables = model.init(rng, dummy)
+
+    def apply_fn(params, x):
+        return model.apply(params, x)
+
+    in_info = TensorsInfo.from_str(
+        f"3:{image_size}:{image_size}:{batch}", "float32")
+    out_info = TensorsInfo.from_str(f"{num_classes}:{batch}", "float32")
+    return apply_fn, variables, in_info, out_info

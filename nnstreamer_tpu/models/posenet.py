@@ -1,0 +1,63 @@
+"""PoseNet — keypoint heatmap model (benchmark config #3).
+
+The reference's pose_estimation decoder (tensordec-pose.c, 824 LoC)
+consumes a PoseNet-style output: heatmaps [keypoints, W/stride, H/stride]
+plus short-range offsets. This module provides that contract natively: a
+small conv backbone producing 17-keypoint heatmaps + 2·17 offsets.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from nnstreamer_tpu.models.mobilenet_v2 import InvertedResidual
+from nnstreamer_tpu.tensors.types import TensorsInfo
+
+NUM_KEYPOINTS = 17
+
+
+class PoseNet(nn.Module):
+    num_keypoints: int = NUM_KEYPOINTS
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (3, 3), strides=(2, 2), padding="SAME",
+                    use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu6(nn.BatchNorm(use_running_average=True,
+                                  dtype=self.dtype)(x))
+        for expand, out_ch, repeats, stride in [
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 2, 2), (6, 64, 2, 2),
+        ]:
+            for i in range(repeats):
+                x = InvertedResidual(out_ch, stride if i == 0 else 1,
+                                     expand, self.dtype)(x)
+        heat = nn.Conv(self.num_keypoints, (1, 1), dtype=self.dtype)(x)
+        offs = nn.Conv(self.num_keypoints * 2, (1, 1), dtype=self.dtype)(x)
+        return (jax.nn.sigmoid(heat).astype(jnp.float32),
+                offs.astype(jnp.float32))
+
+
+def posenet(image_size: int = 257, batch: int = 1, dtype=jnp.bfloat16,
+            seed: int = 0) -> Tuple[Callable, Any, TensorsInfo, TensorsInfo]:
+    model = PoseNet(dtype=dtype)
+    rng = jax.random.PRNGKey(seed)
+    dummy = jnp.zeros((batch, image_size, image_size, 3), jnp.float32)
+    variables = model.init(rng, dummy)
+    h, o = jax.eval_shape(lambda p, x: model.apply(p, x), variables, dummy)
+
+    def apply_fn(params, x):
+        return model.apply(params, x)
+
+    in_info = TensorsInfo.from_str(
+        f"3:{image_size}:{image_size}:{batch}", "float32")
+    out_info = TensorsInfo.from_str(
+        f"{h.shape[3]}:{h.shape[2]}:{h.shape[1]}:{batch},"
+        f"{o.shape[3]}:{o.shape[2]}:{o.shape[1]}:{batch}",
+        "float32,float32")
+    return apply_fn, variables, in_info, out_info

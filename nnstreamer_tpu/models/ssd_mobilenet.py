@@ -1,0 +1,113 @@
+"""SSD-MobileNet detector (benchmark config #2).
+
+The reference decodes ``ssd_mobilenet_v2_coco.tflite`` output with its
+bounding_boxes decoder (tensordec-boundingbox.c mode=mobilenet-ssd):
+two tensors — box encodings [4, anchors, 1] and class scores
+[classes, anchors, 1] — postprocessed against an anchor grid. This module
+provides the same output contract natively: a MobileNetV2 backbone with
+SSD heads over feature maps, plus the anchor grid generator the decoder
+needs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nnstreamer_tpu.models.mobilenet_v2 import InvertedResidual, _make_divisible
+from nnstreamer_tpu.tensors.types import TensorsInfo
+
+
+class SSDMobileNet(nn.Module):
+    num_classes: int = 91
+    num_anchors_per_cell: int = 6
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        # reduced MobileNetV2 backbone, keeping two feature scales
+        x = nn.Conv(32, (3, 3), strides=(2, 2), padding="SAME",
+                    use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu6(nn.BatchNorm(use_running_average=True,
+                                  dtype=self.dtype)(x))
+        feats = []
+        for expand, out_ch, repeats, stride in [
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 3, 2),
+            (6, 96, 2, 1),
+        ]:
+            for i in range(repeats):
+                x = InvertedResidual(out_ch, stride if i == 0 else 1,
+                                     expand, self.dtype)(x)
+            if out_ch in (96,):
+                feats.append(x)  # stride-16 map
+        for expand, out_ch, repeats, stride in [(6, 160, 2, 2), (6, 320, 1, 1)]:
+            for i in range(repeats):
+                x = InvertedResidual(out_ch, stride if i == 0 else 1,
+                                     expand, self.dtype)(x)
+        feats.append(x)  # stride-32 map
+
+        boxes, scores = [], []
+        k = self.num_anchors_per_cell
+        for f in feats:
+            b = nn.Conv(k * 4, (3, 3), padding="SAME", dtype=self.dtype)(f)
+            s = nn.Conv(k * self.num_classes, (3, 3), padding="SAME",
+                        dtype=self.dtype)(f)
+            n = f.shape[0]
+            boxes.append(b.reshape(n, -1, 4))
+            scores.append(s.reshape(n, -1, self.num_classes))
+        return (jnp.concatenate(boxes, axis=1).astype(jnp.float32),
+                jnp.concatenate(scores, axis=1).astype(jnp.float32))
+
+
+def anchor_grid(image_size: int = 300, strides=(16, 32),
+                num_anchors_per_cell: int = 6) -> np.ndarray:
+    """Anchor centers/sizes [anchors, 4] as (cy, cx, h, w) in [0,1] —
+    consumed by the bounding_boxes decoder (the reference reads its anchor
+    box-priors from a file; ours are generated to match the model)."""
+    anchors = []
+    scales = np.linspace(0.2, 0.9, len(strides) * num_anchors_per_cell)
+    si = 0
+    for stride in strides:
+        cells = image_size // stride
+        for a in range(num_anchors_per_cell):
+            s = scales[si]
+            si += 1
+            ratio = [1.0, 2.0, 0.5, 3.0, 1.0 / 3.0, 1.0][a % 6]
+            h, w = s / np.sqrt(ratio), s * np.sqrt(ratio)
+            ys, xs = np.meshgrid(
+                (np.arange(cells) + 0.5) / cells,
+                (np.arange(cells) + 0.5) / cells, indexing="ij",
+            )
+            grid = np.stack(
+                [ys.ravel(), xs.ravel(),
+                 np.full(cells * cells, h), np.full(cells * cells, w)],
+                axis=1,
+            )
+            anchors.append(grid)
+    return np.concatenate(anchors, axis=0).astype(np.float32)
+
+
+def ssd_mobilenet(num_classes: int = 91, image_size: int = 300,
+                  batch: int = 1, dtype=jnp.bfloat16, seed: int = 0
+                  ) -> Tuple[Callable, Any, TensorsInfo, TensorsInfo]:
+    model = SSDMobileNet(num_classes=num_classes, dtype=dtype)
+    rng = jax.random.PRNGKey(seed)
+    dummy = jnp.zeros((batch, image_size, image_size, 3), jnp.float32)
+    variables = model.init(rng, dummy)
+    b, s = jax.eval_shape(lambda p, x: model.apply(p, x), variables, dummy)
+    num_anchors = b.shape[1]
+
+    def apply_fn(params, x):
+        return model.apply(params, x)
+
+    in_info = TensorsInfo.from_str(
+        f"3:{image_size}:{image_size}:{batch}", "float32")
+    out_info = TensorsInfo.from_str(
+        f"4:{num_anchors}:{batch},{num_classes}:{num_anchors}:{batch}",
+        "float32,float32")
+    return apply_fn, variables, in_info, out_info

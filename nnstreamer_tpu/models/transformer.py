@@ -1,0 +1,184 @@
+"""Decoder-only transformer LM — the long-context / multi-chip flagship.
+
+New capability beyond the reference (which has no attention/sequence models
+in-framework, SURVEY §5): a GPT-style LM whose parameters are laid out for
+SPMD sharding (see ``parallel.sharded`` for the axis rules) and whose
+attention can run as **ring attention** over a sequence-parallel mesh axis
+(``parallel.ring``). TPU-first choices:
+
+- layers are **stacked** (one leading L axis per param) and applied with
+  ``lax.scan`` — one compiled layer body instead of L inlined copies;
+- bfloat16 activations, fp32 layernorm/softmax accumulations;
+- rotary position embeddings (no learned positional table to shard);
+- optional top-1 MoE FFN whose expert dim maps to the ``ep`` mesh axis.
+
+Params are a plain pytree dict, so sharding rules are transparent
+name-based PartitionSpecs rather than framework metadata.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from nnstreamer_tpu.tensors.types import TensorsInfo
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 4
+    d_ff: int = 2048
+    max_seq: int = 2048
+    dtype: Any = jnp.bfloat16
+    num_experts: int = 0  # 0 → dense FFN; >0 → top-1 MoE
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def init_params(cfg: TransformerConfig, seed: int = 0) -> Dict[str, Any]:
+    rng = np.random.default_rng(seed)
+
+    def norm(*shape, scale=None):
+        scale = scale or (1.0 / np.sqrt(shape[-2] if len(shape) > 1 else 1))
+        return jnp.asarray(
+            rng.standard_normal(shape).astype(np.float32) * 0.02
+        )
+
+    L, D, H, Dh, F = (cfg.n_layers, cfg.d_model, cfg.n_heads, cfg.head_dim,
+                      cfg.d_ff)
+    p = {
+        "embed": norm(cfg.vocab, D),
+        "ln1": jnp.ones((L, D), jnp.float32),
+        "qkv": norm(L, D, 3, H, Dh),
+        "proj": norm(L, H, Dh, D),
+        "ln2": jnp.ones((L, D), jnp.float32),
+        "ln_f": jnp.ones((D,), jnp.float32),
+    }
+    if cfg.num_experts:
+        p["router"] = norm(L, D, cfg.num_experts)
+        p["w_in"] = norm(L, cfg.num_experts, D, F)
+        p["w_out"] = norm(L, cfg.num_experts, F, D)
+    else:
+        p["w_in"] = norm(L, D, F)
+        p["w_out"] = norm(L, F, D)
+    return p
+
+
+def _rmsnorm(x, scale):
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + 1e-6) * scale).astype(x.dtype)
+
+
+def _rope(x, positions):
+    """Rotary embeddings; x [b, s, h, d], positions [b, s]."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = jnp.exp(-np.log(10000.0) * jnp.arange(half) / half)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [b,s,half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    ).astype(x.dtype)
+
+
+def _dense_ffn(x, w_in, w_out, dtype):
+    h = jnp.einsum("bsd,df->bsf", x, w_in.astype(dtype))
+    h = jax.nn.gelu(h)
+    return jnp.einsum("bsf,fd->bsd", h, w_out.astype(dtype))
+
+
+def _moe_ffn(x, router, w_in, w_out, dtype):
+    """Top-1 routed MoE: expert axis shards over mesh axis ``ep`` (the
+    one-hot dispatch einsum lets GSPMD all-to-all tokens to experts)."""
+    logits = jnp.einsum("bsd,de->bse", x.astype(jnp.float32),
+                        router.astype(jnp.float32))
+    gate = jax.nn.softmax(logits, axis=-1)
+    top = jnp.argmax(gate, axis=-1)                      # [b,s]
+    onehot = jax.nn.one_hot(top, router.shape[-1], dtype=dtype)  # [b,s,e]
+    weight = jnp.take_along_axis(gate, top[..., None], -1)[..., 0].astype(
+        dtype)                                                   # [b,s]
+    h = jnp.einsum("bsd,bse,edf->bsef", x, onehot, w_in.astype(dtype))
+    h = jax.nn.gelu(h)
+    out = jnp.einsum("bsef,efd->bsed", h, w_out.astype(dtype))
+    return jnp.sum(out * onehot[..., None], axis=2) * weight[..., None]
+
+
+def build_forward(cfg: TransformerConfig,
+                  attention_fn: Optional[Callable] = None) -> Callable:
+    """Returns apply_fn(params, tokens[int32 b,s]) -> logits[b,s,vocab].
+
+    ``attention_fn(q, k, v)`` defaults to single-device causal attention;
+    pass a ring-attention closure (inside shard_map) for sequence
+    parallelism. ``positions`` are offset by the sp shard index when the
+    attention_fn provides ``.position_offset`` (set by the sharded step
+    builder) so rotary phases stay globally correct.
+    """
+    from nnstreamer_tpu.parallel.ring import attention_reference
+
+    attn = attention_fn or attention_reference
+    dtype = cfg.dtype
+
+    def layer_body(x_and_pos, lp):
+        x, positions = x_and_pos
+        h = _rmsnorm(x, lp["ln1"])
+        qkv = jnp.einsum("bsd,dthc->btshc", h, lp["qkv"].astype(dtype))
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]       # [b,s,h,dh]
+        q, k = _rope(q, positions), _rope(k, positions)
+        a = attn(q, k, v)                                # [b,s,h,dh]
+        x = x + jnp.einsum("bshc,hcd->bsd", a, lp["proj"].astype(dtype))
+        h2 = _rmsnorm(x, lp["ln2"])
+        if cfg.num_experts:
+            x = x + _moe_ffn(h2, lp["router"], lp["w_in"], lp["w_out"], dtype)
+        else:
+            x = x + _dense_ffn(h2, lp["w_in"], lp["w_out"], dtype)
+        return (x, positions), None
+
+    def apply_fn(params, tokens, position_offset=0):
+        b, s = tokens.shape
+        positions = position_offset + jnp.arange(s)[None, :].astype(
+            jnp.int32
+        ) * jnp.ones((b, 1), jnp.int32)
+        x = params["embed"].astype(dtype)[tokens]
+        layer_params = {k: v for k, v in params.items()
+                        if k not in ("embed", "ln_f")}
+        (x, _), _ = lax.scan(layer_body, (x, positions), layer_params)
+        x = _rmsnorm(x, params["ln_f"])
+        logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
+                            params["embed"])
+        return logits
+
+    return apply_fn
+
+
+def transformer_lm(vocab: int = 32000, d_model: int = 512, n_heads: int = 8,
+                   n_layers: int = 4, d_ff: int = 2048, seq: int = 256,
+                   batch: int = 1, dtype=jnp.bfloat16, num_experts: int = 0,
+                   seed: int = 0
+                   ) -> Tuple[Callable, Any, TensorsInfo, TensorsInfo]:
+    """Filter-backend factory (single-device attention path)."""
+    cfg = TransformerConfig(vocab=vocab, d_model=d_model, n_heads=n_heads,
+                            n_layers=n_layers, d_ff=d_ff, dtype=dtype,
+                            num_experts=num_experts)
+    params = init_params(cfg, seed)
+    fwd = build_forward(cfg)
+
+    def apply_fn(params, tokens):
+        return fwd(params, tokens)
+
+    in_info = TensorsInfo.from_str(f"{seq}:{batch}", "int32")
+    out_info = TensorsInfo.from_str(f"{vocab}:{seq}:{batch}", "float32")
+    return apply_fn, params, in_info, out_info

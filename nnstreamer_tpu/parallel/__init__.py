@@ -1,0 +1,24 @@
+"""Parallel execution: device meshes, sharded invokes, sequence/context
+parallelism, and collectives.
+
+The reference's parallelism inventory (SURVEY §2.4) is dataflow-level:
+stage pipelining, tee/mux fan-out, aggregator batching, query offload,
+repo recurrence. Those all exist here as elements. This package adds what
+the TPU makes possible *beyond* the reference — model-level SPMD:
+
+- ``mesh``      — mesh construction + named shardings (dp/tp/sp/ep axes);
+- ``ring``      — ring attention (sequence/context parallelism) via
+  ``shard_map`` + ``lax.ppermute`` over the ICI ring;
+- ``sharded``   — sharding rules for model params + the sharded train/
+  infer step builders used by the transformer and ``dryrun_multichip``.
+
+All of it is pure jax.sharding/GSPMD: we annotate, XLA inserts the
+collectives (psum/all-gather/reduce-scatter) over ICI.
+"""
+
+from nnstreamer_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    batch_sharding,
+    BatchSharding,
+)
+from nnstreamer_tpu.parallel.ring import ring_attention  # noqa: F401

@@ -1,0 +1,75 @@
+"""Device-mesh construction and named shardings.
+
+The scaling recipe (jax-ml.github.io/scaling-book): pick a mesh whose axes
+name the parallelism kinds, annotate array shardings, and let XLA lower
+collectives onto ICI. The mesh axes used throughout this framework:
+
+- ``dp`` — data/batch parallelism (mux-batched frames split over chips);
+- ``tp`` — tensor parallelism (attention heads / mlp hidden sharded);
+- ``sp`` — sequence/context parallelism (ring attention over tokens);
+- ``ep`` — expert parallelism (MoE experts, one group per chip set).
+
+Helpers here are deliberately small: the mesh is global state the way
+jax treats it, and filter backends only need "shard my batch over dp"
+(:class:`BatchSharding`) or a full rule-based param sharding
+(``parallel.sharded``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def make_mesh(axes: Sequence[Tuple[str, int]], devices=None):
+    """Build a Mesh from (name, size) pairs; size -1 means "the rest".
+
+    make_mesh([("dp", -1), ("tp", 2)]) on 8 devices → 4×2 mesh.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    total = len(devices)
+    sizes = [s for _, s in axes]
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one axis may have size -1")
+    known = math.prod(s for s in sizes if s != -1)
+    if -1 in sizes:
+        if total % known:
+            raise ValueError(f"{total} devices not divisible by {known}")
+        sizes[sizes.index(-1)] = total // known
+    need = math.prod(sizes)
+    if need > total:
+        raise ValueError(f"mesh {sizes} needs {need} devices, have {total}")
+    arr = np.asarray(devices[:need]).reshape(sizes)  # subset is fine
+    return Mesh(arr, axis_names=[n for n, _ in axes])
+
+
+class BatchSharding:
+    """Shard the leading (batch) dim of filter I/O over a 1-D mesh axis —
+    the jax backend's ``custom=sharding:<axis>`` option."""
+
+    def __init__(self, axis: str = "dp", mesh=None):
+        self.axis = axis
+        self.mesh = mesh if mesh is not None else make_mesh([(axis, -1)])
+
+    def batched(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(self.mesh, P(self.axis))
+
+    def replicated(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(self.mesh, P())
+
+    @property
+    def num_devices(self) -> int:
+        return int(np.prod(list(self.mesh.shape.values())))
+
+
+def batch_sharding(axis: str = "dp", mesh=None) -> BatchSharding:
+    return BatchSharding(axis=axis, mesh=mesh)

@@ -1,0 +1,114 @@
+"""Ring attention — sequence/context parallelism over the ICI ring.
+
+Long-context capability the reference lacks entirely (SURVEY §5
+"long-context: absent"): the sequence axis is sharded over mesh axis
+``sp``; each device holds a Q/K/V shard and K/V blocks rotate around the
+ring with ``lax.ppermute`` while every device accumulates its Q-block's
+attention online (flash-attention-style running max/sum renormalization,
+so the full sequence never materializes on one chip). Compute on the
+current block overlaps the ppermute of the next — XLA schedules the
+collective-permute concurrently with the matmuls.
+
+Causal masking uses block indices: device i attends to block j fully when
+j < i, diagonally when j == i, not at all when j > i — the standard ring
+schedule.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _block_attend(q, k, v, bias, m_prev, l_prev, o_prev, scale):
+    """One online-softmax accumulation step (flash-style, numerically
+    stable): returns updated (m, l, o)."""
+    s = jnp.einsum("...qhd,...khd->...hqk", q, k) * scale
+    if bias is not None:
+        s = s + bias
+    m_cur = jnp.max(s, axis=-1)                      # [..., h, q]
+    m_new = jnp.maximum(m_prev, m_cur)
+    p = jnp.exp(s - m_new[..., None])
+    l_corr = jnp.exp(m_prev - m_new)
+    l_new = l_prev * l_corr + jnp.sum(p, axis=-1)
+    o_corr = l_corr[..., None]
+    o_new = o_prev * o_corr + jnp.einsum("...hqk,...khd->...qhd",
+                                         p, v).swapaxes(-3, -2)
+    return m_new, l_new, o_new
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str = "sp", causal: bool = True) -> jax.Array:
+    """Attention over a sequence sharded on ``axis_name``.
+
+    Must be called inside ``shard_map`` (or pjit with explicit axis
+    context). Shapes per device: q/k/v [batch, seq_shard, heads, head_dim].
+    Returns [batch, seq_shard, heads, head_dim].
+    """
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    scale = q.shape[-1] ** -0.5
+    b, sq, h, d = q.shape
+
+    # derive accumulators from q so they inherit every varying manual axis
+    # (dp/tp/sp...) — scan requires carry-in/out VMA types to match
+    zq = q[..., 0].swapaxes(1, 2).astype(jnp.float32) * 0.0  # [b,h,sq]
+    m0 = zq - jnp.inf
+    l0 = zq
+    o0 = q.swapaxes(1, 2).astype(jnp.float32) * 0.0          # [b,h,sq,d]
+
+    qf = q.astype(jnp.float32)
+
+    def body(carry, step):
+        m, l, o, kb, vb = carry
+        src_idx = (my_idx - step) % axis_size  # block kb originated here
+        if causal:
+            # full block if src < mine; diagonal if equal; skip if greater
+            sk = kb.shape[1]
+            qi = jnp.arange(sq)[:, None]
+            ki = jnp.arange(sk)[None, :]
+            diag = jnp.where(qi >= ki, 0.0, -jnp.inf)
+            full = jnp.zeros((sq, sk))
+            none = jnp.full((sq, sk), -jnp.inf)
+            bias = jnp.where(
+                src_idx < my_idx, full,
+                jnp.where(src_idx == my_idx, diag, none),
+            )
+            bias = bias[None, None, :, :]
+        else:
+            bias = None
+        m2, l2, o2 = _block_attend(
+            qf, kb.astype(jnp.float32), vb.astype(jnp.float32),
+            bias, m, l, o, scale,
+        )
+        # rotate K/V to the next device on the ring; overlaps next matmul
+        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+        kb2 = lax.ppermute(kb, axis_name, perm)
+        vb2 = lax.ppermute(vb, axis_name, perm)
+        return (m2, l2, o2, kb2, vb2), None
+
+    # o accumulates as [b, h, sq, d] internally
+    (m, l, o, _, _), _ = lax.scan(
+        body, (m0, l0, o0, k, v), jnp.arange(axis_size)
+    )
+    out = o / jnp.maximum(l, 1e-30)[..., None]     # [b, h, sq, d]
+    return out.swapaxes(1, 2).astype(q.dtype)      # [b, sq, h, d]
+
+
+def attention_reference(q, k, v, causal: bool = True) -> jax.Array:
+    """Single-device reference (for tests): plain softmax attention with
+    the same layout [b, s, h, d]."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)

@@ -1,0 +1,112 @@
+"""Sharding rules + sharded train/infer step builders.
+
+This is where the scaling-book recipe is applied to the transformer: name
+the mesh axes (dp/tp/sp/ep), give every param a PartitionSpec, annotate the
+data, jit — XLA inserts all-gathers/reduce-scatters/psums over ICI. Ring
+attention (manual ppermute schedule) is spliced in with ``shard_map`` when
+the mesh has an ``sp`` axis; everything around it stays GSPMD.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from nnstreamer_tpu.models.transformer import (
+    TransformerConfig,
+    build_forward,
+    init_params,
+)
+from nnstreamer_tpu.parallel.ring import ring_attention
+
+
+def transformer_param_specs(cfg: TransformerConfig) -> Dict[str, P]:
+    """PartitionSpec per param name. tp shards heads / ff hidden; ep shards
+    experts; everything else is replicated (layer axis L is never sharded
+    — it is scanned)."""
+    specs = {
+        "embed": P(None, "tp"),
+        "ln1": P(None, None),
+        "qkv": P(None, None, None, "tp", None),
+        "proj": P(None, "tp", None, None),
+        "ln2": P(None, None),
+        "ln_f": P(None),
+    }
+    if cfg.num_experts:
+        specs["router"] = P(None, None, "ep")
+        specs["w_in"] = P(None, "ep", None, "tp")
+        specs["w_out"] = P(None, "ep", "tp", None)
+    else:
+        specs["w_in"] = P(None, None, "tp")
+        specs["w_out"] = P(None, "tp", None)
+    return specs
+
+
+def _mesh_axis(mesh: Mesh, name: str) -> bool:
+    return name in mesh.axis_names and mesh.shape[name] > 1
+
+
+def make_sharded_forward(cfg: TransformerConfig, mesh: Mesh) -> Callable:
+    """Forward with ring attention over ``sp`` when present (shard_map
+    island inside the GSPMD program)."""
+    if _mesh_axis(mesh, "sp"):
+        from jax import shard_map
+
+        ring = shard_map(
+            functools.partial(ring_attention, axis_name="sp", causal=True),
+            mesh=mesh,
+            in_specs=(P("dp", "sp", "tp", None),) * 3,
+            out_specs=P("dp", "sp", "tp", None),
+            
+        )
+        return build_forward(cfg, attention_fn=ring)
+    return build_forward(cfg)
+
+
+def lm_loss(apply_fn: Callable, params, tokens) -> jax.Array:
+    """Next-token cross-entropy (fp32 logits)."""
+    logits = apply_fn(params, tokens)[:, :-1]
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return jnp.mean(nll)
+
+
+def make_train_step(cfg: TransformerConfig, mesh: Mesh,
+                    learning_rate: float = 1e-3) -> Callable:
+    """One SGD step, fully sharded: params per ``transformer_param_specs``,
+    batch over dp, sequence over sp. Returns
+    train_step(params, tokens) -> (params, loss)."""
+    apply_fn = make_sharded_forward(cfg, mesh)
+    specs = transformer_param_specs(cfg)
+    param_sh = {k: NamedSharding(mesh, s) for k, s in specs.items()}
+    data_sh = NamedSharding(
+        mesh, P("dp", "sp" if _mesh_axis(mesh, "sp") else None)
+    )
+
+    def step(params, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: lm_loss(apply_fn, p, tokens)
+        )(params)
+        params = jax.tree.map(lambda p, g: p - learning_rate * g,
+                              params, grads)
+        return params, loss
+
+    return jax.jit(
+        step,
+        in_shardings=(param_sh, data_sh),
+        out_shardings=(param_sh, NamedSharding(mesh, P())),
+        donate_argnums=(0,),
+    )
+
+
+def shard_params(params, mesh: Mesh, cfg: TransformerConfig):
+    specs = transformer_param_specs(cfg)
+    return {
+        k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+        for k, v in params.items()
+    }

@@ -80,8 +80,19 @@ def _split_caps_fields(text: str) -> List[str]:
 
 def parse_caps_string(text: str) -> Caps:
     """Parse ``media/type,k=v,k2=v2`` into Caps (values kept as str/int).
-    Quoted values may contain commas (multi-tensor dims/types)."""
-    parts = _split_caps_fields(text)
+
+    Multi-tensor values may contain commas, quoted or bare: a comma-part
+    with no ``=`` continues the previous field's value, so both
+    ``dimensions="2:2,3:3"`` and ``dimensions=2:2,3:3`` parse (the launch
+    lexer strips quotes before this function sees the string)."""
+    raw_parts = _split_caps_fields(text)
+    # merge '='-less parts into the previous field's value
+    parts: List[str] = [raw_parts[0]]
+    for item in raw_parts[1:]:
+        if "=" in item or len(parts) == 1:
+            parts.append(item)
+        else:
+            parts[-1] += "," + item
     name = parts[0].strip()
     fields = {}
     for item in parts[1:]:

@@ -197,8 +197,11 @@ class Queue(Element):
                     self.srcpad.push_event(item)
                 else:
                     self.srcpad.push(item)
-            except FlowError as e:
-                self.post_error(e)
+            except Exception as e:  # noqa: BLE001 — downstream negotiation
+                # or chain failures must reach the bus, not silently kill
+                # this worker thread
+                self.post_error(e if isinstance(e, FlowError)
+                                else FlowError(f"{self.name}: {e}"))
                 self._eos_done.set()  # unblock a waiting EOS pusher
                 return
 
