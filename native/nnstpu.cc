@@ -1,0 +1,214 @@
+// nnstpu — native runtime core for nnstreamer_tpu.
+//
+// The reference's runtime is C (GLib/GStreamer): typed buffers, an aligned
+// allocator (gst/nnstreamer/tensor_allocator.c), CPU SIMD detection
+// (hw_accel.c), framed TCP transport (tensor_query/tensor_query_common.c),
+// and sparse transcoding (elements/gsttensorsparseutil.c). This library is
+// the native-speed equivalent for the host-side hot paths of the TPU
+// framework — everything device-side is XLA's job, but wire
+// packing/unpacking, sparse codec, checksums and socket framing are
+// CPU-bound and GIL-free here. Python binds via ctypes
+// (nnstreamer_tpu/native.py) with pure-Python fallbacks.
+//
+// Build: make -C native   (→ native/libnnstpu.so)
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <cerrno>
+
+#include <sys/socket.h>
+#include <sys/uio.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <unistd.h>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// version / capability probe
+// ---------------------------------------------------------------------------
+int nnstpu_abi_version() { return 1; }
+
+// CPU feature detect (reference hw_accel.c: cpu_neon_accel_available).
+// On x86 report AVX2/AVX512; on aarch64 NEON is baseline.
+int nnstpu_cpu_features() {
+  int feats = 0;
+#if defined(__aarch64__)
+  feats |= 1;  // NEON baseline on aarch64
+#elif defined(__x86_64__)
+  unsigned eax, ebx, ecx, edx;
+  __asm__ volatile("cpuid"
+                   : "=a"(eax), "=b"(ebx), "=c"(ecx), "=d"(edx)
+                   : "a"(7), "c"(0));
+  if (ebx & (1u << 5)) feats |= 2;   // AVX2
+  if (ebx & (1u << 16)) feats |= 4;  // AVX512F
+#endif
+  return feats;
+}
+
+// ---------------------------------------------------------------------------
+// aligned allocator (reference tensor_allocator.c: custom GstAllocator with
+// configurable alignment — TPU host staging buffers want 64B+ alignment)
+// ---------------------------------------------------------------------------
+void* nnstpu_aligned_alloc(size_t size, size_t alignment) {
+  if (alignment < sizeof(void*)) alignment = sizeof(void*);
+  void* ptr = nullptr;
+  if (posix_memalign(&ptr, alignment, size) != 0) return nullptr;
+  return ptr;
+}
+
+void nnstpu_aligned_free(void* ptr) { free(ptr); }
+
+// ---------------------------------------------------------------------------
+// fnv1a checksum — integrity tag for wire frames (the reference's protocol
+// trusts TCP; we add an end-to-end check the way its MQTT path timestamps
+// do, cheap enough to be always-on)
+// ---------------------------------------------------------------------------
+uint64_t nnstpu_fnv1a(const uint8_t* data, size_t len) {
+  uint64_t h = 1469598103934665603ULL;
+  for (size_t i = 0; i < len; i++) {
+    h ^= data[i];
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+// ---------------------------------------------------------------------------
+// sparse codec (reference gsttensorsparseutil.c: COO nnz indices + values)
+// Dense -> (indices u32[], values[]) and back, elem_size in {1,2,4,8}.
+// Returns nnz, or -1 on error. GIL-free: operates on raw buffers.
+// ---------------------------------------------------------------------------
+static inline bool is_zero(const uint8_t* p, size_t elem) {
+  for (size_t i = 0; i < elem; i++)
+    if (p[i]) return false;
+  return true;
+}
+
+int64_t nnstpu_sparse_count(const uint8_t* dense, size_t n_elems,
+                            size_t elem_size) {
+  int64_t nnz = 0;
+  switch (elem_size) {
+    case 4: {
+      const uint32_t* d = (const uint32_t*)dense;
+      for (size_t i = 0; i < n_elems; i++) nnz += d[i] != 0;
+      break;
+    }
+    case 1: {
+      for (size_t i = 0; i < n_elems; i++) nnz += dense[i] != 0;
+      break;
+    }
+    case 2: {
+      const uint16_t* d = (const uint16_t*)dense;
+      for (size_t i = 0; i < n_elems; i++) nnz += d[i] != 0;
+      break;
+    }
+    case 8: {
+      const uint64_t* d = (const uint64_t*)dense;
+      for (size_t i = 0; i < n_elems; i++) nnz += d[i] != 0;
+      break;
+    }
+    default:
+      return -1;
+  }
+  return nnz;
+}
+
+int64_t nnstpu_sparse_encode(const uint8_t* dense, size_t n_elems,
+                             size_t elem_size, uint32_t* out_indices,
+                             uint8_t* out_values) {
+  int64_t nnz = 0;
+  for (size_t i = 0; i < n_elems; i++) {
+    const uint8_t* p = dense + i * elem_size;
+    if (!is_zero(p, elem_size)) {
+      out_indices[nnz] = (uint32_t)i;
+      memcpy(out_values + nnz * elem_size, p, elem_size);
+      nnz++;
+    }
+  }
+  return nnz;
+}
+
+int nnstpu_sparse_decode(const uint32_t* indices, const uint8_t* values,
+                         int64_t nnz, size_t elem_size, uint8_t* out_dense,
+                         size_t n_elems) {
+  memset(out_dense, 0, n_elems * elem_size);
+  for (int64_t i = 0; i < nnz; i++) {
+    if (indices[i] >= n_elems) return -1;
+    memcpy(out_dense + (size_t)indices[i] * elem_size,
+           values + (size_t)i * elem_size, elem_size);
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// framed socket transport (reference tensor_query_common.c framing)
+// Frame: u32 magic, u32 command, u64 length, payload[length].
+// Scatter-gather send of header+payload in one writev; blocking recv of
+// exactly one frame. Returns 0 ok, -1 error, -2 closed.
+// ---------------------------------------------------------------------------
+static int send_all_iov(int fd, struct iovec* iov, int iovcnt) {
+  while (iovcnt > 0) {
+    ssize_t n = writev(fd, iov, iovcnt);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    size_t left = (size_t)n;
+    while (iovcnt > 0 && left >= iov->iov_len) {
+      left -= iov->iov_len;
+      iov++;
+      iovcnt--;
+    }
+    if (iovcnt > 0) {
+      iov->iov_base = (uint8_t*)iov->iov_base + left;
+      iov->iov_len -= left;
+    }
+  }
+  return 0;
+}
+
+static int recv_all(int fd, uint8_t* buf, size_t len) {
+  size_t got = 0;
+  while (got < len) {
+    ssize_t n = recv(fd, buf + got, len - got, 0);
+    if (n == 0) return -2;
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    got += (size_t)n;
+  }
+  return 0;
+}
+
+int nnstpu_send_frame(int fd, uint32_t magic, uint32_t command,
+                      const uint8_t* payload, uint64_t length) {
+  uint8_t hdr[16];
+  memcpy(hdr, &magic, 4);
+  memcpy(hdr + 4, &command, 4);
+  memcpy(hdr + 8, &length, 8);
+  struct iovec iov[2];
+  iov[0].iov_base = hdr;
+  iov[0].iov_len = sizeof(hdr);
+  iov[1].iov_base = (void*)payload;
+  iov[1].iov_len = (size_t)length;
+  return send_all_iov(fd, iov, length ? 2 : 1);
+}
+
+// recv header into out_header[16]; then caller allocs and calls
+// nnstpu_recv_payload. Split so Python owns the payload buffer.
+int nnstpu_recv_header(int fd, uint8_t* out_header) {
+  return recv_all(fd, out_header, 16);
+}
+
+int nnstpu_recv_payload(int fd, uint8_t* out, uint64_t length) {
+  return recv_all(fd, out, (size_t)length);
+}
+
+int nnstpu_set_nodelay(int fd) {
+  int one = 1;
+  return setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+}  // extern "C"

@@ -26,3 +26,4 @@ from nnstreamer_tpu.elements import crop  # noqa: F401
 from nnstreamer_tpu.elements import repo  # noqa: F401
 from nnstreamer_tpu.elements import sparse  # noqa: F401
 from nnstreamer_tpu.elements import query  # noqa: F401
+from nnstreamer_tpu.elements import pubsub  # noqa: F401

@@ -1,0 +1,138 @@
+"""tensor_pubsub_sink / tensor_pubsub_src — buffers over pub/sub topics.
+
+Reference: ``gst/mqtt/mqttsink.c`` / ``mqttsrc.c``: publish any stream's
+buffers to a broker topic / subscribe and push them into a pipeline, with
+sender-epoch timestamp rebasing (mqttcommon.h header + ntputil). Element
+names ``mqttsink``/``mqttsrc`` are registered as aliases so reference
+pipeline descriptions parse unchanged.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+from typing import Optional
+
+from nnstreamer_tpu.pipeline.element import Element, FlowReturn
+from nnstreamer_tpu.pipeline.pipeline import SourceElement
+from nnstreamer_tpu.query import protocol as P
+from nnstreamer_tpu.query.pubsub import (
+    Client,
+    make_buffer_envelope,
+    parse_buffer_envelope,
+)
+from nnstreamer_tpu.registry import ELEMENT, register_subplugin, subplugin
+from nnstreamer_tpu.tensors.types import TensorFormat, TensorsConfig
+
+
+@subplugin(ELEMENT, "tensor_pubsub_sink")
+class TensorPubSubSink(Element):
+    ELEMENT_NAME = "tensor_pubsub_sink"
+    PROPERTIES = {
+        **Element.PROPERTIES,
+        "host": "127.0.0.1",
+        "port": 1883,
+        "pub_topic": "nns/stream",
+        "retain": False,
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad("sink")
+        self._client: Optional[Client] = None
+
+    def start(self):
+        super().start()
+        self._client = Client(self.get_property("host"),
+                              int(self.get_property("port")))
+
+    def stop(self):
+        if self._client:
+            self._client.close()
+            self._client = None
+        super().stop()
+
+    def chain(self, pad, buf):
+        payload = make_buffer_envelope(P.pack_buffer(buf), buf.pts)
+        self._client.publish(self.get_property("pub_topic"), payload,
+                             retain=bool(self.get_property("retain")))
+        return FlowReturn.OK
+
+
+@subplugin(ELEMENT, "tensor_pubsub_src")
+class TensorPubSubSrc(SourceElement):
+    ELEMENT_NAME = "tensor_pubsub_src"
+    PROPERTIES = {
+        **SourceElement.PROPERTIES,
+        "host": "127.0.0.1",
+        "port": 1883,
+        "sub_topic": "nns/stream",
+        "num_buffers": -1,
+        "rebase_timestamps": True,
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._client: Optional[Client] = None
+        self._q: _queue.Queue = _queue.Queue(maxsize=256)
+        self.i = 0
+        self._epoch_offset: Optional[int] = None
+
+    def start(self):
+        super().start()
+        self._client = Client(self.get_property("host"),
+                              int(self.get_property("port")))
+        self._client.subscribe(self.get_property("sub_topic"), self._on_msg)
+
+    def stop(self):
+        if self._client:
+            self._client.close()
+            self._client = None
+        super().stop()
+
+    def _on_msg(self, topic: str, body: bytes):
+        try:
+            self._q.put_nowait(body)
+        except _queue.Full:
+            pass  # drop under backpressure (mqttsrc leaky behavior)
+
+    def negotiate(self):
+        self.srcpad.set_caps(
+            TensorsConfig(format=TensorFormat.FLEXIBLE).to_caps()
+        )
+
+    def create(self):
+        n = int(self.get_property("num_buffers"))
+        if 0 <= n <= self.i:
+            return None
+        while not self._stop_evt.is_set():
+            if self._client is not None and self._client.failed.is_set():
+                raise RuntimeError(
+                    f"{self.name}: lost broker connection "
+                    f"({self.get_property('host')}:"
+                    f"{self.get_property('port')})"
+                )
+            try:
+                body = self._q.get(timeout=0.1)
+            except _queue.Empty:
+                continue
+            sent_epoch, pts, payload = parse_buffer_envelope(body)
+            buf = P.unpack_buffer(payload)
+            if self.get_property("rebase_timestamps") and pts is not None:
+                # rebase sender pts into this host's clock using the
+                # sender-epoch delta (the reference's NTP-adjusted
+                # base-time, synchronization-in-mqtt-elements.md)
+                from nnstreamer_tpu.query.pubsub import epoch_ns
+
+                if self._epoch_offset is None:
+                    self._epoch_offset = epoch_ns() - sent_epoch
+                buf = buf.replace(pts=pts + self._epoch_offset)
+            else:
+                buf = buf.replace(pts=pts)
+            self.i += 1
+            return buf
+        return None
+
+
+# reference-name aliases so existing pipeline strings parse unchanged
+register_subplugin(ELEMENT, "mqttsink", TensorPubSubSink)
+register_subplugin(ELEMENT, "mqttsrc", TensorPubSubSrc)

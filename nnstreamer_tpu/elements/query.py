@@ -35,6 +35,11 @@ class TensorQueryClient(Element):
         "servers": None,     # failover list "host1:port1,host2:port2"
         "timeout": P.DEFAULT_TIMEOUT,
         "max_retry": 3,
+        # broker discovery (reference query-hybrid): find servers by
+        # operation name instead of static host/port
+        "operation": None,
+        "broker_host": "127.0.0.1",
+        "broker_port": 1883,
     }
 
     def __init__(self, name=None, **props):
@@ -47,6 +52,23 @@ class TensorQueryClient(Element):
         self._lock = threading.Lock()
 
     def _server_list(self) -> List[Tuple[str, int]]:
+        operation = self.get_property("operation")
+        if operation:
+            from nnstreamer_tpu.query.discovery import ServerDiscovery
+
+            disco = ServerDiscovery(self.get_property("broker_host"),
+                                    int(self.get_property("broker_port")),
+                                    str(operation))
+            try:
+                found = disco.wait_servers(
+                    timeout=float(self.get_property("timeout")))
+            finally:
+                disco.close()
+            if not found:
+                raise P.QueryProtocolError(
+                    f"no servers advertise operation {operation!r}"
+                )
+            return found
         servers = self.get_property("servers")
         if servers:
             out = []
@@ -141,6 +163,11 @@ class TensorQueryServerSrc(SourceElement):
         "port": 3000,
         "id": 0,  # pairs serversrc/serversink (reference `id` property)
         "num_buffers": -1,
+        # broker advertising (reference query-hybrid server side)
+        "operation": None,
+        "broker_host": "127.0.0.1",
+        "broker_port": 1883,
+        "advertise_host": "127.0.0.1",
     }
 
     _SERVERS = {}
@@ -150,6 +177,7 @@ class TensorQueryServerSrc(SourceElement):
         super().__init__(name, **props)
         self.server: Optional[QueryServer] = None
         self.i = 0
+        self._advertiser = None
 
     def start(self):
         super().start()
@@ -159,8 +187,26 @@ class TensorQueryServerSrc(SourceElement):
         ).start()
         with self._SERVERS_LOCK:
             self._SERVERS[int(self.get_property("id"))] = self.server
+        operation = self.get_property("operation")
+        if operation:
+            from nnstreamer_tpu.query.discovery import ServerAdvertiser
+
+            self._advertiser = ServerAdvertiser(
+                self.get_property("broker_host"),
+                int(self.get_property("broker_port")),
+                str(operation),
+                self.get_property("advertise_host"),
+                self.server.port,
+            )
+            self._advertiser.publish()
 
     def stop(self):
+        if self._advertiser is not None:
+            try:
+                self._advertiser.retract()
+            except OSError:
+                pass
+            self._advertiser = None
         if self.server is not None:
             self.server.stop()
             with self._SERVERS_LOCK:

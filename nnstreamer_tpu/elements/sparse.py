@@ -24,21 +24,23 @@ from nnstreamer_tpu.tensors.types import (
 
 
 def sparse_encode(arr: np.ndarray) -> bytes:
+    from nnstreamer_tpu import native
+
     arr = np.ascontiguousarray(np.asarray(arr))
-    flat = arr.reshape(-1)
-    nz = np.flatnonzero(flat)
+    idx, vals = native.sparse_encode_arrays(arr)  # GIL-free scan in C++
     meta = TensorMetaInfo.from_info(
         TensorInfo.from_array(arr), format=TensorFormat.SPARSE,
-        sparse_nnz=int(nz.size),
+        sparse_nnz=int(idx.size),
     )
-    return (meta.pack() + nz.astype(np.uint32).tobytes() +
-            flat[nz].tobytes())
+    return meta.pack() + idx.tobytes() + vals.tobytes()
 
 
 def sparse_decode(blob: bytes, offset: int = 0):
     meta = TensorMetaInfo.unpack(blob[offset:offset + HEADER_SIZE])
     if meta.format is not TensorFormat.SPARSE:
         raise ValueError("sparse_decode: not a sparse payload")
+    from nnstreamer_tpu import native
+
     nnz = meta.sparse_nnz
     dtype = meta.type.np_dtype
     p = offset + HEADER_SIZE
@@ -47,8 +49,7 @@ def sparse_decode(blob: bytes, offset: int = 0):
     vals = np.frombuffer(blob[p:p + dtype.itemsize * nnz], dtype)
     p += dtype.itemsize * nnz
     info = meta.to_info()
-    dense = np.zeros(info.num_elements, dtype)
-    dense[idx] = vals
+    dense = native.sparse_decode_arrays(idx, vals, info.num_elements)
     return dense.reshape(info.shape), p
 
 

@@ -1,0 +1,196 @@
+"""ctypes bindings for the native runtime core (``native/libnnstpu.so``).
+
+Every entry point has a pure-Python fallback, so the framework works
+without the compiled library; with it, the host-side hot paths (wire
+framing, sparse codec, checksums, aligned buffers) run GIL-free C++
+(see ``native/nnstpu.cc`` for the reference-parity map).
+
+Build on demand: ``python -m nnstreamer_tpu.native`` (runs make).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+from typing import Optional, Tuple
+
+import numpy as np
+
+from nnstreamer_tpu.log import get_logger
+
+log = get_logger("native")
+
+_LIB_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_LIB_PATH = os.path.join(_LIB_DIR, "libnnstpu.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def build(quiet: bool = True) -> bool:
+    """Compile the native library (make -C native). A file lock serializes
+    concurrent builders (SPMD multi-process starts) so nobody dlopens a
+    half-written .so."""
+    import fcntl
+
+    lock_path = os.path.join(_LIB_DIR, ".build.lock")
+    try:
+        with open(lock_path, "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            if os.path.isfile(_LIB_PATH) and os.path.getmtime(
+                    _LIB_PATH) >= os.path.getmtime(
+                    os.path.join(_LIB_DIR, "nnstpu.cc")):
+                return True  # another process already built it
+            subprocess.run(["make", "-C", _LIB_DIR],
+                           capture_output=quiet, check=True)
+        return True
+    except (subprocess.CalledProcessError, FileNotFoundError, OSError) as e:
+        log.warning("native build failed: %s", e)
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Load (building if sources are present but the .so is not)."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.isfile(_LIB_PATH):
+        if os.path.isfile(os.path.join(_LIB_DIR, "nnstpu.cc")):
+            if not build():
+                return None
+        else:
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError as e:
+        log.warning("cannot load %s: %s", _LIB_PATH, e)
+        return None
+    lib.nnstpu_abi_version.restype = ctypes.c_int
+    if lib.nnstpu_abi_version() != 1:
+        log.warning("native ABI mismatch; rebuilding may help")
+        return None
+    # signatures
+    lib.nnstpu_cpu_features.restype = ctypes.c_int
+    lib.nnstpu_fnv1a.restype = ctypes.c_uint64
+    lib.nnstpu_fnv1a.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    lib.nnstpu_sparse_count.restype = ctypes.c_int64
+    lib.nnstpu_sparse_count.argtypes = [
+        ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t]
+    lib.nnstpu_sparse_encode.restype = ctypes.c_int64
+    lib.nnstpu_sparse_encode.argtypes = [
+        ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t,
+        ctypes.c_void_p, ctypes.c_void_p]
+    lib.nnstpu_sparse_decode.restype = ctypes.c_int
+    lib.nnstpu_sparse_decode.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_size_t, ctypes.c_void_p, ctypes.c_size_t]
+    lib.nnstpu_send_frame.restype = ctypes.c_int
+    lib.nnstpu_send_frame.argtypes = [
+        ctypes.c_int, ctypes.c_uint32, ctypes.c_uint32,
+        ctypes.c_char_p, ctypes.c_uint64]
+    lib.nnstpu_recv_header.restype = ctypes.c_int
+    lib.nnstpu_recv_header.argtypes = [ctypes.c_int, ctypes.c_void_p]
+    lib.nnstpu_recv_payload.restype = ctypes.c_int
+    lib.nnstpu_recv_payload.argtypes = [
+        ctypes.c_int, ctypes.c_void_p, ctypes.c_uint64]
+    lib.nnstpu_set_nodelay.restype = ctypes.c_int
+    lib.nnstpu_set_nodelay.argtypes = [ctypes.c_int]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+# ---------------------------------------------------------------------------
+# high-level helpers (native when possible, numpy fallback otherwise)
+# ---------------------------------------------------------------------------
+def cpu_features() -> dict:
+    lib = get_lib()
+    feats = lib.nnstpu_cpu_features() if lib else 0
+    return {"neon": bool(feats & 1), "avx2": bool(feats & 2),
+            "avx512": bool(feats & 4), "native": lib is not None}
+
+
+def fnv1a(data: bytes) -> int:
+    lib = get_lib()
+    if lib:
+        return int(lib.nnstpu_fnv1a(data, len(data)))
+    h = 0xCBF29CE484222325
+    for b in data:
+        h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def sparse_encode_arrays(dense: np.ndarray
+                         ) -> Tuple[np.ndarray, np.ndarray]:
+    """(indices u32, values) of nonzero elements, native-accelerated."""
+    flat = np.ascontiguousarray(dense).reshape(-1)
+    lib = get_lib()
+    if lib is None or flat.dtype.itemsize not in (1, 2, 4, 8):
+        idx = np.flatnonzero(flat).astype(np.uint32)
+        return idx, flat[idx]
+    nnz = lib.nnstpu_sparse_count(
+        flat.ctypes.data, flat.size, flat.dtype.itemsize)
+    if nnz < 0:
+        idx = np.flatnonzero(flat).astype(np.uint32)
+        return idx, flat[idx]
+    idx = np.empty(nnz, np.uint32)
+    vals = np.empty(nnz, flat.dtype)
+    lib.nnstpu_sparse_encode(flat.ctypes.data, flat.size,
+                             flat.dtype.itemsize,
+                             idx.ctypes.data, vals.ctypes.data)
+    return idx, vals
+
+
+def sparse_decode_arrays(indices: np.ndarray, values: np.ndarray,
+                         n_elems: int) -> np.ndarray:
+    lib = get_lib()
+    values = np.ascontiguousarray(values)
+    indices = np.ascontiguousarray(indices, np.uint32)
+    if lib is None:
+        dense = np.zeros(n_elems, values.dtype)
+        dense[indices] = values
+        return dense
+    dense = np.empty(n_elems, values.dtype)
+    rc = lib.nnstpu_sparse_decode(
+        indices.ctypes.data, values.ctypes.data, len(indices),
+        values.dtype.itemsize, dense.ctypes.data, n_elems)
+    if rc != 0:
+        raise ValueError("sparse_decode: index out of range")
+    return dense
+
+
+def send_frame(sock, magic: int, command: int, payload: bytes) -> None:
+    """Framed send over a Python socket; native writev when available.
+
+    The native path requires a truly blocking fd: CPython implements socket
+    timeouts with O_NONBLOCK, and the C side retries only EINTR — so any
+    socket with a timeout takes the Python path (same guard as recv_msg).
+    """
+    lib = get_lib()
+    if lib is not None and sock.gettimeout() is None:
+        rc = lib.nnstpu_send_frame(sock.fileno(), magic, command,
+                                   payload, len(payload))
+        if rc != 0:
+            raise OSError("native send_frame failed")
+        return
+    import struct
+
+    sock.sendall(struct.pack("<IIQ", magic, command, len(payload)) + payload)
+
+
+def main(argv=None):
+    ok = build(quiet=False)
+    print("native build:", "ok" if ok else "FAILED")
+    print("features:", cpu_features())
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

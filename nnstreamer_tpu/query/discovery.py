@@ -1,0 +1,92 @@
+"""Broker-based query-server discovery (reference tensor_query_hybrid).
+
+Reference: ``gst/nnstreamer/tensor_query/tensor_query_hybrid.c`` (375 LoC):
+servers publish their endpoint under an MQTT topic named after the
+``operation`` they serve; clients subscribe, collect the candidate server
+list, and fail over through it (tensor_query_hybrid.h:49-116).
+
+Here the broker is ``query.pubsub``; endpoints are JSON
+``{"host": ..., "port": ..., "ts": ...}`` retained under
+``nns-query/<operation>/<host>:<port>``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from nnstreamer_tpu.log import get_logger
+from nnstreamer_tpu.query.pubsub import Client
+
+log = get_logger("discovery")
+
+TOPIC_PREFIX = "nns-query/"
+
+
+class ServerAdvertiser:
+    """Server side: publish (retained) this server's endpoint for an
+    operation (reference tensor_query_hybrid_publish)."""
+
+    def __init__(self, broker_host: str, broker_port: int, operation: str,
+                 host: str, port: int):
+        self.client = Client(broker_host, broker_port)
+        self.topic = f"{TOPIC_PREFIX}{operation}/{host}:{port}"
+        self.endpoint = {"host": host, "port": port, "ts": time.time()}
+
+    def publish(self) -> None:
+        self.client.publish(self.topic,
+                            json.dumps(self.endpoint).encode(), retain=True)
+
+    def retract(self) -> None:
+        self.client.publish(self.topic, b"", retain=True)  # tombstone
+        self.client.close()
+
+
+class ServerDiscovery:
+    """Client side: subscribe to an operation's topic and keep the live
+    server list (reference tensor_query_hybrid_subscribe /
+    _get_server_info)."""
+
+    def __init__(self, broker_host: str, broker_port: int, operation: str):
+        self.client = Client(broker_host, broker_port)
+        self._servers: Dict[str, Tuple[str, int]] = {}
+        self._lock = threading.Lock()
+        self._seen = threading.Event()
+        self.client.subscribe(f"{TOPIC_PREFIX}{operation}/#", self._on_msg)
+
+    def _on_msg(self, topic: str, body: bytes) -> None:
+        key = topic.rsplit("/", 1)[-1]
+        with self._lock:
+            if not body:
+                self._servers.pop(key, None)  # tombstone
+            else:
+                try:
+                    info = json.loads(body.decode())
+                    self._servers[key] = (info["host"], int(info["port"]))
+                except (ValueError, KeyError) as e:
+                    log.warning("bad discovery payload on %s: %s", topic, e)
+                    return
+                self._seen.set()  # only live endpoints count as "seen"
+
+    def wait_servers(self, timeout: float = 5.0,
+                     settle: float = 0.2) -> List[Tuple[str, int]]:
+        """Wait up to ``timeout`` for at least one live server, then a
+        short ``settle`` window so same-burst retained messages land and
+        the failover list is complete — a tombstone alone never satisfies
+        the wait."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._seen.wait(timeout=min(0.1, max(0.0, deadline -
+                                                    time.monotonic()))):
+                break
+        with self._lock:
+            have = bool(self._servers)
+        if have and settle > 0:
+            time.sleep(settle)  # collect the rest of the retained burst
+        with self._lock:
+            return list(self._servers.values())
+
+    def close(self) -> None:
+        self.client.close()

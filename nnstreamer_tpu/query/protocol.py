@@ -51,7 +51,10 @@ class QueryProtocolError(RuntimeError):
 
 
 def send_msg(sock: socket.socket, cmd: Cmd, payload: bytes = b"") -> None:
-    sock.sendall(_HDR.pack(_MAGIC, int(cmd), len(payload)) + payload)
+    from nnstreamer_tpu import native
+
+    native.send_frame(sock, _MAGIC, int(cmd), payload)  # writev, GIL-free
+    # (falls back to sock.sendall internally when the .so is absent)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -67,6 +70,28 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def recv_msg(sock: socket.socket) -> Tuple[Cmd, bytes]:
+    from nnstreamer_tpu import native
+
+    lib = native.get_lib()
+    if lib is not None and sock.gettimeout() is None:
+        import ctypes
+
+        hdr = bytearray(16)
+        rc = lib.nnstpu_recv_header(
+            sock.fileno(), (ctypes.c_char * 16).from_buffer(hdr))
+        if rc != 0:
+            raise QueryProtocolError("connection closed mid-frame")
+        magic, cmd, plen = _HDR.unpack(bytes(hdr))
+        if magic != _MAGIC:
+            raise QueryProtocolError(f"bad magic {magic:#x}")
+        payload = bytearray(plen)
+        if plen:
+            rc = lib.nnstpu_recv_payload(
+                sock.fileno(),
+                (ctypes.c_char * plen).from_buffer(payload), plen)
+            if rc != 0:
+                raise QueryProtocolError("connection closed mid-frame")
+        return Cmd(cmd), bytes(payload)
     hdr = _recv_exact(sock, _HDR.size)
     magic, cmd, plen = _HDR.unpack(hdr)
     if magic != _MAGIC:

@@ -1,0 +1,146 @@
+"""Pub/sub broker + elements + discovery tests (reference: unittest_mqtt
+with the GstMqttTestHelper broker fake, tests/gstreamer_mqtt/; here the
+broker itself ships in-tree so tests run the real thing on loopback)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import parse_launch
+from nnstreamer_tpu.query.discovery import ServerAdvertiser, ServerDiscovery
+from nnstreamer_tpu.query.pubsub import Broker, Client
+
+
+@pytest.fixture
+def broker():
+    b = Broker(port=0).start()
+    yield b
+    b.stop()
+
+
+class TestBroker:
+    def test_pub_sub_roundtrip(self, broker):
+        got = []
+        sub = Client("127.0.0.1", broker.port)
+        sub.subscribe("a/b", lambda t, p: got.append((t, p)))
+        time.sleep(0.1)
+        pub = Client("127.0.0.1", broker.port)
+        pub.publish("a/b", b"hello")
+        deadline = time.time() + 5
+        while not got and time.time() < deadline:
+            time.sleep(0.01)
+        assert got == [("a/b", b"hello")]
+        sub.close()
+        pub.close()
+
+    def test_retained_delivered_to_late_subscriber(self, broker):
+        pub = Client("127.0.0.1", broker.port)
+        pub.publish("cfg/x", b"v1", retain=True)
+        time.sleep(0.1)
+        got = []
+        sub = Client("127.0.0.1", broker.port)
+        sub.subscribe("cfg/#", lambda t, p: got.append((t, p)))
+        deadline = time.time() + 5
+        while not got and time.time() < deadline:
+            time.sleep(0.01)
+        assert got == [("cfg/x", b"v1")]
+        sub.close()
+        pub.close()
+
+    def test_wildcard(self, broker):
+        got = []
+        sub = Client("127.0.0.1", broker.port)
+        sub.subscribe("ns/#", lambda t, p: got.append(t))
+        time.sleep(0.1)
+        pub = Client("127.0.0.1", broker.port)
+        pub.publish("ns/one", b"1")
+        pub.publish("other/two", b"2")
+        pub.publish("ns/three", b"3")
+        deadline = time.time() + 5
+        while len(got) < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        assert got == ["ns/one", "ns/three"]
+        sub.close()
+        pub.close()
+
+
+class TestPubSubElements:
+    def test_stream_over_broker(self, broker):
+        recv = parse_launch(
+            f"tensor_pubsub_src host=127.0.0.1 port={broker.port} "
+            "sub-topic=t/video num-buffers=3 ! tensor_sink name=out"
+        )
+        recv.start()
+        time.sleep(0.2)  # let the subscription land
+        send = parse_launch(
+            "videotestsrc num-buffers=3 width=8 height=8 ! tensor_converter ! "
+            f"tensor_pubsub_sink host=127.0.0.1 port={broker.port} "
+            "pub-topic=t/video"
+        )
+        send.run(timeout=20)
+        msg = recv.wait(timeout=20)
+        recv.stop()
+        assert msg is not None and msg.kind == "eos"
+        outs = recv.get("out").buffers
+        assert len(outs) == 3
+        assert outs[0][0].shape == (1, 8, 8, 3)
+        assert outs[0].pts is not None  # rebased timestamps
+
+    def test_mqtt_alias_names(self):
+        from nnstreamer_tpu.registry import ELEMENT, get_subplugin
+
+        assert get_subplugin(ELEMENT, "mqttsink") is not None
+        assert get_subplugin(ELEMENT, "mqttsrc") is not None
+
+
+class TestDiscovery:
+    def test_advertise_and_discover(self, broker):
+        adv = ServerAdvertiser("127.0.0.1", broker.port, "detect",
+                               "10.0.0.5", 4242)
+        adv.publish()
+        time.sleep(0.1)
+        disco = ServerDiscovery("127.0.0.1", broker.port, "detect")
+        servers = disco.wait_servers(timeout=5)
+        assert ("10.0.0.5", 4242) in servers
+        disco.close()
+        adv.retract()
+
+    def test_query_client_discovers_live_server(self, broker):
+        from nnstreamer_tpu.filters import register_custom_easy
+        from nnstreamer_tpu.tensors.types import TensorsInfo
+
+        info = TensorsInfo.from_str("4", "float32")
+        register_custom_easy("p4", lambda ins: [np.asarray(ins[0]) * 3],
+                             info, info)
+        server = parse_launch(
+            f"tensor_query_serversrc name=s port=0 operation=triple "
+            f"broker-host=127.0.0.1 broker-port={broker.port} ! "
+            "tensor_filter framework=custom-easy model=p4 ! "
+            "tensor_query_serversink"
+        )
+        server.start()
+        time.sleep(0.2)
+        try:
+            from nnstreamer_tpu.elements.sink import TensorSink
+            from nnstreamer_tpu.elements.source import AppSrc
+
+            client = parse_launch(
+                "tensor_query_client name=c operation=triple "
+                f"broker-host=127.0.0.1 broker-port={broker.port} timeout=5"
+            )
+            src, sink = AppSrc(name="src"), TensorSink(name="out")
+            client.add(src, sink)
+            src.link(client.get("c"))
+            client.get("c").link(sink)
+            client.start()
+            src.push([np.arange(4, dtype=np.float32)], pts=0)
+            src.end_of_stream()
+            msg = client.wait(timeout=20)
+            client.stop()
+            assert msg is not None and msg.kind == "eos", str(msg)
+            np.testing.assert_array_equal(
+                sink.buffers[0][0], np.arange(4, dtype=np.float32) * 3
+            )
+        finally:
+            server.stop()
