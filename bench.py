@@ -99,12 +99,16 @@ def measure_tflite_baseline() -> float | None:
     return None  # no bundled .tflite model file; driver baseline applies
 
 
-def _probe_accelerator(timeout_s: float = 120.0) -> bool:
+def _probe_accelerator(timeout_s: float = None) -> bool:
     """Check that jax device init doesn't hang (a wedged TPU tunnel blocks
     forever in PJRT client creation). Probe in a subprocess so the main
     process stays clean; fall back to CPU when unavailable."""
     import subprocess
 
+    if timeout_s is None:
+        # tunneled TPU backends can take minutes to initialize; real local
+        # chips answer in seconds
+        timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT", "300"))
     try:
         proc = subprocess.run(
             [sys.executable, "-c",

@@ -117,16 +117,12 @@ def _moe_ffn(x, router, w_in, w_out, dtype):
     return jnp.sum(out * onehot[..., None], axis=2) * weight[..., None]
 
 
-def build_forward(cfg: TransformerConfig,
-                  attention_fn: Optional[Callable] = None) -> Callable:
-    """Returns apply_fn(params, tokens[int32 b,s]) -> logits[b,s,vocab].
-
-    ``attention_fn(q, k, v)`` defaults to single-device causal attention;
-    pass a ring-attention closure (inside shard_map) for sequence
-    parallelism. ``positions`` are offset by the sp shard index when the
-    attention_fn provides ``.position_offset`` (set by the sharded step
-    builder) so rotary phases stay globally correct.
-    """
+def make_layer_body(cfg: TransformerConfig,
+                    attention_fn: Optional[Callable] = None) -> Callable:
+    """One transformer block as a ``lax.scan`` body over stacked layer
+    params: ``layer_body((x, positions), layer_params) -> ((x, positions),
+    None)``. Shared by the plain forward (scan over all L layers) and the
+    pipeline-parallel forward (each stage scans its local L/pp layers)."""
     from nnstreamer_tpu.parallel.ring import attention_reference
 
     attn = attention_fn or attention_reference
@@ -146,6 +142,22 @@ def build_forward(cfg: TransformerConfig,
         else:
             x = x + _dense_ffn(h2, lp["w_in"], lp["w_out"], dtype)
         return (x, positions), None
+
+    return layer_body
+
+
+def build_forward(cfg: TransformerConfig,
+                  attention_fn: Optional[Callable] = None) -> Callable:
+    """Returns apply_fn(params, tokens[int32 b,s]) -> logits[b,s,vocab].
+
+    ``attention_fn(q, k, v)`` defaults to single-device causal attention;
+    pass a ring-attention closure (inside shard_map) for sequence
+    parallelism. ``positions`` are offset by the sp shard index when the
+    attention_fn provides ``.position_offset`` (set by the sharded step
+    builder) so rotary phases stay globally correct.
+    """
+    dtype = cfg.dtype
+    layer_body = make_layer_body(cfg, attention_fn)
 
     def apply_fn(params, tokens, position_offset=0):
         b, s = tokens.shape

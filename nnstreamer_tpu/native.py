@@ -58,8 +58,11 @@ def get_lib() -> Optional[ctypes.CDLL]:
     if _lib is not None or _tried:
         return _lib
     _tried = True
-    if not os.path.isfile(_LIB_PATH):
-        if os.path.isfile(os.path.join(_LIB_DIR, "nnstpu.cc")):
+    src = os.path.join(_LIB_DIR, "nnstpu.cc")
+    stale = (os.path.isfile(_LIB_PATH) and os.path.isfile(src)
+             and os.path.getmtime(_LIB_PATH) < os.path.getmtime(src))
+    if not os.path.isfile(_LIB_PATH) or stale:
+        if os.path.isfile(src):
             if not build():
                 return None
         else:
@@ -154,6 +157,8 @@ def sparse_decode_arrays(indices: np.ndarray, values: np.ndarray,
     values = np.ascontiguousarray(values)
     indices = np.ascontiguousarray(indices, np.uint32)
     if lib is None:
+        if len(indices) and int(indices.max()) >= n_elems:
+            raise ValueError("sparse_decode: index out of range")
         dense = np.zeros(n_elems, values.dtype)
         dense[indices] = values
         return dense

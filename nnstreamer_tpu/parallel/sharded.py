@@ -104,9 +104,59 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh,
     )
 
 
-def shard_params(params, mesh: Mesh, cfg: TransformerConfig):
-    specs = transformer_param_specs(cfg)
+def shard_params(params, mesh: Mesh, cfg: TransformerConfig,
+                 pipelined: bool = False):
+    if pipelined:
+        from nnstreamer_tpu.parallel.pipeline import pipeline_param_specs
+
+        specs = pipeline_param_specs(cfg)
+    else:
+        specs = transformer_param_specs(cfg)
     return {
         k: jax.device_put(v, NamedSharding(mesh, specs[k]))
         for k, v in params.items()
     }
+
+
+def make_pp_train_step(cfg: TransformerConfig, mesh: Mesh,
+                       num_microbatches: int = 4,
+                       learning_rate: float = 1e-3) -> Callable:
+    """One SGD step with the block stack **pipeline-parallel** over mesh
+    axis ``pp`` (microbatched GPipe schedule, parallel.pipeline), composed
+    in the same jitted program with tp (Megatron shardings), ep (expert
+    axis), sp (ring attention inside the pipelined region) and dp (batch).
+
+    ``tokens`` are ``[num_microbatches, mb_batch, seq]`` int32; the
+    microbatch axis is the pipeline's time axis, ``mb_batch`` shards over
+    dp, ``seq`` over sp. Returns step(params, tokens) -> (params, loss).
+    """
+    from nnstreamer_tpu.parallel.pipeline import (
+        build_pipelined_forward,
+        pipeline_param_specs,
+    )
+
+    apply_fn = build_pipelined_forward(cfg, mesh, num_microbatches)
+    specs = pipeline_param_specs(cfg)
+    param_sh = {k: NamedSharding(mesh, s) for k, s in specs.items()}
+    data_sh = NamedSharding(
+        mesh, P(None, "dp", "sp" if _mesh_axis(mesh, "sp") else None))
+
+    def loss_fn(params, tokens):
+        logits = apply_fn(params, tokens)[:, :, :-1]
+        targets = tokens[:, :, 1:]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+        return jnp.mean(nll)
+
+    def step(params, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        params = jax.tree.map(lambda p, g: p - learning_rate * g,
+                              params, grads)
+        return params, loss
+
+    return jax.jit(
+        step,
+        in_shardings=(param_sh, data_sh),
+        out_shardings=(param_sh, NamedSharding(mesh, P())),
+        donate_argnums=(0,),
+    )

@@ -123,6 +123,8 @@ class Tracer:
         """Chrome trace-event format (load in chrome://tracing/Perfetto)."""
         with self._lock:
             events = list(self.events)
+        tids = {name: i for i, name in enumerate(
+            sorted({ev["element"] for ev in events}))}
         trace = [
             {
                 "name": ev["element"],
@@ -131,7 +133,7 @@ class Tracer:
                 "ts": ev["ts_us"],
                 "dur": ev["dur_us"],
                 "pid": 1,
-                "tid": hash(ev["element"]) % 1000,
+                "tid": tids[ev["element"]],
             }
             for ev in events
         ]
