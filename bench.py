@@ -51,6 +51,7 @@ def build_pipeline(batch: int = 1):
         "tensor_transform mode=arithmetic "
         "option=typecast:float32,add:-127.5,div:127.5 ! "
         "tensor_filter framework=jax model=mobilenet_v2_bench name=filter ! "
+        "queue max-size-buffers=8 prefetch-host=true ! "
         "tensor_sink name=sink to-host=true"
     )
     return pipe

@@ -233,6 +233,41 @@ class TensorFilter(Element):
             final = list(outputs)
         return self.srcpad.push(buf.with_tensors(final))
 
+    # -- region fusion (pipeline/fuse.py) ------------------------------------
+    def device_stage(self):
+        """Fusible when the backend can hand over a pure jittable stage and
+        no host-side per-frame control flow is configured (throttle drops
+        are data/time-dependent host decisions)."""
+        if int(self.get_property("throttle")) > 0:
+            return None
+        fw = self.fw
+        stage_getter = getattr(fw, "device_stage", None)
+        if fw is None or stage_getter is None:
+            return None
+        backend_stage = stage_getter()
+        if backend_stage is None:
+            return None
+        from nnstreamer_tpu.pipeline.fuse import DeviceStage
+
+        in_comb = self._combination("input_combination")
+        out_comb = self._combination("output_combination")
+        inner = backend_stage.fn
+
+        def fn(consts, tensors):
+            model_in = [tensors[i] for _, i in in_comb] if in_comb \
+                else tensors
+            outs = inner(consts, model_in)
+            if out_comb:
+                return [outs[i] if k == "o" else tensors[i]
+                        for k, i in out_comb]
+            return list(outs)
+
+        key = None if backend_stage.key is None else (
+            "tensor_filter", backend_stage.key,
+            tuple(in_comb or ()), tuple(out_comb or ()),
+        )
+        return DeviceStage(consts=backend_stage.consts, fn=fn, key=key)
+
     # -- events --------------------------------------------------------------
     def sink_event(self, pad, event: Event):
         if isinstance(event, CustomEvent) and event.name == "reload_model":
@@ -249,3 +284,6 @@ class TensorFilter(Element):
             self._props["model"] = model
         if self.fw is not None:
             self.fw.handle_event("reload_model", data)
+        region = getattr(self, "_fused_region", None)
+        if region is not None:
+            region.invalidate()

@@ -194,3 +194,24 @@ class TensorTransform(Element):
         idx = set(self._apply_indices(buf.num_tensors))
         out = [spec(t) if i in idx else t for i, t in enumerate(buf.tensors)]
         return self.srcpad.push(buf.with_tensors(out))
+
+    # -- region fusion (pipeline/fuse.py) ------------------------------------
+    def device_stage(self):
+        """All transform modes are pure elementwise/layout math — always
+        fusible when acceleration is on."""
+        if not bool(self.get_property("acceleration")):
+            return None
+        from nnstreamer_tpu.pipeline.fuse import DeviceStage
+
+        spec = self._get_spec()
+
+        def fn(consts, tensors):
+            import jax.numpy as jnp
+
+            sel = set(self._apply_indices(len(tensors)))
+            return [spec.apply(jnp, t) if i in sel else t
+                    for i, t in enumerate(tensors)]
+
+        key = ("tensor_transform", spec.mode, spec.option,
+               str(self.get_property("apply") or ""))
+        return DeviceStage(consts=None, fn=fn, key=key)

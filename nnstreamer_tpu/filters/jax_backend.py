@@ -146,6 +146,7 @@ class JaxFilter(FilterFramework):
         import jax
 
         platform = _parse_accelerator(props.accelerator)
+        self._explicit_platform = platform
         try:
             self._device = jax.devices(platform)[0] if platform else \
                 jax.devices()[0]
@@ -165,6 +166,14 @@ class JaxFilter(FilterFramework):
             entry = self._load(model, props)
             if props.shared_key:
                 entry = shared_model_insert(props.shared_key, entry)
+        # bump the trace token only when the model *function* actually
+        # changed — fused regions key their jit cache on it, so a
+        # params-only reload swaps consts without an XLA recompile.
+        # (_last_fn survives close(): reload is close()+open(), and the
+        # identity must be compared across that gap)
+        if entry["fn"] is not getattr(self, "_last_fn", None):
+            self._fn_token = getattr(self, "_fn_token", 0) + 1
+            self._last_fn = entry["fn"]
         self._fn = entry["fn"]
         self._params = entry["params"]
         self._in_info = props.input_info or entry.get("in_info")
@@ -230,6 +239,25 @@ class JaxFilter(FilterFramework):
             for o in out
         ])
         return self._out_info
+
+    # -- region fusion (pipeline/fuse.py) ------------------------------------
+    def device_stage(self):
+        """Expose the model as a pure fused-region stage; params ride as the
+        stage consts so hot reload swaps them without recompiling.
+
+        Not fusible with batch sharding or an explicitly-requested platform:
+        invoke() places inputs with NamedSharding / onto the chosen device,
+        and a plain fused jit would silently drop that placement."""
+        if self._fn is None or self._sharding is not None or \
+                getattr(self, "_explicit_platform", None):
+            return None
+        from nnstreamer_tpu.pipeline.fuse import DeviceStage
+
+        def fn(params, tensors):
+            return self._call(params, *tensors)
+
+        return DeviceStage(consts=self._params, fn=fn,
+                           key=("jax", id(self), self._fn_token))
 
     # -- hot path ------------------------------------------------------------
     def invoke(self, inputs: Sequence[Any]) -> List[Any]:

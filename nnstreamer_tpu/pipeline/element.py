@@ -203,13 +203,24 @@ class Element:
             )
         self._props[key] = self._coerce_property(key, value)
         self.property_changed(key)
+        region = getattr(self, "_fused_region", None)
+        if region is not None:
+            # a live property edit may change the member's computation (or
+            # its fusibility — e.g. throttle>0); re-plan on the next frame
+            region.invalidate()
 
     def get_property(self, key: str) -> Any:
         key = key.replace("-", "_")
-        if key == "latency":
-            return self.stats.latency_us
-        if key == "throughput":
-            return self.stats.throughput_milli
+        if key in ("latency", "throughput"):
+            # a fused member doesn't run its own chain; its best-available
+            # number is the region's single-dispatch stat (documented: when
+            # fused, element latency == region dispatch latency)
+            stats = self.stats
+            region = getattr(self, "_fused_region", None)
+            if region is not None and stats.total_invokes == 0:
+                stats = region.stats
+            return stats.latency_us if key == "latency" else \
+                stats.throughput_milli
         return self._props[key]
 
     def _coerce_property(self, key: str, value: Any) -> Any:

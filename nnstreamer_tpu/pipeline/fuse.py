@@ -1,0 +1,301 @@
+"""Region fusion — compile chains of device-capable elements into ONE XLA
+program.
+
+The reference's per-element hot path is a C function call per element per
+frame (tensor_filter.c:547, tensor_transform.c chain); cheap on a CPU, but
+on a TPU every element-level dispatch is a host→device round trip. The
+TPU-first answer (SURVEY §7 design stance: "the pipeline graph compiles
+region-wise into jitted XLA programs") is this pass: after elements start,
+maximal runs of *fusible* single-in/single-out elements are re-linked behind
+a :class:`FusedRegion` whose chain performs a single ``jax.jit`` dispatch.
+XLA then fuses the whole run — e.g. uint8 frame → normalize → MobileNet →
+logits becomes one executable with one H2D transfer per frame.
+
+An element opts in by implementing ``device_stage() -> DeviceStage | None``:
+a pure, shape-polymorphic ``fn(consts, tensors) -> tensors`` plus the
+device-resident constants (model params) passed as jit arguments (NOT
+captured, so hot model reload swaps params without recompiling). Elements
+whose per-frame behavior is host-side control flow (throttling drops, sync
+policies, routing) simply don't implement it and stay unfused.
+
+Disable globally with ``NNSTPU_FUSE=0`` or per-pipeline with
+``Pipeline(fuse=False)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+from nnstreamer_tpu.log import get_logger
+from nnstreamer_tpu.pipeline.element import (
+    CustomEvent,
+    Element,
+    Event,
+    FlowError,
+    Pad,
+)
+
+log = get_logger("fuse")
+
+
+@dataclasses.dataclass
+class DeviceStage:
+    """One element's contribution to a fused region.
+
+    ``fn(consts, tensors)`` must be traceable by JAX (pure, no data-dependent
+    Python control flow) and polymorphic over the number/shape of tensors.
+    ``consts`` is any pytree (device arrays preferred); it is threaded
+    through the jitted call as an argument so const updates (model reload)
+    don't recompile when shapes are unchanged.
+
+    ``key`` identifies the *traced computation* (not the consts): the region
+    re-jits only when a member's key changes (model function swapped,
+    transform option edited); a rebuild with identical keys just swaps
+    consts into the existing executable — no XLA recompile.
+    """
+
+    consts: Any
+    fn: Callable[[Any, List[Any]], List[Any]]
+    key: Any = None
+
+
+def fusion_enabled() -> bool:
+    return os.environ.get("NNSTPU_FUSE", "1").strip().lower() not in (
+        "0", "false", "no", "off"
+    )
+
+
+def _single_io(el: Element) -> bool:
+    return len(el.sinkpads) == 1 and len(el.srcpads) == 1
+
+
+def _stage_of(el: Element) -> Optional[DeviceStage]:
+    getter = getattr(el, "device_stage", None)
+    if getter is None:
+        return None
+    try:
+        return getter()
+    except Exception as e:  # noqa: BLE001 — an element that can't stage
+        # simply stays unfused; fusion is an optimization, never a failure
+        log.debug("element %s not fusible: %s", el.name, e)
+        return None
+
+
+class FusedRegion(Element):
+    """Replaces a run of fusible elements with one jitted dispatch.
+
+    The member elements stay in the pipeline (their properties, stats and
+    custom-event handling remain live); only their pads are re-routed so
+    buffers flow through this region instead. Caps negotiation chains the
+    members' own ``transform_caps`` so negotiation semantics are identical
+    to the unfused pipeline. Custom events are delivered into the member
+    chain (internal links are kept); whatever the members do NOT consume
+    reaches this region's internal return pad and is forwarded downstream —
+    identical consume semantics to the unfused graph.
+    """
+
+    ELEMENT_NAME = "fused_region"
+    PROPERTIES = {**Element.PROPERTIES}
+
+    def __init__(self, members: Sequence[Element], name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+        #: receives whatever flows out of the last member (events only —
+        #: buffers no longer flow through members)
+        self.internal_pad = self.add_sink_pad("fused-internal")
+        self.members: List[Element] = list(members)
+        #: (consts_list, jitted) — swapped atomically; readers take one
+        #: local reference so invalidate() can never half-update it
+        self._compiled: Optional[Tuple[list, Callable]] = None
+        #: (keys_list, jitted) from the last trace — reused when a rebuild
+        #: finds identical keys, so consts-only changes never recompile
+        self._trace_cache: Optional[Tuple[list, Callable]] = None
+        self._dead = False  # set when un-spliced back out of the graph
+
+    # -- stage (re)build -----------------------------------------------------
+    def _build(self) -> Tuple[list, Callable]:
+        import jax
+
+        stages = []
+        for m in self.members:
+            st = _stage_of(m)
+            if st is None:
+                raise FlowError(
+                    f"fused region {self.name}: member {m.name} is no "
+                    f"longer fusible"
+                )
+            stages.append(st)
+        keys = [st.key for st in stages]
+        cache = self._trace_cache
+        # a None key means "cannot prove the computation is unchanged" —
+        # never match it against the cache
+        if any(k is None for k in keys):
+            cache = None
+        if cache is not None and cache[0] == keys:
+            jitted = cache[1]
+        else:
+            fns = [st.fn for st in stages]
+
+            def composed(consts, tensors):
+                for f, c in zip(fns, consts):
+                    tensors = f(c, list(tensors))
+                return list(tensors)
+
+            jitted = jax.jit(composed)
+            self._trace_cache = (keys, jitted)
+        compiled = ([st.consts for st in stages], jitted)
+        self._compiled = compiled
+        return compiled
+
+    def invalidate(self) -> None:
+        """Drop the compiled (consts, jit) pair; the next frame re-pulls
+        member stages. Whether that re-traces is decided by stage keys — a
+        params-only model reload keeps the executable and just swaps consts;
+        a swapped model function / edited transform option re-jits."""
+        self._compiled = None
+
+    def start(self):
+        super().start()
+        if self._dead:
+            return
+        # members were restarted (backends re-opened, possibly with changed
+        # properties) — never reuse a program traced over the old backend
+        self.invalidate()
+        try:
+            self._build()
+        except FlowError:
+            # a member stopped being fusible (properties changed while the
+            # pipeline was NULL) — fall back to the original element links
+            self.unsplice()
+
+    # -- negotiation ---------------------------------------------------------
+    def transform_caps(self, pad, caps):
+        for m in self.members:
+            out = m.transform_caps(m.sinkpads[0], caps)
+            if out is None:
+                return None
+            caps = out
+        return caps
+
+    # -- hot path ------------------------------------------------------------
+    def chain(self, pad, buf):
+        if pad is self.internal_pad:
+            raise FlowError(f"{self.name}: buffer on internal event pad")
+        compiled = self._compiled
+        if compiled is None:
+            try:
+                compiled = self._build()
+            except FlowError:
+                # a member stopped being fusible mid-stream (e.g. throttle
+                # enabled at runtime) — restore the original links and send
+                # this and all future buffers down the member chain; the
+                # unfused pipeline's behavior resumes seamlessly
+                self.unsplice()
+                first = self.members[0]
+                return first._chain_entry(first.sinkpads[0], buf)
+        consts, jitted = compiled
+        out = jitted(consts, list(buf.tensors))
+        return self.srcpad.push(buf.with_tensors(list(out)))
+
+    # -- events --------------------------------------------------------------
+    def sink_event(self, pad: Pad, event: Event) -> None:
+        if pad is self.internal_pad:
+            # an event the member chain chose to forward — pass it on
+            self.srcpad.push_event(event)
+            return
+        if isinstance(event, CustomEvent):
+            # deliver through the member chain; members that consume it
+            # (e.g. tensor_filter eats reload_model) stop it there, others
+            # forward it to the internal pad which sends it downstream
+            self.members[0]._event_entry(self.members[0].sinkpads[0], event)
+            self.invalidate()
+            return
+        from nnstreamer_tpu.pipeline.element import EosEvent
+
+        if isinstance(event, EosEvent):
+            # the internal event pad never sees EOS, so the base "all sink
+            # pads at EOS" rule would deadlock — the data sink pad alone
+            # decides here
+            self.handle_eos()
+            self.srcpad.push_event(event)
+            return
+        super().sink_event(pad, event)
+
+    def __repr__(self):
+        names = "+".join(m.name for m in self.members)
+        return f"<FusedRegion [{names}]>"
+
+    # -- splicing ------------------------------------------------------------
+    def splice(self, pipe) -> None:
+        self.pipeline = pipe
+        for m in self.members:
+            m._fused_region = self  # so member-level mutators (e.g.
+            # TensorFilter.reload_model) can invalidate the compiled region
+        first, last = self.members[0], self.members[-1]
+        up_src = first.sinkpads[0].peer
+        down_sink = last.srcpads[0].peer
+        if up_src is not None:
+            up_src.unlink()
+            up_src.link(self.sinkpad)
+        if down_sink is not None:
+            last.srcpads[0].unlink()
+            self.srcpad.link(down_sink)
+        # route member-chain event outflow back through this region
+        last.srcpads[0].link(self.internal_pad)
+        log.info("fused region: %s", self)
+
+    def unsplice(self) -> None:
+        """Restore the original element links (region becomes inert)."""
+        first, last = self.members[0], self.members[-1]
+        last.srcpads[0].unlink()  # internal pad
+        up_src = self.sinkpad.peer
+        down_sink = self.srcpad.peer
+        if up_src is not None:
+            up_src.unlink()
+            up_src.link(first.sinkpads[0])
+        if down_sink is not None:
+            self.srcpad.unlink()
+            last.srcpads[0].link(down_sink)
+        for m in self.members:
+            m._fused_region = None
+        self._dead = True
+        log.info("unspliced region: %s", self)
+
+
+def fuse_pipeline(pipe) -> List[FusedRegion]:
+    """Find maximal fusible runs and splice FusedRegions into the graph.
+
+    Must run after non-source elements started (filter backends open their
+    models in start(), and a backend is what makes a filter fusible) and
+    before sources begin pushing.
+    """
+    regions: List[FusedRegion] = []
+    in_run = set()
+    for el in pipe.elements:
+        if id(el) in in_run or not _single_io(el):
+            continue
+        if _stage_of(el) is None:
+            continue
+        up = el.sinkpads[0].peer.element if el.sinkpads[0].peer else None
+        if up is not None and _single_io(up) and _stage_of(up) is not None:
+            continue  # not the head of a run
+        run = [el]
+        cur = el
+        while True:
+            peer = cur.srcpads[0].peer
+            nxt = peer.element if peer else None
+            if nxt is None or not _single_io(nxt) or _stage_of(nxt) is None:
+                break
+            run.append(nxt)
+            cur = nxt
+        if len(run) < 2:
+            continue
+        for m in run:
+            in_run.add(id(m))
+        region = FusedRegion(run, name="+".join(m.name for m in run))
+        region.splice(pipe)
+        regions.append(region)
+    return regions

@@ -111,7 +111,8 @@ class Queue(Element):
     """
 
     ELEMENT_NAME = "queue"
-    PROPERTIES = {**Element.PROPERTIES, "max_size_buffers": 16, "leaky": "no"}
+    PROPERTIES = {**Element.PROPERTIES, "max_size_buffers": 16, "leaky": "no",
+                  "prefetch_host": False}
 
     _EOS = object()
 
@@ -146,6 +147,14 @@ class Queue(Element):
         super().stop()
 
     def chain(self, pad, buf):
+        if self.get_property("prefetch_host"):
+            # start D2H for device tensors NOW (producer side) so a
+            # downstream to_host consumer finds the copy already in flight
+            # instead of serializing one device round trip per frame
+            for t in buf.tensors:
+                start_async = getattr(t, "copy_to_host_async", None)
+                if start_async is not None:
+                    start_async()
         if self._worker is None:  # not started: degenerate passthrough
             return self.srcpad.push(buf)
         if self.get_property("leaky") == "downstream":
@@ -209,7 +218,7 @@ class Queue(Element):
 class Pipeline:
     """Element container + scheduler + bus."""
 
-    def __init__(self, name: str = "pipeline"):
+    def __init__(self, name: str = "pipeline", fuse: bool = True):
         self.name = name
         self.elements: List[Element] = []
         self.by_name: Dict[str, Element] = {}
@@ -218,6 +227,8 @@ class Pipeline:
         self._threads: List[threading.Thread] = []
         self._eos_pending = 0
         self._lock = threading.Lock()
+        self._fuse = fuse
+        self._regions: Optional[list] = None
 
     # -- construction ---------------------------------------------------------
     def add(self, *elements: Element) -> "Pipeline":
@@ -249,6 +260,14 @@ class Pipeline:
         others = [e for e in self.elements if not isinstance(e, SourceElement)]
         for el in others:
             el.start()
+        # region fusion after backends opened, before any buffer flows
+        # (pipeline/fuse.py); splices persist across restarts
+        from nnstreamer_tpu.pipeline.fuse import fuse_pipeline, fusion_enabled
+
+        if self._fuse and fusion_enabled() and self._regions is None:
+            self._regions = fuse_pipeline(self)
+        for r in self._regions or ():
+            r.start()
         for el in sources:
             el.start()
         self.state = State.PLAYING
@@ -274,6 +293,8 @@ class Pipeline:
         for el in self.elements:
             if not isinstance(el, SourceElement):
                 el.stop()
+        for r in self._regions or ():
+            r.stop()
         self.state = State.NULL
         return self
 

@@ -1,0 +1,256 @@
+"""Region fusion (pipeline/fuse.py): fused pipelines must be
+indistinguishable from unfused ones except for speed.
+
+Mirrors the reference's guarantee that element composition is semantics-
+preserving regardless of scheduling (queues, threads); here the scheduling
+change is "one XLA program instead of N dispatches".
+"""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import parse_launch
+from nnstreamer_tpu.filters.jax_backend import (
+    register_jax_model,
+    unregister_jax_model,
+)
+from nnstreamer_tpu.pipeline.fuse import FusedRegion
+from nnstreamer_tpu.tensors.types import TensorInfo, TensorsInfo, TensorType
+
+
+@pytest.fixture
+def linear_model():
+    import jax.numpy as jnp
+
+    w = jnp.full((4, 3), 0.5, jnp.float32)
+
+    def fn(params, x):
+        return x.astype(jnp.float32) @ params
+
+    in_info = TensorsInfo([TensorInfo(dim=(4, 8), type=TensorType.FLOAT32)])
+    out_info = TensorsInfo([TensorInfo(dim=(3, 8), type=TensorType.FLOAT32)])
+    register_jax_model("fuse_linear", fn, w, in_info=in_info,
+                       out_info=out_info)
+    yield "fuse_linear"
+    unregister_jax_model("fuse_linear")
+
+
+DESC = (
+    "appsrc name=src ! "
+    "tensor_transform mode=arithmetic option=typecast:float32,mul:2.0 ! "
+    "tensor_filter framework=jax model={m} name=filter ! "
+    "tensor_sink name=sink"
+)
+
+
+def _run(desc, frames, fuse=True):
+    pipe = parse_launch(desc)
+    pipe._fuse = fuse
+    src = pipe.get("src")
+    sink = pipe.get("sink")
+    pipe.start()
+    try:
+        for f in frames:
+            src.push([f.copy()])
+        src.end_of_stream()
+        msg = pipe.wait(timeout=60)
+        assert msg is not None and msg.kind == "eos", msg
+    finally:
+        pipe.stop()
+    return pipe, [np.asarray(b.tensors[0]) for b in sink.buffers]
+
+
+def test_fused_matches_unfused(linear_model):
+    frames = [np.random.default_rng(i).integers(0, 9, (8, 4)).astype(np.uint8)
+              for i in range(5)]
+    pipe_f, out_f = _run(DESC.format(m=linear_model), frames, fuse=True)
+    pipe_u, out_u = _run(DESC.format(m=linear_model), frames, fuse=False)
+    assert pipe_f._regions and isinstance(pipe_f._regions[0], FusedRegion)
+    assert pipe_f._regions[0].members[0].ELEMENT_NAME == "tensor_transform"
+    assert not pipe_u._regions
+    assert len(out_f) == len(out_u) == 5
+    for a, b in zip(out_f, out_u):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_fused_region_math(linear_model):
+    frames = [np.ones((8, 4), np.uint8)] * 3
+    pipe, out = _run(DESC.format(m=linear_model), frames, fuse=True)
+    region = pipe._regions[0]
+    assert len(region.members) == 2
+    # result = (x*2) @ 0.5 → each output element sums 4 * 2 * 0.5 = 4
+    np.testing.assert_allclose(out[0], np.full((8, 3), 4.0, np.float32))
+
+
+def test_throttled_filter_not_fused(linear_model):
+    desc = DESC.format(m=linear_model).replace(
+        "name=filter", "name=filter throttle=100000")
+    frames = [np.ones((8, 4), np.uint8)] * 2
+    pipe, _ = _run(desc, frames, fuse=True)
+    # transform alone is a 1-element run → no region spliced
+    assert not pipe._regions
+
+
+def test_member_stats_stay_live(linear_model):
+    frames = [np.ones((8, 4), np.uint8)] * 6
+    pipe, _ = _run(DESC.format(m=linear_model), frames, fuse=True)
+    assert pipe.get("filter").get_property("throughput") > 0
+
+
+def test_custom_event_consume_semantics(linear_model):
+    """Events consumed by a member (reload_model) must not leak downstream;
+    events no member consumes must arrive downstream — same as unfused."""
+    from nnstreamer_tpu.pipeline.element import CustomEvent
+
+    pipe = parse_launch(
+        "appsrc name=src ! tensor_transform mode=typecast option=float32 ! "
+        "tensor_filter framework=jax model=fuse_linear name=filter "
+        "is-updatable=true ! tensor_sink name=sink"
+    )
+    sink = pipe.get("sink")
+    seen = []
+    orig = sink.sink_event
+
+    def spy(pad, event):
+        if isinstance(event, CustomEvent):
+            seen.append(event.name)
+        return orig(pad, event)
+
+    sink.sink_event = spy
+    pipe.start()
+    try:
+        region = pipe._regions[0]
+        region._event_entry(region.sinkpad, CustomEvent("app_event", {}))
+        region._event_entry(region.sinkpad,
+                            CustomEvent("reload_model", {}))
+        assert seen == ["app_event"]
+    finally:
+        pipe.stop()
+
+
+def test_restart_reuses_region_safely(linear_model):
+    """stop()/start() must re-pull backend state instead of reusing the
+    program traced over the closed backend."""
+    frames = [np.ones((8, 4), np.uint8)] * 2
+    desc = DESC.format(m=linear_model)
+    pipe = parse_launch(desc)
+    src, sink = pipe.get("src"), pipe.get("sink")
+    pipe.start()
+    src.push([frames[0].copy()])
+    src.end_of_stream()
+    assert pipe.wait(timeout=60).kind == "eos"
+    pipe.stop()
+    first = np.asarray(sink.buffers[-1].tensors[0])
+
+    pipe.start()  # backend re-opened; region must rebuild
+    src.push([frames[1].copy()])
+    src.end_of_stream()
+    assert pipe.wait(timeout=60).kind == "eos"
+    pipe.stop()
+    second = np.asarray(sink.buffers[-1].tensors[0])
+    np.testing.assert_allclose(first, second)
+
+
+def test_sharded_filter_not_fused(linear_model):
+    """Batch-sharded filters keep their NamedSharding placement → unfused."""
+    desc = DESC.format(m=linear_model).replace(
+        "name=filter", "name=filter custom=sharding:batch")
+    frames = [np.ones((8, 4), np.uint8)] * 2
+    pipe, out = _run(desc, frames, fuse=True)
+    assert not pipe._regions
+    np.testing.assert_allclose(out[0], np.full((8, 3), 4.0, np.float32))
+
+
+def test_runtime_throttle_unsplices(linear_model):
+    """Enabling throttle on a PLAYING fused filter must fall back to the
+    member chain (QoS dropping resumes), not kill the pipeline."""
+    pipe = parse_launch(DESC.format(m=linear_model))
+    src, sink = pipe.get("src"), pipe.get("sink")
+    pipe.start()
+    try:
+        frame = np.ones((8, 4), np.uint8)
+        src.push([frame.copy()])
+        sink.wait(1)
+        region = pipe._regions[0]
+        assert not region._dead
+        pipe.get("filter").set_property("throttle", 1000000)
+        src.push([frame.copy()])
+        src.end_of_stream()
+        msg = pipe.wait(timeout=60)
+        assert msg is not None and msg.kind == "eos", msg
+        assert region._dead  # unspliced, stream survived
+        np.testing.assert_allclose(
+            np.asarray(sink.buffers[-1].tensors[0]),
+            np.full((8, 3), 4.0, np.float32))
+    finally:
+        pipe.stop()
+
+
+def test_params_only_reload_keeps_executable(linear_model):
+    """Same model fn + new params must swap consts without re-jitting."""
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu.filters import jax_backend
+
+    fn = jax_backend._registered["fuse_linear"]["fn"]
+    pipe = parse_launch(
+        "appsrc name=src ! tensor_transform mode=typecast option=float32 ! "
+        "tensor_filter framework=jax model=fuse_linear name=filter "
+        "is-updatable=true ! tensor_sink name=sink"
+    )
+    src, sink = pipe.get("src"), pipe.get("sink")
+    pipe.start()
+    try:
+        frame = np.ones((8, 4), np.uint8)
+        src.push([frame.copy()])
+        sink.wait(1)
+        region = pipe._regions[0]
+        jitted_before = region._trace_cache[1]
+
+        register_jax_model("fuse_linear", fn,
+                           jnp.full((4, 3), 2.0, jnp.float32))
+        pipe.get("filter").reload_model()
+        src.push([frame.copy()])
+        src.end_of_stream()
+        assert pipe.wait(timeout=60).kind == "eos"
+        assert region._trace_cache[1] is jitted_before  # no re-jit
+        np.testing.assert_allclose(
+            np.asarray(sink.buffers[-1].tensors[0]),
+            np.full((8, 3), 8.0, np.float32))
+    finally:
+        pipe.stop()
+
+
+def test_reload_inside_region(linear_model):
+    """reload via the member filter must invalidate the compiled region."""
+    import jax.numpy as jnp
+
+    register_jax_model("fuse_linear2",
+                       lambda p, x: x.astype(jnp.float32) @ p,
+                       jnp.full((4, 3), 1.0, jnp.float32))
+    pipe = parse_launch(
+        "appsrc name=src ! "
+        "tensor_transform mode=typecast option=float32 ! "
+        "tensor_filter framework=jax model=fuse_linear name=filter "
+        "is-updatable=true ! tensor_sink name=sink"
+    )
+    src, sink = pipe.get("src"), pipe.get("sink")
+    pipe.start()
+    try:
+        assert pipe._regions
+        frame = np.ones((8, 4), np.uint8)
+        src.push([frame.copy()])
+        sink.wait(1)
+        before = np.asarray(sink.buffers[-1].tensors[0])
+
+        pipe.get("filter").reload_model("fuse_linear2")
+        src.push([frame.copy()])
+        src.end_of_stream()
+        msg = pipe.wait(timeout=60)
+        assert msg is not None and msg.kind == "eos", msg
+        after = np.asarray(sink.buffers[-1].tensors[0])
+        np.testing.assert_allclose(before, np.full((8, 3), 2.0))
+        np.testing.assert_allclose(after, np.full((8, 3), 4.0))
+    finally:
+        pipe.stop()
+        unregister_jax_model("fuse_linear2")
