@@ -179,14 +179,28 @@ def build_forward(cfg: TransformerConfig,
 def transformer_lm(vocab: int = 32000, d_model: int = 512, n_heads: int = 8,
                    n_layers: int = 4, d_ff: int = 2048, seq: int = 256,
                    batch: int = 1, dtype=jnp.bfloat16, num_experts: int = 0,
-                   seed: int = 0
+                   seed: int = 0, attention: str = "auto"
                    ) -> Tuple[Callable, Any, TensorsInfo, TensorsInfo]:
-    """Filter-backend factory (single-device attention path)."""
+    """Filter-backend factory (single-device attention path).
+
+    ``attention``: "auto" uses the Pallas flash kernel on TPU for tileable
+    shapes (ops/flash_attention.py) and XLA attention elsewhere;
+    "reference" forces XLA.
+    """
     cfg = TransformerConfig(vocab=vocab, d_model=d_model, n_heads=n_heads,
                             n_layers=n_layers, d_ff=d_ff, dtype=dtype,
                             num_experts=num_experts)
+    if attention not in ("auto", "reference"):
+        raise ValueError(
+            f"transformer_lm: attention must be 'auto' or 'reference', "
+            f"got {attention!r}")
     params = init_params(cfg, seed)
-    fwd = build_forward(cfg)
+    attention_fn = None
+    if attention == "auto":
+        from nnstreamer_tpu.ops import flash_attention
+
+        attention_fn = lambda q, k, v: flash_attention(q, k, v, causal=True)
+    fwd = build_forward(cfg, attention_fn)
 
     def apply_fn(params, tokens):
         return fwd(params, tokens)

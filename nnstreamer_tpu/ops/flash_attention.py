@@ -1,0 +1,176 @@
+"""Flash attention — tiled online-softmax attention as a Pallas TPU kernel.
+
+Grid is (batch, heads, q_blocks, k_blocks); the TPU executes the trailing
+grid axis sequentially on one core, so the running max/sum/accumulator
+live in VMEM scratch across k-steps while K/V stream through VMEM one
+``block_k`` tile at a time — the [seq, seq] score matrix never exists and
+VMEM holds O(block) state regardless of context length. Causally-dead
+k-tiles are skipped with predicated execution. bfloat16 in/out, fp32
+accumulation — the MXU-friendly shape of the computation.
+
+``flash_attention`` auto-selects: the Pallas kernel on TPU for aligned
+shapes, the jnp reference otherwise (CPU tests, ragged shapes). The same
+online-softmax math also runs *between* chips in
+``parallel.ring.ring_attention``; this kernel is the intra-chip tile of
+that scheme.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+#: scores below this act as -inf without producing exp() NaNs in fully
+#: masked tiles
+_NEG_BIG = -1e30
+
+try:  # pallas import is deferred-safe: CPU-only installs still work
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAVE_PALLAS = True
+except Exception:  # noqa: BLE001
+    _HAVE_PALLAS = False
+
+
+def attention_reference(q, k, v, causal: bool = True):
+    """Plain XLA attention, [batch, seq, heads, dim] layout; fp32 softmax.
+
+    The canonical single-device reference — parallel.ring re-exports this
+    for its unsharded path.
+    """
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        qi = jnp.arange(q.shape[1])[:, None]
+        ki = jnp.arange(k.shape[1])[None, :]
+        s = jnp.where(qi >= ki, s, _NEG_BIG)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return o.astype(q.dtype)
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+            causal: bool, scale: float, block_q: int, block_k: int):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_BIG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # a k-tile is causally dead when its first key comes after the last
+    # query of this q-tile
+    live = True if not causal else ki * block_k <= (qi + 1) * block_q - 1
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32) * scale       # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)               # [bk, d]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_BIG)
+        m_prev = m_scr[:, :1]                              # [bq, 1]
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                             "interpret"))
+def _flash_bhsd(q, k, v, causal: bool, block_q: int, block_k: int,
+                interpret: bool):
+    """Kernel entry on [batch, heads, seq, dim] layout."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    scale = d ** -0.5
+    grid = (b, h, sq // block_q, sk // block_k)
+    kern = functools.partial(_kernel, causal=causal, scale=scale,
+                             block_q=block_q, block_k=block_k)
+    return pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running max
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running sum
+            pltpu.VMEM((block_q, d), jnp.float32),    # output accumulator
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _pallas_ok(q, k, block_q: int, block_k: int) -> bool:
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    return (sq % block_q == 0 and sk % block_k == 0 and
+            block_q % 8 == 0 and block_k % 8 == 0 and
+            d % 8 == 0 and d <= 256)
+
+
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 256,
+                    block_k: int = 256, force: str | None = None):
+    """Attention on [batch, seq, heads, dim] tensors.
+
+    ``force``: None (auto), "pallas" (kernel, interpreted off-TPU), or
+    "reference".
+    """
+    if force == "reference":
+        return attention_reference(q, k, v, causal=causal)
+    block_q = min(block_q, q.shape[1])
+    block_k = min(block_k, k.shape[1])
+    on_tpu = jax.default_backend() == "tpu"
+    tileable = _HAVE_PALLAS and _pallas_ok(q, k, block_q, block_k)
+    if force == "pallas":
+        if not _HAVE_PALLAS:
+            raise RuntimeError(
+                "flash_attention: force='pallas' but jax.experimental."
+                "pallas failed to import on this install")
+        if not tileable:
+            raise ValueError(
+                f"flash_attention: shapes {q.shape}/{k.shape} not tileable "
+                f"by ({block_q},{block_k})")
+    elif not (on_tpu and tileable):
+        return attention_reference(q, k, v, causal=causal)
+    qt = q.swapaxes(1, 2)  # [b, h, s, d]
+    kt = k.swapaxes(1, 2)
+    vt = v.swapaxes(1, 2)
+    out = _flash_bhsd(qt, kt, vt, causal, block_q, block_k,
+                      interpret=not on_tpu)
+    return out.swapaxes(1, 2)

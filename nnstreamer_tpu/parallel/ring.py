@@ -99,16 +99,6 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return out.swapaxes(1, 2).astype(q.dtype)      # [b, sq, h, d]
 
 
-def attention_reference(q, k, v, causal: bool = True) -> jax.Array:
-    """Single-device reference (for tests): plain softmax attention with
-    the same layout [b, s, h, d]."""
-    scale = q.shape[-1] ** -0.5
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
-    if causal:
-        sq, sk = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((sq, sk), bool))
-        s = jnp.where(mask[None, None], s, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
-    return out.astype(q.dtype)
+# canonical single-device reference lives with the flash kernel; re-export
+# for the unsharded path and existing importers
+from nnstreamer_tpu.ops.flash_attention import attention_reference  # noqa: E402,F401

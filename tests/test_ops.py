@@ -1,0 +1,89 @@
+"""Pallas kernel library (nnstreamer_tpu/ops): kernels run in interpret
+mode on CPU and must match their XLA reference implementations."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from nnstreamer_tpu.ops import (
+    dequantize_int8,
+    flash_attention,
+    normalize_u8,
+    quantize_int8,
+)
+from nnstreamer_tpu.ops.flash_attention import attention_reference
+
+
+def _qkv(b=2, s=256, h=2, d=32, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_attention_matches_reference(causal):
+    q, k, v = _qkv()
+    ref = attention_reference(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, force="pallas")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_flash_attention_blocked_causality():
+    """Causality must hold across k-block boundaries, not just inside."""
+    q, k, v = _qkv(b=1, s=256, h=1, d=16, seed=3)
+    out = np.asarray(flash_attention(q, k, v, causal=True, force="pallas",
+                                     block_q=64, block_k=64))
+    # changing future keys must not affect earlier queries
+    k2 = k.at[:, 128:].set(0.0)
+    v2 = v.at[:, 128:].set(0.0)
+    out2 = np.asarray(flash_attention(q, k2, v2, causal=True,
+                                      force="pallas", block_q=64,
+                                      block_k=64))
+    np.testing.assert_allclose(out[:, :128], out2[:, :128],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_flash_attention_auto_fallback_ragged():
+    """Non-tileable shapes silently use the reference path."""
+    q, k, v = _qkv(s=100, d=24)
+    out = flash_attention(q, k, v)  # auto → reference on CPU
+    ref = attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref))
+
+
+def test_normalize_u8_matches_reference():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, 256, (224, 224, 3)), jnp.uint8)
+    ref = np.asarray(((np.asarray(x, np.float32) - 127.5) / 127.5))
+    out = normalize_u8(x, 127.5, 1 / 127.5, jnp.float32, force="pallas")
+    assert out.shape == x.shape and out.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6)
+
+
+def test_normalize_u8_bf16_output():
+    x = jnp.asarray(np.arange(300) % 256, jnp.uint8).reshape(10, 30)
+    out = normalize_u8(x, force="pallas")
+    assert out.dtype == jnp.bfloat16 and out.shape == (10, 30)
+
+
+def test_quantize_reference_roundtrip():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(scale=3.0, size=(64, 128)), jnp.float32)
+    q, scale = quantize_int8(x, force="reference")
+    assert q.dtype == jnp.int8
+    back = dequantize_int8(q, scale)
+    err = np.max(np.abs(np.asarray(back) - np.asarray(x)))
+    assert err <= float(scale[0]) * 0.51
+
+
+def test_quantize_pallas_roundtrip():
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(scale=3.0, size=(64, 128)), jnp.float32)
+    q, scale = quantize_int8(x, force="pallas")
+    assert q.dtype == jnp.int8 and q.shape == x.shape
+    back = dequantize_int8(q, scale)
+    # stochastic dither: per-element error bounded by one quantization step
+    err = np.max(np.abs(np.asarray(back) - np.asarray(x)))
+    assert err <= float(scale[0]) * 1.01
