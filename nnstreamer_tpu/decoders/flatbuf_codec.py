@@ -1,0 +1,147 @@
+"""flatbuf decoder/codec — tensors ↔ FlatBuffers ``Tensors`` tables.
+
+Reference: ``ext/nnstreamer/tensor_decoder/tensordec-flatbuf.cc`` (211 LoC)
+/ ``tensor_converter_flatbuf.cc`` (168 LoC) with the schema from
+``ext/nnstreamer/include/nnstreamer.fbs``:
+
+    table Tensor  { name:string; type:Tensor_type; dimension:[uint32];
+                    data:[ubyte]; }
+    table Tensors { num_tensor:int; fr:frame_rate(struct);
+                    tensor:[Tensor]; format:Tensor_format; }
+
+Built directly with the ``flatbuffers`` runtime Builder/Table APIs — no
+flatc-generated code is shipped; slot numbers follow schema declaration
+order (field n ↦ vtable offset 4+2n).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from nnstreamer_tpu.pipeline.caps import Caps
+from nnstreamer_tpu.registry import CONVERTER, DECODER, subplugin
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
+from nnstreamer_tpu.tensors.types import TensorInfo, TensorType
+
+_TYPE_ORDER = list(TensorType)
+
+try:
+    import flatbuffers
+    from flatbuffers import number_types as _N
+
+    _HAVE_FLATBUFFERS = True
+except ImportError:
+    _HAVE_FLATBUFFERS = False
+
+
+def _require():
+    if not _HAVE_FLATBUFFERS:
+        raise RuntimeError("flatbuf codec requires the 'flatbuffers' "
+                           "package, which failed to import")
+
+
+def encode_flatbuf(buf: TensorBuffer, rate=None) -> bytes:
+    _require()
+    b = flatbuffers.Builder(1024)
+    host = buf.to_host()
+    tensor_offs = []
+    for t in host.tensors:
+        info = TensorInfo.from_array(t)
+        data_off = b.CreateByteVector(np.ascontiguousarray(t).tobytes())
+        dims = list(info.dim)
+        b.StartVector(4, len(dims), 4)
+        for d in reversed(dims):
+            b.PrependUint32(d)
+        dim_off = b.EndVector()
+        name_off = b.CreateString("")
+        b.StartObject(4)
+        b.PrependUOffsetTRelativeSlot(0, name_off, 0)
+        b.PrependInt32Slot(1, _TYPE_ORDER.index(info.type), len(_TYPE_ORDER))
+        b.PrependUOffsetTRelativeSlot(2, dim_off, 0)
+        b.PrependUOffsetTRelativeSlot(3, data_off, 0)
+        tensor_offs.append(b.EndObject())
+    b.StartVector(4, len(tensor_offs), 4)
+    for off in reversed(tensor_offs):
+        b.PrependUOffsetTRelative(off)
+    vec_off = b.EndVector()
+    b.StartObject(4)
+    b.PrependInt32Slot(0, host.num_tensors, 0)
+    if rate is not None:
+        # frame_rate struct is stored inline in the table; accepts the
+        # framework Fraction (.num/.den) or the stdlib one
+        num = getattr(rate, "num", None)
+        den = getattr(rate, "den", None)
+        if num is None:
+            num, den = rate.numerator, rate.denominator
+        b.Prep(4, 8)
+        b.PrependInt32(int(den))
+        b.PrependInt32(int(num))
+        b.PrependStructSlot(1, b.Offset(), 0)
+    b.PrependUOffsetTRelativeSlot(2, vec_off, 0)
+    b.PrependInt32Slot(3, 0, 0)  # NNS_TENSOR_FORAMT_STATIC
+    b.Finish(b.EndObject())
+    return bytes(b.Output())
+
+
+def decode_flatbuf(blob: bytes) -> TensorBuffer:
+    _require()
+    data = bytearray(blob)
+    root = flatbuffers.encode.Get(_N.UOffsetTFlags.packer_type, data, 0)
+    tab = flatbuffers.Table(data, root)
+    tensors = []
+    vec = tab.Offset(8)  # slot 2: tensor vector
+    if vec:
+        n = tab.VectorLen(vec)
+        base = tab.Vector(vec)
+        for i in range(n):
+            sub_pos = tab.Indirect(base + i * 4)
+            sub = flatbuffers.Table(data, sub_pos)
+            t_off = sub.Offset(6)  # slot 1: type
+            # an absent field means the schema default, enum value 0 =
+            # NNS_INT32 — external flatc encoders omit default fields
+            type_idx = sub.Get(_N.Int32Flags, t_off + sub.Pos) if t_off \
+                else 0
+            ttype = _TYPE_ORDER[type_idx]
+            d_off = sub.Offset(8)  # slot 2: dimension
+            dims = []
+            if d_off:
+                dn = sub.VectorLen(d_off)
+                dbase = sub.Vector(d_off)
+                dims = [sub.Get(_N.Uint32Flags, dbase + j * 4)
+                        for j in range(dn)]
+            b_off = sub.Offset(10)  # slot 3: data
+            if b_off:
+                start = sub.Vector(b_off)
+                length = sub.VectorLen(b_off)
+                raw = bytes(data[start:start + length])
+            else:
+                raw = b""
+            shape = tuple(reversed(dims))
+            tensors.append(np.frombuffer(raw, ttype.np_dtype).reshape(shape))
+    return TensorBuffer(tensors)
+
+
+@subplugin(DECODER, "flatbuf")
+class FlatbufDecoder:
+    """tensors → serialized flatbuffer (other/flatbuf-tensor stream)."""
+
+    def out_caps(self, config, options) -> Caps:
+        return Caps("other/flatbuf-tensor")
+
+    def decode(self, buf: TensorBuffer, config, options) -> TensorBuffer:
+        blob = encode_flatbuf(buf, rate=getattr(config, "rate", None))
+        return buf.with_tensors(
+            [np.frombuffer(blob, np.uint8)])
+
+
+@subplugin(CONVERTER, "flatbuf")
+class FlatbufConverter:
+    """serialized flatbuffer stream → other/tensors."""
+
+    def get_out_config(self, caps):
+        return None
+
+    def convert(self, buf: TensorBuffer, in_caps) -> TensorBuffer:
+        blob = np.ascontiguousarray(buf.to_host()[0]).tobytes()
+        out = decode_flatbuf(blob)
+        return out.replace(pts=buf.pts, meta=dict(buf.meta))

@@ -27,3 +27,6 @@ from nnstreamer_tpu.elements import repo  # noqa: F401
 from nnstreamer_tpu.elements import sparse  # noqa: F401
 from nnstreamer_tpu.elements import query  # noqa: F401
 from nnstreamer_tpu.elements import pubsub  # noqa: F401
+
+from nnstreamer_tpu.elements import grpc_io  # noqa: F401 (grpcio itself
+# is imported lazily inside the elements' start())

@@ -73,10 +73,11 @@ class TensorConverter(Element):
         mode = self.get_property("mode")
         if mode:  # custom converter owns the output config
             name = mode.split(":", 1)[1] if ":" in mode else mode
-            self._custom = get_subplugin(CONVERTER, name)
-            if self._custom is None:
+            impl = get_subplugin(CONVERTER, name)
+            if impl is None:
                 raise ValueError(f"tensor_converter: no converter subplugin "
                                  f"{name!r}")
+            self._custom = impl() if isinstance(impl, type) else impl
             out = getattr(self._custom, "get_out_config", lambda c: None)(caps)
             return out
         rate = Fraction.parse(caps.get("framerate", "0/1"))

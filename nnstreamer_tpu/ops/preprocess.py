@@ -18,7 +18,9 @@ import functools
 
 import jax
 import jax.numpy as jnp
-import numpy as np
+
+from nnstreamer_tpu.ops.tiling import BLOCK_ROWS as _BLOCK_ROWS
+from nnstreamer_tpu.ops.tiling import LANES as _LANES
 
 try:
     from jax.experimental import pallas as pl
@@ -27,8 +29,6 @@ try:
     _HAVE_PALLAS = True
 except Exception:  # noqa: BLE001
     _HAVE_PALLAS = False
-
-_LANES = 128
 
 
 def _normalize_reference(x, mean: float, scale: float, out_dtype):
@@ -43,9 +43,6 @@ def _kernel(x_ref, mean_ref, scale_ref, o_ref):
         # Mosaic has no direct uint8→float32 cast; widen via int32
         x = x.astype(jnp.int32)
     o_ref[:] = ((x.astype(jnp.float32) - mean) * scale).astype(o_ref.dtype)
-
-
-_BLOCK_ROWS = 256
 
 
 @functools.partial(jax.jit, static_argnames=("out_dtype", "interpret"))
@@ -82,15 +79,11 @@ def normalize_u8(x, mean: float = 127.5, scale: float = 1.0 / 127.5,
     if not use_pallas or force == "reference":
         return _normalize_reference(x, mean, scale, out_dtype)
 
-    n = int(np.prod(x.shape))
-    pad = (-n) % (_LANES * _BLOCK_ROWS)
-    flat = jnp.ravel(x)
-    if pad:
-        flat = jnp.concatenate([flat, jnp.zeros((pad,), x.dtype)])
-    x2 = flat.reshape(-1, _LANES)
+    from nnstreamer_tpu.ops.tiling import pad_to_tiles, unpad_from_tiles
+
+    x2, n = pad_to_tiles(x)
     mean_s = jnp.array([[mean]], jnp.float32)
     scale_s = jnp.array([[scale]], jnp.float32)
     out2 = _normalize_2d(x2, mean_s, scale_s, jnp.dtype(out_dtype).name,
                          interpret=not on_tpu)
-    out = out2.reshape(-1)[:n].reshape(x.shape)
-    return out
+    return unpad_from_tiles(out2, n, x.shape)

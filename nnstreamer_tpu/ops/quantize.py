@@ -14,6 +14,9 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from nnstreamer_tpu.ops.tiling import BLOCK_ROWS as _BLOCK_ROWS
+from nnstreamer_tpu.ops.tiling import LANES as _LANES
+
 try:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -21,9 +24,6 @@ try:
     _HAVE_PALLAS = True
 except Exception:  # noqa: BLE001
     _HAVE_PALLAS = False
-
-_LANES = 128
-_BLOCK_ROWS = 256
 
 
 def _quantize_reference(x):
@@ -110,17 +110,11 @@ def quantize_int8(x, seed: int = 0, force: str | None = None):
     if not use_pallas or force == "reference":
         return _quantize_reference(x)
 
-    import numpy as np
+    from nnstreamer_tpu.ops.tiling import pad_to_tiles, unpad_from_tiles
 
     xf = x.astype(jnp.float32)
     scale = jnp.maximum(jnp.max(jnp.abs(xf)) / 127.0, 1e-30).reshape(1)
-    n = int(np.prod(x.shape))
-    pad = (-n) % (_LANES * _BLOCK_ROWS)
-    flat = jnp.ravel(xf)
-    if pad:
-        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
-    x2 = flat.reshape(-1, _LANES)
+    x2, n = pad_to_tiles(xf)
     q2 = _quantize_2d(x2, scale, jnp.array([seed], jnp.int32),
                       interpret=not on_tpu)
-    q = q2.reshape(-1)[:n].reshape(x.shape)
-    return q, scale
+    return unpad_from_tiles(q2, n, x.shape), scale

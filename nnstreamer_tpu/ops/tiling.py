@@ -1,0 +1,32 @@
+"""Shared lane/row tiling helpers for elementwise Pallas kernels.
+
+TPU VPU tiles are (sublane, 128-lane); elementwise kernels here flatten
+any-shape arrays to a (rows, 128) layout padded to a whole number of
+kernel row-blocks, run the grid, and strip the padding.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+LANES = 128
+BLOCK_ROWS = 256
+
+
+def pad_to_tiles(x, dtype=None):
+    """Flatten + zero-pad to (N*BLOCK_ROWS, LANES); returns (x2d, n_valid)."""
+    if dtype is not None:
+        x = x.astype(dtype)
+    n = int(np.prod(x.shape))
+    pad = (-n) % (LANES * BLOCK_ROWS)
+    flat = jnp.ravel(x)
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat.reshape(-1, LANES), n
+
+
+def unpad_from_tiles(x2d, n_valid: int, shape):
+    """Inverse of :func:`pad_to_tiles`."""
+    return x2d.reshape(-1)[:n_valid].reshape(shape)

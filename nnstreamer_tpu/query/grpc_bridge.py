@@ -1,0 +1,189 @@
+"""gRPC TensorService — the DCN-facing streaming bridge.
+
+Reference: ``ext/nnstreamer/extra/nnstreamer_grpc_*`` (NNStreamerRPC class,
+nnstreamer_grpc_common.h:32) exposing ``TensorService`` from
+``ext/nnstreamer/include/nnstreamer.proto:43-49``:
+
+    service TensorService {
+      rpc SendTensors (stream Tensors) returns (Empty);   // client→server
+      rpc RecvTensors (Empty) returns (stream Tensors);   // server→client
+    }
+
+Same service shape here, built on grpcio generic handlers with the
+framework's own wire codecs as (de)serializers — protobuf ``Tensors``
+messages (decoders/protobuf_codec.py, wire-compatible field layout) or
+flexbuf (``idl`` option), no generated stubs. In the TPU deployment this
+is the DCN ingress/egress: frames arrive over gRPC, flow device-resident
+through the pipeline, and results stream back; intra-slice movement is
+XLA collectives, never this path (SURVEY §5 distributed-backend mapping).
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from concurrent import futures
+from typing import Callable, Iterator, Optional
+
+from nnstreamer_tpu.log import get_logger
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
+
+log = get_logger("grpc")
+
+SERVICE = "nnstreamer.protobuf.TensorService"
+
+
+def _codecs(idl: str):
+    """(encode: TensorBuffer→bytes, decode: bytes→TensorBuffer) per IDL."""
+    if idl == "protobuf":
+        from nnstreamer_tpu.decoders.protobuf_codec import (
+            decode_protobuf,
+            encode_protobuf,
+        )
+
+        return encode_protobuf, decode_protobuf
+    if idl == "flexbuf":
+        from nnstreamer_tpu.decoders.flexbuf import decode_flex, encode_flex
+
+        return encode_flex, decode_flex
+    if idl == "flatbuf":
+        from nnstreamer_tpu.decoders.flatbuf_codec import (
+            decode_flatbuf,
+            encode_flatbuf,
+        )
+
+        return encode_flatbuf, decode_flatbuf
+    raise ValueError(f"grpc: unknown idl {idl!r} (protobuf|flexbuf|flatbuf)")
+
+
+def _noop_serializer(_) -> bytes:  # Empty message
+    return b""
+
+
+def _noop_deserializer(raw: bytes) -> bytes:
+    # grpcio interprets a None deserializer result as a failure, so the
+    # Empty message round-trips as the empty byte string
+    return raw or b""
+
+
+class TensorServiceServer:
+    """Hosts TensorService; hands received buffers to ``on_recv`` and
+    streams buffers from an internal queue to RecvTensors callers."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 idl: str = "protobuf",
+                 on_recv: Optional[Callable[[TensorBuffer], None]] = None):
+        import grpc
+
+        self._encode, self._decode = _codecs(idl)
+        self.on_recv = on_recv
+        # bounded with drop-oldest: a server with no (or a slow)
+        # RecvTensors subscriber must not grow without bound at video rate
+        self._sendq: _queue.Queue = _queue.Queue(maxsize=64)
+        self._stop = threading.Event()
+
+        def send_tensors(request_iterator, context):
+            # client→server stream; requests arrive already decoded
+            for buf in request_iterator:
+                if self.on_recv is not None:
+                    self.on_recv(buf)
+            return b""  # Empty
+
+        def recv_tensors(request, context):
+            # server→client stream from the send queue
+            while not self._stop.is_set():
+                try:
+                    item = self._sendq.get(timeout=0.1)
+                except _queue.Empty:
+                    continue
+                if item is None:
+                    return
+                yield item
+
+        handler = grpc.method_handlers_generic_handler(SERVICE, {
+            "SendTensors": grpc.stream_unary_rpc_method_handler(
+                send_tensors,
+                request_deserializer=self._decode,
+                response_serializer=_noop_serializer,
+            ),
+            "RecvTensors": grpc.unary_stream_rpc_method_handler(
+                recv_tensors,
+                request_deserializer=_noop_deserializer,
+                response_serializer=self._encode,
+            ),
+        })
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        self._server.add_generic_rpc_handlers((handler,))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        if self.port == 0:
+            raise RuntimeError(f"grpc: cannot bind {host}:{port}")
+
+    def start(self):
+        self._server.start()
+        log.info("TensorService listening on :%d", self.port)
+        return self
+
+    def send(self, buf: TensorBuffer) -> None:
+        """Queue a buffer for RecvTensors streams (drops oldest on
+        backpressure, like a leaky downstream queue)."""
+        while True:
+            try:
+                self._sendq.put_nowait(buf)
+                return
+            except _queue.Full:
+                try:
+                    self._sendq.get_nowait()
+                except _queue.Empty:
+                    pass
+
+    def stop(self, grace: float = 1.0):
+        self._stop.set()
+        self._sendq.put(None)
+        self._server.stop(grace)
+
+
+class TensorServiceClient:
+    """Client side: stream buffers up (SendTensors) or down (RecvTensors)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 idl: str = "protobuf"):
+        import grpc
+
+        self._encode, self._decode = _codecs(idl)
+        self.target = f"{host}:{port}"
+        self._channel = grpc.insecure_channel(self.target)
+        self._send_rpc = self._channel.stream_unary(
+            f"/{SERVICE}/SendTensors",
+            request_serializer=self._encode,
+            response_deserializer=_noop_deserializer,
+        )
+        self._recv_rpc = self._channel.unary_stream(
+            f"/{SERVICE}/RecvTensors",
+            request_serializer=_noop_serializer,
+            response_deserializer=self._decode,
+        )
+
+    def __del__(self):  # best-effort channel cleanup
+        try:
+            self._channel.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+    def wait_ready(self, timeout: float = 10.0):
+        import grpc
+
+        grpc.channel_ready_future(self._channel).result(timeout=timeout)
+        return self
+
+    def send_stream(self, buffers: Iterator[TensorBuffer],
+                    timeout: Optional[float] = None) -> None:
+        """Stream buffers to the server (blocks until the server acks)."""
+        self._send_rpc(iter(buffers), timeout=timeout)
+
+    def recv_stream(self, timeout: Optional[float] = None
+                    ) -> Iterator[TensorBuffer]:
+        """Iterate buffers streamed by the server."""
+        return self._recv_rpc(None, timeout=timeout)
+
+    def close(self):
+        self._channel.close()
