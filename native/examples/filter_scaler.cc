@@ -1,0 +1,93 @@
+// Example native custom filter: elementwise scale (+passthrough).
+//
+// The reference ships custom-filter .so scaffolding as its fake-NN test
+// backbone (tests/nnstreamer_example/custom_example_scaler/
+// nnscustom_example_scaler.c); this is the same role for the TPU
+// framework's native filter ABI. `custom` property grammar: "scale:<f>"
+// (default 1.0 — passthrough). float32 tensors are scaled; any other
+// dtype passes through unchanged.
+//
+// Build: make -C native examples  (→ libnnstpu_filter_scaler.so)
+
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "../nnstpu_filter.h"
+
+namespace {
+
+struct Scaler {
+  float scale = 1.0f;
+  nnstpu_tensors_info in_info{};  // captured at set_input_info
+};
+
+void* scaler_open(const char* custom_props) {
+  auto* s = new Scaler();
+  if (custom_props != nullptr) {
+    std::string props(custom_props);
+    auto pos = props.find("scale:");
+    if (pos != std::string::npos)
+      s->scale = std::strtof(props.c_str() + pos + 6, nullptr);
+  }
+  return s;
+}
+
+void scaler_close(void* h) { delete static_cast<Scaler*>(h); }
+
+int scaler_get_model_info(void*, nnstpu_tensors_info* in_info,
+                          nnstpu_tensors_info* out_info) {
+  in_info->num_tensors = 0;   // adapts to any stream
+  out_info->num_tensors = 0;
+  return 0;
+}
+
+int scaler_set_input_info(void* h, const nnstpu_tensors_info* in_info,
+                          nnstpu_tensors_info* out_info) {
+  auto* s = static_cast<Scaler*>(h);
+  s->in_info = *in_info;
+  *out_info = *in_info;  // shape/type preserving
+  return 0;
+}
+
+size_t elem_count(const nnstpu_tensor_info& ti) {
+  size_t n = 1;
+  for (uint32_t d = 0; d < ti.rank; d++) n *= ti.dims[d];
+  return n;
+}
+
+size_t dtype_size(int32_t dtype) {
+  switch (dtype) {
+    case 4: case 5: return 1;               // int8/uint8
+    case 2: case 3: case 10: case 11: return 2;  // int16/uint16/f16/bf16
+    case 0: case 1: case 7: return 4;       // int32/uint32/float32
+    default: return 8;                      // 64-bit types
+  }
+}
+
+int scaler_invoke(void* h, const void* const* inputs, void* const* outputs) {
+  auto* s = static_cast<Scaler*>(h);
+  for (uint32_t t = 0; t < s->in_info.num_tensors; t++) {
+    const nnstpu_tensor_info& ti = s->in_info.info[t];
+    size_t n = elem_count(ti);
+    if (ti.dtype == 7) {  // float32: scale
+      const float* in = static_cast<const float*>(inputs[t]);
+      float* out = static_cast<float*>(outputs[t]);
+      for (size_t i = 0; i < n; i++) out[i] = in[i] * s->scale;
+    } else {  // other dtypes: passthrough
+      std::memcpy(outputs[t], inputs[t], n * dtype_size(ti.dtype));
+    }
+  }
+  return 0;
+}
+
+const nnstpu_filter_vtable kVtable = {
+    NNSTPU_FILTER_ABI,    scaler_open,           scaler_close,
+    scaler_get_model_info, scaler_set_input_info, scaler_invoke,
+};
+
+}  // namespace
+
+extern "C" const nnstpu_filter_vtable* nnstpu_filter_get_vtable(void) {
+  return &kVtable;
+}

@@ -1,0 +1,76 @@
+// nnstpu_filter.h — C ABI for native custom filter subplugins.
+//
+// The reference's native extension points are tensor_filter_custom (user
+// .so with a C vtable, gst/nnstreamer/tensor_filter/tensor_filter_custom.c
+// + include/tensor_filter_custom.h) and the header-only C++ class API
+// (include/nnstreamer_cppplugin_api_filter.hh). This header is the TPU
+// framework's equivalent contract: a shared object exports
+//
+//     const nnstpu_filter_vtable* nnstpu_filter_get_vtable(void);
+//
+// and the Python runtime (nnstreamer_tpu/filters/native_filter.py) dlopens
+// it and drives open → info negotiation → invoke×N → close. Tensors cross
+// the boundary as raw host pointers (caller-allocated outputs), so invoke
+// runs entirely outside the GIL.
+
+#ifndef NNSTPU_FILTER_H_
+#define NNSTPU_FILTER_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define NNSTPU_FILTER_ABI 1
+#define NNSTPU_MAX_TENSORS 16
+#define NNSTPU_MAX_RANK 8
+
+// dtype codes follow the framework's TensorType declaration order
+// (nnstreamer_tpu/tensors/types.py; matches the reference's tensor_type,
+// tensor_typedef.h): int32, uint32, int16, uint16, int8, uint8, float64,
+// float32, int64, uint64, float16, bfloat16 (TPU addition).
+typedef struct {
+  uint32_t rank;
+  uint32_t dims[NNSTPU_MAX_RANK];  // row-major (numpy shape order)
+  int32_t dtype;
+} nnstpu_tensor_info;
+
+typedef struct {
+  uint32_t num_tensors;
+  nnstpu_tensor_info info[NNSTPU_MAX_TENSORS];
+} nnstpu_tensors_info;
+
+typedef struct {
+  int abi_version;  // must be NNSTPU_FILTER_ABI
+
+  // Instantiate with the element's `custom` property string (may be NULL).
+  // Returns an opaque handle, or NULL on failure.
+  void* (*open)(const char* custom_props);
+
+  void (*close)(void* handle);
+
+  // Fill static model info. Either side may be left with num_tensors == 0
+  // meaning "adapts to the negotiated stream" (then set_input_info runs).
+  int (*get_model_info)(void* handle, nnstpu_tensors_info* in_info,
+                        nnstpu_tensors_info* out_info);
+
+  // Given negotiated input shapes, fill output shapes. Optional (NULL) if
+  // get_model_info is fully static.
+  int (*set_input_info)(void* handle, const nnstpu_tensors_info* in_info,
+                        nnstpu_tensors_info* out_info);
+
+  // Run one frame. inputs/outputs are arrays of num_tensors raw pointers;
+  // output buffers are caller-allocated per the negotiated out info.
+  int (*invoke)(void* handle, const void* const* inputs, void* const* outputs);
+} nnstpu_filter_vtable;
+
+// Every filter .so exports exactly this symbol.
+typedef const nnstpu_filter_vtable* (*nnstpu_filter_get_vtable_fn)(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  // NNSTPU_FILTER_H_

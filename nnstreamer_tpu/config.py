@@ -44,7 +44,7 @@ DEFAULT_EXT_PRIORITY: Dict[str, List[str]] = {
     ".pt2": ["torch"],
     ".tflite": ["tflite", "jax"],
     ".py": ["python"],
-    ".so": ["custom"],
+    ".so": ["native", "custom"],
 }
 
 

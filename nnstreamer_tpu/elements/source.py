@@ -336,74 +336,293 @@ class AppSrc(SourceElement):
         return None
 
 
+class IIOChannel:
+    """One scan element: name, index and packed-sample format.
+
+    The format descriptor mirrors the kernel's ``in_*_type`` files,
+    ``[be|le]:[s|u]BITS/STORAGE>>SHIFT`` (the reference parses these in
+    gsttensorsrciio.c's channel probe): STORAGE bits on the wire, BITS of
+    real data after right-shifting by SHIFT, signed or unsigned.
+    """
+
+    def __init__(self, name: str, index: int, fmt: str,
+                 scale: float = 1.0, offset: float = 0.0):
+        self.name = name
+        self.index = index
+        self.scale = scale
+        self.offset = offset
+        endian, rest = fmt.strip().split(":")
+        self.big_endian = endian == "be"
+        self.signed = rest[0] == "s"
+        bits, rest = rest[1:].split("/")
+        storage, shift = (rest.split(">>") + ["0"])[:2]
+        self.bits = int(bits)
+        self.storage_bits = int(storage)
+        self.shift = int(shift)
+        if self.storage_bits % 8 or self.storage_bits not in (8, 16, 32, 64):
+            raise ValueError(f"iio: unsupported storage {fmt!r}")
+
+    @property
+    def storage_bytes(self) -> int:
+        return self.storage_bits // 8
+
+    def extract(self, raw: np.ndarray) -> np.ndarray:
+        """Packed storage words → scaled float32 values."""
+        dt = np.dtype(f"{'>' if self.big_endian else '<'}u"
+                      f"{self.storage_bytes}")
+        words = raw.view(dt).astype(np.uint64) >> np.uint64(self.shift)
+        vals = words & np.uint64((1 << self.bits) - 1)
+        if self.signed:
+            if self.bits == 64:  # e.g. the kernel timestamp channel s64/64
+                vals = vals.view(np.int64)
+            else:
+                # branchless sign-extend: (v XOR sign) - sign
+                sign = np.int64(1) << np.int64(self.bits - 1)
+                vals = (vals.astype(np.int64) ^ sign) - sign
+        return ((vals.astype(np.float64) + self.offset) *
+                self.scale).astype(np.float32)
+
+
 @subplugin(ELEMENT, "tensor_src_iio")
 class TensorSrcIIO(SourceElement):
     """Linux Industrial-I/O sensor source (reference ``tensor_src_iio``,
-    gst/nnstreamer/elements/gsttensorsrciio.c:18-52).
+    gst/nnstreamer/elements/gsttensorsrciio.c, 2604 LoC).
 
-    Reads sampled channels from ``/sys/bus/iio/devices`` + ``/dev/iio:deviceX``
-    and emits ``other/tensors`` frames [channels, buffer_capacity]. On hosts
-    without IIO hardware (every TPU VM), ``mode=mock`` provides a
-    deterministic synthetic device so pipelines and tests still run — the
-    reference's EdgeTPU ``device_type:dummy`` pattern.
+    ``mode=device`` follows the reference's buffered-capture flow: probe
+    ``<base-dir>/iio:deviceN`` sysfs (scan_elements ``in_*_{en,index,type}``
+    plus per-channel scale/offset), enable channels, set
+    ``sampling_frequency`` and ``buffer/length``, then read packed scans
+    from ``<dev-dir>/iio:deviceN`` and demux each enabled channel by its
+    type descriptor into a [channels, buffer_capacity] float32 tensor.
+    ``base-dir``/``dev-dir`` default to the real kernel paths and are
+    test-overridable (a mock sysfs tree replaces real hardware, the
+    reference's dummy-device pattern). ``mode=mock`` needs no filesystem
+    at all and synthesizes deterministic sine channels.
     """
 
     ELEMENT_NAME = "tensor_src_iio"
     PROPERTIES = {
         **SourceElement.PROPERTIES,
-        "mode": "mock",  # "device" reads sysfs; "mock" synthesizes
-        "device": None,
+        "mode": "mock",  # "device" reads sysfs+devnode; "mock" synthesizes
+        "device": None,            # device name (resolved to a number)
         "device_number": -1,
+        "base_dir": "/sys/bus/iio/devices",
+        "dev_dir": "/dev",
         "frequency": 100,
         "buffer_capacity": 1,
-        "channels": 2,
+        "channels": "auto",        # "auto"|comma list of channel names
         "num_buffers": -1,
+        "poll_timeout_ms": 1000,
     }
-
-    _IIO_BASE = "/sys/bus/iio/devices"
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self.i = 0
+        self._chans: list[IIOChannel] = []
+        self._chan_offsets: list[int] = []
+        self._scan_bytes = 0
+        self._fh = None
+
+    # -- sysfs probing -------------------------------------------------------
+    def _device_dir(self) -> str:
+        base = self.get_property("base_dir")
+        num = int(self.get_property("device_number"))
+        want = self.get_property("device")
+        if num < 0 and want:
+            for d in sorted(glob.glob(os.path.join(base, "iio:device*"))):
+                try:
+                    with open(os.path.join(d, "name")) as f:
+                        if f.read().strip() == want:
+                            return d
+                except OSError:
+                    continue
+            raise FileNotFoundError(f"tensor_src_iio: no device named "
+                                    f"{want!r} under {base}")
+        d = os.path.join(base, f"iio:device{max(num, 0)}")
+        if not os.path.isdir(d):
+            raise FileNotFoundError(
+                f"tensor_src_iio: {d} not found (use mode=mock on hosts "
+                f"without IIO hardware)")
+        return d
+
+    @staticmethod
+    def _read_sysfs(path: str, default: Optional[str] = None) -> str:
+        try:
+            with open(path) as f:
+                return f.read().strip()
+        except OSError:
+            if default is None:
+                raise
+            return default
+
+    @staticmethod
+    def _write_sysfs(path: str, value) -> None:
+        try:
+            with open(path, "w") as f:
+                f.write(str(value))
+        except OSError:
+            pass  # read-only attribute (fixed-rate sensors)
+
+    def _probe_channels(self, dev_dir: str) -> list[IIOChannel]:
+        scan = os.path.join(dev_dir, "scan_elements")
+        sel = self.get_property("channels")
+        # "auto" → all; an integer → first N by scan index (the element's
+        # original numeric contract); otherwise a comma list of names
+        wanted = None
+        limit = None
+        if sel not in (None, "auto"):
+            if str(sel).isdigit():
+                limit = int(sel)
+            else:
+                wanted = {c.strip() for c in str(sel).split(",")}
+        probed = []
+        for en_path in sorted(glob.glob(os.path.join(scan, "in_*_en"))):
+            cname = os.path.basename(en_path)[len("in_"):-len("_en")]
+            idx = int(self._read_sysfs(
+                os.path.join(scan, f"in_{cname}_index"), "0"))
+            fmt = self._read_sysfs(os.path.join(scan, f"in_{cname}_type"))
+            scale = float(self._read_sysfs(
+                os.path.join(dev_dir, f"in_{cname}_scale"), "1.0"))
+            offset = float(self._read_sysfs(
+                os.path.join(dev_dir, f"in_{cname}_offset"), "0.0"))
+            probed.append((en_path, IIOChannel(cname, idx, fmt, scale,
+                                               offset)))
+        probed.sort(key=lambda pair: pair[1].index)
+        chans = []
+        for pos, (en_path, ch) in enumerate(probed):
+            enable = ((wanted is None or ch.name in wanted) and
+                      (limit is None or pos < limit))
+            self._write_sysfs(en_path, 1 if enable else 0)
+            if enable:
+                chans.append(ch)
+        if not chans:
+            raise ValueError(f"tensor_src_iio: no scan channels enabled "
+                             f"under {scan}")
+        return chans
+
+    def start(self):
+        super().start()
+        self.i = 0
+        if self.get_property("mode") != "device":
+            return
+        dev_dir = self._device_dir()
+        self._chans = self._probe_channels(dev_dir)
+        # kernel scan layout: each element sits at an offset aligned to its
+        # own storage size (index order); the whole scan pads to the widest
+        # element's alignment
+        off = 0
+        self._chan_offsets = []
+        for c in self._chans:
+            sb = c.storage_bytes
+            off = (off + sb - 1) // sb * sb
+            self._chan_offsets.append(off)
+            off += sb
+        widest = max(c.storage_bytes for c in self._chans)
+        self._scan_bytes = (off + widest - 1) // widest * widest
+        cap = int(self.get_property("buffer_capacity"))
+        self._write_sysfs(os.path.join(dev_dir, "sampling_frequency"),
+                          int(self.get_property("frequency")))
+        self._write_sysfs(os.path.join(dev_dir, "buffer", "length"), cap)
+        self._write_sysfs(os.path.join(dev_dir, "buffer", "enable"), 1)
+        node = os.path.join(self.get_property("dev_dir"),
+                            os.path.basename(dev_dir))
+        self._fh = open(node, "rb", buffering=0)
+
+    def stop(self):
+        # signal the streaming thread FIRST so _read_scans exits its loop
+        # before the handle goes away
+        self._stop_evt.set()
+        if self._fh is not None:
+            fh, self._fh = self._fh, None
+            fh.close()
+            if self.get_property("mode") == "device":
+                try:
+                    self._write_sysfs(
+                        os.path.join(self._device_dir(), "buffer", "enable"),
+                        0)
+                except FileNotFoundError:
+                    pass
+        super().stop()
+
+    # -- negotiation ---------------------------------------------------------
+    def _num_channels(self) -> int:
+        if self.get_property("mode") == "device":
+            return len(self._chans)
+        sel = self.get_property("channels")
+        return 2 if sel in (None, "auto") else (
+            int(sel) if str(sel).isdigit() else len(str(sel).split(",")))
 
     def negotiate(self):
         from nnstreamer_tpu.tensors.types import TensorsConfig, TensorsInfo
 
-        ch = int(self.get_property("channels"))
+        ch = self._num_channels()
         cap = int(self.get_property("buffer_capacity"))
         info = TensorsInfo.from_str(f"{ch}:{cap}", "float32")
-        cfg = TensorsConfig(info=info,
-                            rate=Fraction(int(self.get_property("frequency")), 1))
+        cfg = TensorsConfig(
+            info=info,
+            rate=Fraction(int(self.get_property("frequency")), 1))
         self.srcpad.set_caps(cfg.to_caps())
 
-    def _read_device(self) -> Optional[np.ndarray]:
-        num = int(self.get_property("device_number"))
-        dev_dir = os.path.join(self._IIO_BASE, f"iio:device{num}")
-        if not os.path.isdir(dev_dir):
-            raise FileNotFoundError(
-                f"tensor_src_iio: no IIO device {num} (use mode=mock on "
-                f"hosts without IIO hardware)"
-            )
-        ch = int(self.get_property("channels"))
-        cap = int(self.get_property("buffer_capacity"))
-        vals = np.zeros((cap, ch), np.float32)
-        in_files = sorted(glob.glob(os.path.join(dev_dir, "in_*_raw")))[:ch]
-        for j in range(cap):
-            for c, f in enumerate(in_files):
-                with open(f) as fh:
-                    vals[j, c] = float(fh.read().strip())
-        return vals
+    # -- capture -------------------------------------------------------------
+    def _read_scans(self, cap: int) -> Optional[np.ndarray]:
+        """Read ``cap`` packed scans and demux → [cap, channels] f32.
+
+        ``poll-timeout-ms`` bounds the wait for each buffer (reference
+        poll() on the char device); a quiet sensor ends the stream instead
+        of hanging stop() forever.
+        """
+        import select
+
+        need = self._scan_bytes * cap
+        deadline = time.monotonic() + \
+            max(1, int(self.get_property("poll_timeout_ms"))) / 1e3
+        data = b""
+        while len(data) < need and not self._stop_evt.is_set():
+            fh = self._fh
+            if fh is None:
+                return None
+            left = deadline - time.monotonic()
+            if left <= 0:
+                self.log.warning("poll timeout (%d bytes of %d)",
+                                 len(data), need)
+                return None
+            try:
+                ready, _, _ = select.select([fh], [], [], min(0.1, left))
+            except (OSError, ValueError):
+                return None  # handle closed during stop
+            if not ready:
+                continue
+            try:
+                chunk = fh.read(need - len(data))
+            except (OSError, ValueError):
+                return None
+            if chunk is None:
+                continue  # non-blocking node, nothing buffered
+            if not chunk:
+                return None  # EOF (mock trees use finite files)
+            data += chunk
+        if len(data) < need:
+            return None
+        raw = np.frombuffer(data, np.uint8).reshape(cap, self._scan_bytes)
+        cols = []
+        for c, off in zip(self._chans, self._chan_offsets):
+            sl = np.ascontiguousarray(
+                raw[:, off:off + c.storage_bytes]).reshape(-1)
+            cols.append(c.extract(sl))
+        return np.stack(cols, axis=1)
 
     def create(self):
         n = int(self.get_property("num_buffers"))
         if 0 <= n <= self.i:
             return None
         freq = max(1, int(self.get_property("frequency")))
+        cap = int(self.get_property("buffer_capacity"))
         if self.get_property("mode") == "device":
-            vals = self._read_device()
+            vals = self._read_scans(cap)
+            if vals is None:
+                return None
         else:
-            ch = int(self.get_property("channels"))
-            cap = int(self.get_property("buffer_capacity"))
+            ch = self._num_channels()
             t = self.i * cap + np.arange(cap)
             vals = np.stack(
                 [np.sin(2 * np.pi * (c + 1) * t / freq) for c in range(ch)],

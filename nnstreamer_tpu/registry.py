@@ -49,6 +49,7 @@ _BUILTIN_PROVIDERS: Dict[str, Dict[str, str]] = {
         "custom-easy": "nnstreamer_tpu.filters.custom",
         "tflite": "nnstreamer_tpu.filters.tflite_backend",
         "tensorflow-lite": "nnstreamer_tpu.filters.tflite_backend",
+        "native": "nnstreamer_tpu.filters.native_filter",
     },
     DECODER: {
         "image_labeling": "nnstreamer_tpu.decoders.image_labeling",

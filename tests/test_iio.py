@@ -1,0 +1,162 @@
+"""tensor_src_iio buffered capture against a mock sysfs tree (reference
+tests/nnstreamer_source/unittest_src_iio.cc builds exactly this kind of
+fake /sys/bus/iio layout)."""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import parse_launch
+from nnstreamer_tpu.elements.source import IIOChannel
+
+
+def test_channel_format_parse():
+    ch = IIOChannel("accel_x", 0, "le:s12/16>>4", scale=0.5, offset=1.0)
+    assert ch.storage_bytes == 2 and ch.bits == 12 and ch.shift == 4
+    # -3 stored as 12-bit two's complement, shifted left 4 in 16-bit word
+    word = struct.pack("<H", ((-3) & 0xFFF) << 4)
+    out = ch.extract(np.frombuffer(word, np.uint8))
+    assert out.dtype == np.float32
+    np.testing.assert_allclose(out, [(-3 + 1.0) * 0.5])
+
+
+def test_channel_format_unsigned_be():
+    ch = IIOChannel("light", 1, "be:u10/16>>0")
+    word = struct.pack(">H", 1023)
+    np.testing.assert_allclose(ch.extract(np.frombuffer(word, np.uint8)),
+                               [1023.0])
+
+
+def _mock_tree(tmp_path, scans, payload=None):
+    """Build iio:device0 with two channels: accel_x le:s16/16>>0 scale=0.01
+    and accel_y le:s16/16>>0 scale=0.02; device node holds packed scans
+    (``payload`` overrides — the kernel packs only *enabled* channels)."""
+    base = tmp_path / "sys"
+    dev = base / "iio:device0"
+    scan = dev / "scan_elements"
+    os.makedirs(scan)
+    os.makedirs(dev / "buffer")
+    (dev / "name").write_text("mock_accel\n")
+    (dev / "sampling_frequency").write_text("100\n")
+    (dev / "buffer" / "length").write_text("1\n")
+    (dev / "buffer" / "enable").write_text("0\n")
+    for i, ch in enumerate(("accel_x", "accel_y")):
+        (scan / f"in_{ch}_en").write_text("0\n")
+        (scan / f"in_{ch}_index").write_text(f"{i}\n")
+        (scan / f"in_{ch}_type").write_text("le:s16/16>>0\n")
+    (dev / "in_accel_x_scale").write_text("0.01\n")
+    (dev / "in_accel_y_scale").write_text("0.02\n")
+    node_dir = tmp_path / "dev"
+    os.makedirs(node_dir)
+    if payload is None:
+        payload = b"".join(struct.pack("<hh", x, y) for x, y in scans)
+    (node_dir / "iio:device0").write_bytes(payload)
+    return str(base), str(node_dir)
+
+
+def test_iio_device_capture(tmp_path):
+    scans = [(100, -200), (300, -400), (500, -600), (700, -800)]
+    base, dev = _mock_tree(tmp_path, scans)
+    pipe = parse_launch(
+        f"tensor_src_iio name=src mode=device device-number=0 "
+        f"base-dir={base} dev-dir={dev} buffer-capacity=2 num-buffers=2 ! "
+        f"tensor_sink name=out")
+    out = pipe.get("out")
+    msg = pipe.run(timeout=30)
+    assert msg is not None and msg.kind == "eos", msg
+    assert len(out.buffers) == 2
+    t0 = out.buffers[0].tensors[0]
+    assert t0.shape == (2, 2)  # [capacity, channels]
+    np.testing.assert_allclose(t0[:, 0], [1.0, 3.0])        # x * 0.01
+    np.testing.assert_allclose(t0[:, 1], [-4.0, -8.0])      # y * 0.02
+    t1 = out.buffers[1].tensors[0]
+    np.testing.assert_allclose(t1[:, 0], [5.0, 7.0])
+    # sysfs side effects: channels enabled, buffer configured
+    assert (tmp_path / "sys/iio:device0/scan_elements/in_accel_x_en"
+            ).read_text() == "1"
+    assert (tmp_path / "sys/iio:device0/buffer/length").read_text() == "2"
+
+
+def test_iio_device_by_name_and_channel_select(tmp_path):
+    # only accel_y will be enabled → the node carries y samples alone
+    base, dev = _mock_tree(tmp_path, [],
+                           payload=struct.pack("<hhh", 20, 20, 20))
+    pipe = parse_launch(
+        f"tensor_src_iio name=src mode=device device=mock_accel "
+        f"base-dir={base} dev-dir={dev} channels=accel_y "
+        f"buffer-capacity=1 num-buffers=3 ! tensor_sink name=out")
+    out = pipe.get("out")
+    msg = pipe.run(timeout=30)
+    assert msg is not None and msg.kind == "eos", msg
+    assert len(out.buffers) == 3
+    assert out.buffers[0].tensors[0].shape == (1, 1)
+    np.testing.assert_allclose(out.buffers[0].tensors[0], [[0.4]])
+    # the unselected channel was explicitly disabled
+    assert (tmp_path / "sys/iio:device0/scan_elements/in_accel_x_en"
+            ).read_text() == "0"
+
+
+def test_iio_kernel_scan_alignment(tmp_path):
+    """Mixed-width scans follow the kernel layout: each element aligned to
+    its own storage size, scan padded to the widest element (2x s16 accel
+    + s64 timestamp → ts at offset 8, scan size 16)."""
+    base = tmp_path / "sys"
+    dev = base / "iio:device0"
+    scan = dev / "scan_elements"
+    os.makedirs(scan)
+    os.makedirs(dev / "buffer")
+    (dev / "name").write_text("mixed\n")
+    for i, (ch, fmt) in enumerate((("accel_x", "le:s16/16>>0"),
+                                   ("accel_y", "le:s16/16>>0"),
+                                   ("timestamp", "le:s64/64>>0"))):
+        (scan / f"in_{ch}_en").write_text("0\n")
+        (scan / f"in_{ch}_index").write_text(f"{i}\n")
+        (scan / f"in_{ch}_type").write_text(f"{fmt}\n")
+    node_dir = tmp_path / "dev"
+    os.makedirs(node_dir)
+    # scan: s16 s16 [4B pad] s64  → 16 bytes
+    payload = b"".join(
+        struct.pack("<hh4xq", 10 * i, -10 * i, 10 ** 12 + i)
+        for i in range(3))
+    (node_dir / "iio:device0").write_bytes(payload)
+    pipe = parse_launch(
+        f"tensor_src_iio mode=device device-number=0 base-dir={base} "
+        f"dev-dir={node_dir} buffer-capacity=3 num-buffers=1 ! "
+        f"tensor_sink name=out")
+    out = pipe.get("out")
+    msg = pipe.run(timeout=30)
+    assert msg is not None and msg.kind == "eos", msg
+    t = out.buffers[0].tensors[0]
+    assert t.shape == (3, 3)
+    np.testing.assert_allclose(t[:, 0], [0.0, 10.0, 20.0])
+    np.testing.assert_allclose(t[:, 1], [0.0, -10.0, -20.0])
+    np.testing.assert_allclose(t[:, 2], [1e12, 1e12 + 1, 1e12 + 2])
+
+
+def test_iio_numeric_channel_count_device_mode(tmp_path):
+    """channels=<int> keeps the original contract: first N by index."""
+    base, dev = _mock_tree(tmp_path, [],
+                           payload=struct.pack("<hh", 5, 7))
+    pipe = parse_launch(
+        f"tensor_src_iio mode=device device-number=0 base-dir={base} "
+        f"dev-dir={dev} channels=1 buffer-capacity=1 num-buffers=1 ! "
+        f"tensor_sink name=out")
+    out = pipe.get("out")
+    msg = pipe.run(timeout=30)
+    assert msg is not None and msg.kind == "eos", msg
+    # only accel_x enabled → scan is one s16; 5 * 0.01
+    np.testing.assert_allclose(out.buffers[0].tensors[0], [[0.05]])
+    assert (tmp_path / "sys/iio:device0/scan_elements/in_accel_y_en"
+            ).read_text() == "0"
+
+
+def test_iio_mock_mode_still_works():
+    pipe = parse_launch(
+        "tensor_src_iio mode=mock channels=3 buffer-capacity=4 "
+        "num-buffers=2 ! tensor_sink name=out")
+    out = pipe.get("out")
+    msg = pipe.run(timeout=30)
+    assert msg is not None and msg.kind == "eos"
+    assert out.buffers[0].tensors[0].shape == (4, 3)

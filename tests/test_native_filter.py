@@ -1,0 +1,84 @@
+"""Native C-ABI custom filter (.so) path: build the example scaler filter
+and run it inside a pipeline (reference tests/nnstreamer_example custom
+.so scaffolding + tensor_filter_custom loading)."""
+
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import parse_launch
+
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+SCALER_SO = os.path.join(NATIVE_DIR, "libnnstpu_filter_scaler.so")
+
+
+@pytest.fixture(scope="module")
+def scaler_so():
+    if shutil.which("g++") is None and shutil.which("make") is None:
+        pytest.skip("no native toolchain")
+    subprocess.run(["make", "-C", NATIVE_DIR, "examples"], check=True,
+                   capture_output=True)
+    assert os.path.isfile(SCALER_SO)
+    return SCALER_SO
+
+
+def test_native_scaler_pipeline(scaler_so):
+    pipe = parse_launch(
+        f"appsrc name=src ! tensor_transform mode=typecast option=float32 ! "
+        f"tensor_filter framework=native model={scaler_so} "
+        f"custom=scale:3.0 ! tensor_sink name=out")
+    src, out = pipe.get("src"), pipe.get("out")
+    pipe.start()
+    try:
+        src.push([np.arange(12, dtype=np.uint8).reshape(3, 4)])
+        src.push([np.ones((3, 4), np.uint8)])
+        src.end_of_stream()
+        msg = pipe.wait(timeout=30)
+        assert msg is not None and msg.kind == "eos", msg
+    finally:
+        pipe.stop()
+    assert len(out.buffers) == 2
+    np.testing.assert_allclose(
+        out.buffers[0].tensors[0],
+        np.arange(12, dtype=np.float32).reshape(3, 4) * 3.0)
+    np.testing.assert_allclose(out.buffers[1].tensors[0],
+                               np.full((3, 4), 3.0, np.float32))
+
+
+def test_native_scaler_passthrough_ints(scaler_so):
+    """Non-float dtypes pass through untouched."""
+    pipe = parse_launch(
+        f"appsrc name=src ! tensor_filter framework=native "
+        f"model={scaler_so} custom=scale:5.0 ! tensor_sink name=out")
+    src, out = pipe.get("src"), pipe.get("out")
+    pipe.start()
+    try:
+        src.push([np.arange(6, dtype=np.int32)])
+        src.end_of_stream()
+        assert pipe.wait(timeout=30).kind == "eos"
+    finally:
+        pipe.stop()
+    np.testing.assert_array_equal(out.buffers[0].tensors[0],
+                                  np.arange(6, dtype=np.int32))
+
+
+def test_framework_auto_detects_native(scaler_so):
+    """framework=auto resolves .so to the native backend."""
+    pipe = parse_launch(
+        f"appsrc name=src ! tensor_transform mode=typecast option=float32 ! "
+        f"tensor_filter framework=auto model={scaler_so} name=f "
+        f"custom=scale:2.0 ! tensor_sink name=out")
+    src, out = pipe.get("src"), pipe.get("out")
+    pipe.start()
+    try:
+        src.push([np.ones((2, 2), np.uint8)])
+        src.end_of_stream()
+        assert pipe.wait(timeout=30).kind == "eos"
+    finally:
+        pipe.stop()
+    np.testing.assert_allclose(out.buffers[0].tensors[0],
+                               np.full((2, 2), 2.0, np.float32))
