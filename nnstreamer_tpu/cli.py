@@ -10,6 +10,8 @@ Options:
   -q / --quiet     suppress the per-element stats summary
   -t / --timeout   seconds to wait for EOS (default: none — run to EOS)
   -v / --verbose   print caps as they are negotiated and buffer counts
+  --confchk        print the effective configuration and registries
+                   (the reference's tools/development/confchk) and exit
 """
 
 from __future__ import annotations
@@ -18,18 +20,72 @@ import argparse
 import sys
 
 
+def confchk() -> int:
+    """Dump effective config + registries (reference confchk.c)."""
+    import os
+
+    from nnstreamer_tpu import native
+    from nnstreamer_tpu import elements  # noqa: F401 — registers elements
+    from nnstreamer_tpu.config import ENV_PREFIX, get_conf
+    from nnstreamer_tpu.registry import (
+        CONVERTER,
+        DECODER,
+        ELEMENT,
+        FILTER,
+        registered_names,
+    )
+
+    conf = get_conf(refresh=True)
+    print("nnstreamer_tpu configuration")
+    print(f"  conf file : {conf.path or '(none found)'}")
+    envs = sorted(k for k in os.environ if k.startswith(ENV_PREFIX))
+    print(f"  env overrides : {', '.join(envs) if envs else '(none)'}")
+    restricted = conf.get_bool("element-restriction", "enable")
+    print(f"  element restriction : "
+          f"{'ENABLED' if restricted else 'disabled'}")
+    if restricted:
+        print(f"    allowlist: "
+              f"{conf.get('element-restriction', 'restricted_elements')}")
+    print(f"  native runtime : "
+          f"{'available' if native.available() else 'NOT built'}")
+    try:
+        import jax
+
+        # a TPU-tunnel sitecustomize may force the tunnel backend at boot;
+        # honor an explicit JAX_PLATFORMS=cpu request (avoids a minutes-long
+        # tunnel init just to print config)
+        if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+            jax.config.update("jax_platforms", "cpu")
+        print(f"  jax backend : {jax.default_backend()} "
+              f"({len(jax.devices())} device(s))")
+    except Exception as e:  # noqa: BLE001
+        print(f"  jax backend : unavailable ({e})")
+    for kind, label in ((ELEMENT, "elements"), (FILTER, "filters"),
+                        (DECODER, "decoders"), (CONVERTER, "converters")):
+        names = registered_names(kind)
+        print(f"  {label} ({len(names)}): {', '.join(names)}")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="nns-launch",
         description="Run an nnstreamer_tpu pipeline description "
                     "(gst-launch-1.0 equivalent).",
     )
-    ap.add_argument("description", nargs="+",
+    ap.add_argument("description", nargs="*",
                     help="pipeline description (may be multiple tokens)")
     ap.add_argument("-t", "--timeout", type=float, default=None)
     ap.add_argument("-q", "--quiet", action="store_true")
     ap.add_argument("-v", "--verbose", action="store_true")
+    ap.add_argument("--confchk", action="store_true",
+                    help="print effective configuration and exit")
     args = ap.parse_args(argv)
+
+    if args.confchk:
+        return confchk()
+    if not args.description:
+        ap.error("pipeline description required (or --confchk)")
 
     from nnstreamer_tpu import parse_launch
     from nnstreamer_tpu.elements.sink import TensorSink

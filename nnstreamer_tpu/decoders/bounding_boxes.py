@@ -94,6 +94,12 @@ def draw_boxes(width: int, height: int, detections: List[dict]
         img[yi1:yi2 + 1, xi2] = color
         img[yi1, xi1:xi2 + 1] = color
         img[yi2, xi1:xi2 + 1] = color
+        label = det.get("label")
+        if label:
+            from nnstreamer_tpu.decoders.overlay import draw_text
+
+            draw_text(img, xi1 + 2, max(yi1 - 9, 0), str(label),
+                      color=(0, 255, 0, 255))
     return img
 
 
@@ -172,6 +178,23 @@ class BoundingBoxes:
             bi, ci = np.flatnonzero(mask), best[mask]
             dets = [{"class": int(ci[i]), "score": float(score[mask][i]),
                      "box": [float(v) for v in boxes[i]]} for i in keep]
+        elif mode == "ov-person-detection":
+            # OpenVINO person-detection-retail: [1,1,N,7] rows of
+            # (image_id, label, conf, x_min, y_min, x_max, y_max),
+            # normalized corners; stream ends at image_id < 0
+            # (reference tensordec-boundingbox.c OV_PERSON_DETECTION_*,
+            # default threshold 0.8)
+            rows = np.asarray(buf[0], np.float32).reshape(-1, 7)
+            thresh = float(options.get("option3") or 0.8)
+            dets = []
+            for r in rows:
+                if r[0] < 0:
+                    break
+                if r[2] < thresh:
+                    continue
+                dets.append({"class": int(r[1]), "score": float(r[2]),
+                             "box": [float(r[4]), float(r[3]),
+                                     float(r[6]), float(r[5])]})
         else:
             raise ValueError(f"bounding_boxes: unknown mode {mode!r}")
 

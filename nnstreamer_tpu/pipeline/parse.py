@@ -119,6 +119,19 @@ def _is_caps_token(tok: str) -> bool:
 
 
 def _make_element(factory_name: str, props: List[Tuple[str, str]]) -> Element:
+    from nnstreamer_tpu.config import get_conf
+
+    conf = get_conf()
+    # element-restriction allowlist (reference meson option
+    # enable-element-restriction + [element-restriction] restricted_elements)
+    if conf.get_bool("element-restriction", "enable"):
+        allowed = {e.strip() for e in
+                   (conf.get("element-restriction", "restricted_elements")
+                    or "").split(",") if e.strip()}
+        if factory_name not in allowed:
+            raise ValueError(
+                f"element {factory_name!r} is not in the configured "
+                f"element-restriction allowlist")
     factory = get_subplugin(ELEMENT, factory_name)
     if factory is None:
         raise ValueError(f"no such element factory {factory_name!r}")

@@ -148,6 +148,15 @@ def get_subplugin(kind: str, name: str) -> Optional[Any]:
         return _registry[kind].get(name)
 
 
+def registered_names(kind: str) -> list:
+    """All known names for a kind: explicitly registered plus lazily
+    discoverable built-ins (for tooling like confchk)."""
+    with _lock:
+        names = set(_registry[kind])
+    names.update(_BUILTIN_PROVIDERS.get(kind, {}))
+    return sorted(names)
+
+
 def list_subplugins(kind: str) -> Dict[str, Any]:
     with _lock:
         return dict(_registry[kind])

@@ -1,0 +1,87 @@
+"""Tooling and decoder additions: confchk, element-restriction allowlist,
+text overlay, ov-person-detection decoder mode."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import parse_launch
+from nnstreamer_tpu.cli import main as cli_main
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
+
+
+def test_confchk_runs(capsys):
+    assert cli_main(["--confchk"]) == 0
+    out = capsys.readouterr().out
+    assert "tensor_filter" in out and "jax" in out
+    assert "element restriction : disabled" in out
+
+
+def test_element_restriction_allowlist(monkeypatch):
+    from nnstreamer_tpu.config import ENV_PREFIX, get_conf
+
+    monkeypatch.setenv(f"{ENV_PREFIX}ELEMENT-RESTRICTION_ENABLE", "true")
+    monkeypatch.setenv(f"{ENV_PREFIX}ELEMENT-RESTRICTION_RESTRICTED_ELEMENTS",
+                       "videotestsrc,tensor_converter,fakesink")
+    get_conf(refresh=True)
+    try:
+        # allowed chain parses
+        parse_launch("videotestsrc num-buffers=1 ! tensor_converter ! "
+                     "fakesink")
+        # tensor_transform is not in the allowlist
+        with pytest.raises(ValueError, match="allowlist"):
+            parse_launch("videotestsrc ! tensor_transform mode=typecast "
+                         "option=float32 ! fakesink")
+    finally:
+        monkeypatch.delenv(f"{ENV_PREFIX}ELEMENT-RESTRICTION_ENABLE")
+        get_conf(refresh=True)
+
+
+def test_draw_text_overlay():
+    from nnstreamer_tpu.decoders.overlay import draw_text, text_extent
+
+    img = np.zeros((20, 80, 4), np.uint8)
+    draw_text(img, 1, 1, "AB 9", color=(255, 0, 0, 255))
+    assert img[:, :, 0].sum() > 0          # pixels rendered in red channel
+    assert img[:, :, 1].sum() == 0
+    w, h = text_extent("AB 9")
+    assert h == 7 and w == 4 * 6 - 1
+    # out-of-bounds rendering must not crash
+    draw_text(img, 76, 18, "XYZ")
+
+
+def test_bounding_boxes_ov_person_mode():
+    from nnstreamer_tpu.registry import DECODER, get_subplugin
+
+    dec = get_subplugin(DECODER, "bounding_boxes")()
+    rows = np.array([
+        [0, 1, 0.95, 0.10, 0.20, 0.40, 0.60],
+        [0, 1, 0.50, 0.50, 0.50, 0.90, 0.90],   # below 0.8 threshold
+        [-1, 0, 0.0, 0, 0, 0, 0],                # end marker
+        [0, 1, 0.99, 0.0, 0.0, 1.0, 1.0],        # after end: ignored
+    ], np.float32).reshape(1, 1, 4, 7)
+    buf = TensorBuffer([rows])
+    out = dec.decode(buf, None, {"option1": "ov-person-detection",
+                                 "option4": "100:100", "option7": "meta"})
+    dets = out.meta["detections"]
+    assert len(dets) == 1
+    assert dets[0]["score"] == pytest.approx(0.95)
+    # box is [y1, x1, y2, x2]
+    assert dets[0]["box"] == pytest.approx([0.2, 0.1, 0.6, 0.4])
+
+
+def test_bounding_boxes_overlay_labels(tmp_path):
+    """Overlay mode renders label text pixels beyond the box outline."""
+    from nnstreamer_tpu.registry import DECODER, get_subplugin
+
+    labels = tmp_path / "labels.txt"
+    labels.write_text("bg\nperson\n")
+    dec = get_subplugin(DECODER, "bounding_boxes")()
+    rows = np.array([[0, 1, 0.9, 0.2, 0.3, 0.8, 0.9]],
+                    np.float32).reshape(1, 1, 1, 7)
+    out = dec.decode(TensorBuffer([rows]), None,
+                     {"option1": "ov-person-detection",
+                      "option2": str(labels), "option4": "100:100"})
+    overlay = out.tensors[0]
+    assert overlay.shape == (100, 100, 4)
+    box_only = 2 * (80 - 20) + 2 * (60 - 30) + 4  # rough outline pixel count
+    assert (overlay[:, :, 1] == 255).sum() > box_only  # text adds pixels
