@@ -1,0 +1,75 @@
+"""Fast shape-based parameter initialization.
+
+``flax.linen.Module.init`` executes the un-jitted forward pass op-by-op to
+produce the variable tree — ~34 s for MobileNetV2 on a 1-core CPU host and
+a full extra trace+execute on TPU. The models here are benchmark/zoo models
+whose weights are random anyway (the reference ships no weights in-tree
+either; its test models are external .tflite files), so we only need the
+*structure*: trace abstractly with ``jax.eval_shape`` (no compile, no
+execute) and materialize each leaf host-side with numpy.
+
+Leaves are filled deterministically from the seed + leaf path:
+- ``batch_stats``/``mean`` → zeros, ``var`` → ones
+- ``scale`` (LayerNorm/BatchNorm gamma) → ones
+- ``bias`` → zeros
+- kernels/embeddings → truncated-normal-ish N(0, 1/sqrt(fan_in))
+
+This mirrors what the standard flax initializers (lecun_normal, zeros,
+ones) produce in distribution, at ~1000x the speed.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def _fill(path: str, shape, dtype, rng: np.random.Generator) -> np.ndarray:
+    leaf = path.rsplit("/", 1)[-1].lower()
+    if leaf == "mean":
+        return np.zeros(shape, dtype)
+    if leaf == "var":
+        return np.ones(shape, dtype)
+    if leaf in ("scale", "gamma"):
+        return np.ones(shape, dtype)
+    if leaf in ("bias", "beta") or not shape:
+        return np.zeros(shape, dtype)
+    # kernel / embedding: fan_in = product of all dims but the last
+    fan_in = 1
+    for d in shape[:-1]:
+        fan_in *= d
+    std = 1.0 / np.sqrt(max(fan_in, 1))
+    return rng.normal(0.0, std, size=shape).astype(dtype)
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        key = getattr(p, "key", getattr(p, "name", getattr(p, "idx", p)))
+        parts.append(str(key))
+    return "/".join(parts)
+
+
+def fast_init(init_fn, *args, seed: int = 0, **kwargs) -> Any:
+    """Drop-in for ``model.init(rng, *inputs)``: same tree, numpy-filled.
+
+    ``init_fn`` is the bound ``model.init``; ``args`` are its arguments
+    (rng first, then dummy inputs). Runs ``jax.eval_shape`` (abstract — no
+    FLOPs) and fills each leaf deterministically from ``seed`` + leaf path.
+    """
+    shapes = jax.eval_shape(init_fn, *args, **kwargs)
+
+    def make(path, leaf):
+        p = _path_str(path)
+        # independent stream per leaf, keyed by a stable (unsalted) hash of
+        # the path so the same seed gives identical weights on every
+        # process/host — python's hash() is salted per-process
+        rng = np.random.default_rng([seed, zlib.crc32(p.encode())])
+        return jax.numpy.asarray(
+            _fill(p, leaf.shape, leaf.dtype, rng)
+        )
+
+    return jax.tree_util.tree_map_with_path(make, shapes)

@@ -103,7 +103,8 @@ def mobilenet_v2(num_classes: int = 1001, width: float = 1.0,
     model = MobileNetV2(num_classes=num_classes, width=width, dtype=dtype)
     rng = jax.random.PRNGKey(seed)
     dummy = jnp.zeros((batch, image_size, image_size, 3), jnp.float32)
-    variables = model.init(rng, dummy)
+    from nnstreamer_tpu.models._init import fast_init
+    variables = fast_init(model.init, rng, dummy, seed=seed)
 
     def apply_fn(params, x):
         return model.apply(params, x)

@@ -48,7 +48,8 @@ def posenet(image_size: int = 257, batch: int = 1, dtype=jnp.bfloat16,
     model = PoseNet(dtype=dtype)
     rng = jax.random.PRNGKey(seed)
     dummy = jnp.zeros((batch, image_size, image_size, 3), jnp.float32)
-    variables = model.init(rng, dummy)
+    from nnstreamer_tpu.models._init import fast_init
+    variables = fast_init(model.init, rng, dummy, seed=seed)
     h, o = jax.eval_shape(lambda p, x: model.apply(p, x), variables, dummy)
 
     def apply_fn(params, x):

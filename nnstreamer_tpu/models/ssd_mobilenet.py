@@ -98,7 +98,8 @@ def ssd_mobilenet(num_classes: int = 91, image_size: int = 300,
     model = SSDMobileNet(num_classes=num_classes, dtype=dtype)
     rng = jax.random.PRNGKey(seed)
     dummy = jnp.zeros((batch, image_size, image_size, 3), jnp.float32)
-    variables = model.init(rng, dummy)
+    from nnstreamer_tpu.models._init import fast_init
+    variables = fast_init(model.init, rng, dummy, seed=seed)
     b, s = jax.eval_shape(lambda p, x: model.apply(p, x), variables, dummy)
     num_anchors = b.shape[1]
 
