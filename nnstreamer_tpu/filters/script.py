@@ -1,0 +1,110 @@
+"""Inline-script filter backend (reference ``tensor_filter_lua.cc``, 566
+LoC: filters defined by a script string or file, no compiled model needed).
+
+The reference embeds a Lua interpreter and runs the script per frame on the
+CPU. The TPU-native take: the script is a tiny Python/jax.numpy program
+that is **traced once and jitted**, so a "scripted filter" costs the same
+as a compiled one — it fuses into a single XLA program and runs on the
+MXU/VPU rather than an interpreter.
+
+Script protocol: inputs are bound as ``x0..xN`` (and ``x`` = ``x0``),
+namespace has ``jnp``/``jax``/``lax``/``np``; outputs are whatever the
+script assigns to ``y0..yN`` (or ``y``)::
+
+    tensor_filter framework=script model="y = jnp.tanh(x) * 2.0"
+    tensor_filter framework=script model=my_filter.jaxs   # same, from file
+
+The script runs under jit tracing: no data-dependent Python control flow
+(use ``lax.cond``/``lax.select``), static shapes — the same rules as any
+jitted function. One specialization is compiled per negotiated input
+shape-set and cached.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nnstreamer_tpu.filters.api import FilterFramework, FilterProperties
+from nnstreamer_tpu.registry import FILTER, subplugin
+from nnstreamer_tpu.tensors.types import TensorInfo, TensorsInfo, TensorType
+
+
+_Y_RE = re.compile(r"^y(\d+)$")
+
+
+@subplugin(FILTER, "script")
+class ScriptFilter(FilterFramework):
+    """Jit-compiled expression/script filters."""
+
+    NAME = "script"
+    KEEP_ON_DEVICE = True
+
+    def __init__(self):
+        super().__init__()
+        self._src: Optional[str] = None
+        self._code = None
+        self._jitted = None
+        self._in_info: Optional[TensorsInfo] = None
+
+    # -- vtable --------------------------------------------------------------
+    def open(self, props: FilterProperties) -> None:
+        super().open(props)
+        src = props.model or ""
+        if os.path.isfile(src):
+            with open(src, "r", encoding="utf-8") as f:
+                src = f.read()
+        if not src.strip():
+            raise ValueError("script: empty script (model property)")
+        self._src = src
+        self._code = compile(src, "<tensor_filter_script>", "exec")
+
+        def run(*inputs):
+            ns: Dict[str, Any] = {
+                "jnp": jnp, "jax": jax, "lax": jax.lax, "np": jnp,
+            }
+            for i, x in enumerate(inputs):
+                ns[f"x{i}"] = x
+            ns["x"] = inputs[0]
+            ns["n_inputs"] = len(inputs)
+            exec(self._code, ns)  # traced once under jit, not per frame
+            if "y" in ns and not any(_Y_RE.match(k) for k in ns):
+                return [jnp.asarray(ns["y"])]
+            outs = sorted(
+                ((int(_Y_RE.match(k).group(1)), v) for k, v in ns.items()
+                 if _Y_RE.match(k)),
+                key=lambda kv: kv[0],
+            )
+            if not outs:
+                raise ValueError(
+                    "script: script must assign y (or y0..yN)"
+                )
+            return [jnp.asarray(v) for _, v in outs]
+
+        self._run = run
+        self._jitted = jax.jit(lambda *xs: tuple(run(*xs)))
+
+    def close(self) -> None:
+        self._src = self._code = self._jitted = None
+        super().close()
+
+    def set_input_info(self, in_info: TensorsInfo) -> TensorsInfo:
+        self._in_info = in_info
+        dummies = [
+            jax.ShapeDtypeStruct(t.shape, t.type.np_dtype) for t in in_info
+        ]
+        outs = jax.eval_shape(lambda *xs: tuple(self._run(*xs)), *dummies)
+        return TensorsInfo([
+            TensorInfo(dim=tuple(reversed(o.shape)),
+                       type=TensorType.from_any(np.dtype(o.dtype)))
+            for o in outs
+        ])
+
+    def invoke(self, inputs: Sequence[Any]) -> List[Any]:
+        with self.global_stats().measure():
+            return list(self._jitted(*[jnp.asarray(x) for x in inputs]))

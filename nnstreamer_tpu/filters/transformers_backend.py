@@ -1,0 +1,177 @@
+"""HuggingFace ``transformers`` filter backend.
+
+The reference wraps the era's heavyweight NN frameworks as filter
+subplugins (``tensor_filter_tensorflow.cc`` 785 LoC,
+``tensor_filter_pytorch.cc`` 711 LoC): model file in, tensors in/out. The
+TPU-native peer is the transformers model hub format: the ``model``
+property names a local HF checkpoint directory or a ``config.json``, and
+the backend runs the **Flax** head of the architecture jitted on TPU
+(falling back to torch-CPU only if the architecture has no Flax class or
+``custom=backend:torch`` forces it).
+
+Inputs map positionally: ``input_ids`` [, ``attention_mask``] — i.e. a
+text pipeline is ``tensor_converter`` (text→int ids) ! ``tensor_filter
+framework=transformers model=./bert-dir``; outputs are the model outputs
+flattened in declaration order (logits first for classification heads).
+
+``custom=`` options (comma-separated ``key:value``):
+
+- ``arch:<FlaxAutoModelFor...|AutoModelFor...>`` — auto-class to load
+  with (default ``FlaxAutoModel``).
+- ``backend:flax|torch`` — force a backend (default flax).
+- ``from_config:true`` — build from config with random weights (no
+  weight files needed; CI/egress-free pattern, like the reference's
+  EdgeTPU ``device_type:dummy`` software mock).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from nnstreamer_tpu.filters.api import FilterFramework, FilterProperties
+from nnstreamer_tpu.registry import FILTER, subplugin
+from nnstreamer_tpu.tensors.types import TensorInfo, TensorsInfo, TensorType
+
+
+def _parse_custom(custom: Optional[str]) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for part in (custom or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        k, _, v = part.partition(":")
+        out[k.strip()] = v.strip()
+    return out
+
+
+@subplugin(FILTER, "transformers")
+class TransformersFilter(FilterFramework):
+    NAME = "transformers"
+    KEEP_ON_DEVICE = True
+
+    def __init__(self):
+        super().__init__()
+        self._model = None
+        self._params = None
+        self._backend = "flax"
+        self._jitted = None
+
+    # -- helpers -------------------------------------------------------------
+    def _auto_cls(self, name: str):
+        import transformers
+
+        if not hasattr(transformers, name):
+            raise ValueError(f"transformers: unknown auto-class {name!r}")
+        return getattr(transformers, name)
+
+    def open(self, props: FilterProperties) -> None:
+        super().open(props)
+        import transformers
+
+        opts = _parse_custom(props.custom)
+        self._backend = opts.get("backend", "flax")
+        arch = opts.get(
+            "arch", "FlaxAutoModel" if self._backend == "flax" else "AutoModel"
+        )
+        if self._backend == "flax" and not arch.startswith("Flax"):
+            arch = "Flax" + arch
+        path = props.model
+        if not path:
+            raise ValueError("transformers: model property required")
+        cfg = transformers.AutoConfig.from_pretrained(
+            path, local_files_only=True
+        )
+        cls = self._auto_cls(arch)
+        from_config = opts.get("from_config", "").lower() in ("1", "true")
+        if self._backend == "flax":
+            if from_config:
+                self._model = cls.from_config(cfg)
+            else:
+                self._model = cls.from_pretrained(
+                    path, config=cfg, local_files_only=True
+                )
+            self._params = self._model.params
+            self._compile()
+        else:
+            import torch
+
+            if from_config:
+                self._model = cls.from_config(cfg)
+            else:
+                self._model = cls.from_pretrained(
+                    path, config=cfg, local_files_only=True
+                )
+            self._model.eval()
+            self._torch = torch
+
+    def _compile(self):
+        import jax
+
+        model = self._model
+
+        def fwd(params, input_ids, attention_mask):
+            out = model(
+                input_ids=input_ids,
+                attention_mask=attention_mask,
+                params=params,
+                train=False,
+            )
+            return tuple(
+                v for v in out.to_tuple()
+                if hasattr(v, "shape") and v is not None
+            )
+
+        self._fwd = fwd
+        self._jitted = jax.jit(fwd)
+
+    def close(self) -> None:
+        self._model = self._params = self._jitted = None
+        super().close()
+
+    # -- shape negotiation ---------------------------------------------------
+    def set_input_info(self, in_info: TensorsInfo) -> TensorsInfo:
+        import jax
+
+        ids = in_info[0]
+        if self._backend == "torch":
+            outs = self.invoke(
+                [np.zeros(t.shape, t.type.np_dtype) for t in in_info]
+            )
+            return TensorsInfo.from_arrays(outs)
+        dummy_ids = jax.ShapeDtypeStruct(ids.shape, np.int32)
+        dummy_mask = jax.ShapeDtypeStruct(ids.shape, np.int32)
+        outs = jax.eval_shape(
+            self._fwd, self._params, dummy_ids, dummy_mask
+        )
+        return TensorsInfo([
+            TensorInfo(dim=tuple(reversed(o.shape)),
+                       type=TensorType.from_any(np.dtype(o.dtype)))
+            for o in outs
+        ])
+
+    # -- invoke --------------------------------------------------------------
+    def invoke(self, inputs: Sequence[Any]) -> List[Any]:
+        with self.global_stats().measure():
+            if self._backend == "torch":
+                t = self._torch
+                ids = t.as_tensor(np.asarray(inputs[0])).long()
+                mask = (
+                    t.as_tensor(np.asarray(inputs[1])).long()
+                    if len(inputs) > 1 else t.ones_like(ids)
+                )
+                with t.no_grad():
+                    out = self._model(input_ids=ids, attention_mask=mask)
+                return [
+                    v.numpy() for v in out.to_tuple()
+                    if hasattr(v, "numpy")
+                ]
+            import jax.numpy as jnp
+
+            ids = jnp.asarray(inputs[0], jnp.int32)
+            mask = (
+                jnp.asarray(inputs[1], jnp.int32)
+                if len(inputs) > 1 else jnp.ones_like(ids)
+            )
+            return list(self._jitted(self._params, ids, mask))

@@ -50,6 +50,9 @@ _BUILTIN_PROVIDERS: Dict[str, Dict[str, str]] = {
         "tflite": "nnstreamer_tpu.filters.tflite_backend",
         "tensorflow-lite": "nnstreamer_tpu.filters.tflite_backend",
         "native": "nnstreamer_tpu.filters.native_filter",
+        "script": "nnstreamer_tpu.filters.script",
+        "pipeline": "nnstreamer_tpu.filters.pipeline_filter",
+        "transformers": "nnstreamer_tpu.filters.transformers_backend",
     },
     DECODER: {
         "image_labeling": "nnstreamer_tpu.decoders.image_labeling",

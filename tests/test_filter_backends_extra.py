@@ -1,0 +1,166 @@
+"""Script, pipeline-as-filter, and transformers filter backends.
+
+Reference parity: tensor_filter_lua.cc (script-defined filters),
+tensor_filter_mediapipe.cc (sub-graph as a filter), and the heavyweight
+framework subplugins (tensor_filter_tensorflow.cc / _pytorch.cc) whose
+TPU-native peer loads HF-format checkpoints through Flax.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import parse_launch
+from nnstreamer_tpu.filters.api import FilterProperties
+from nnstreamer_tpu.registry import FILTER, get_subplugin
+from nnstreamer_tpu.tensors.types import TensorsInfo
+
+
+def _run_collect(desc, sink="out"):
+    pipe = parse_launch(desc)
+    outs = []
+    pipe.get(sink).connect(lambda b: outs.append(b))
+    pipe.run(timeout=120)
+    return outs
+
+
+class TestScriptFilter:
+    def test_inline_expression(self):
+        outs = _run_collect(
+            "videotestsrc num-buffers=3 width=8 height=8 ! "
+            "tensor_converter ! tensor_transform mode=typecast "
+            "option=float32 ! "
+            'tensor_filter framework=script model="y = jnp.tanh(x) * 2.0" ! '
+            "tensor_sink name=out to-host=true"
+        )
+        assert len(outs) == 3
+        got = np.asarray(outs[0].tensors[0])
+        assert got.shape == (1, 8, 8, 3)
+        assert float(np.abs(got).max()) <= 2.0
+
+    def test_multi_output_and_file(self, tmp_path):
+        script = tmp_path / "split.jaxs"
+        script.write_text(
+            "y0 = x * 2.0\n"
+            "y1 = jnp.sum(x, axis=(1, 2, 3), keepdims=False)\n"
+        )
+        outs = _run_collect(
+            "videotestsrc num-buffers=2 width=8 height=8 ! "
+            "tensor_converter ! tensor_transform mode=typecast "
+            f"option=float32 ! tensor_filter framework=script "
+            f"model={script} ! tensor_sink name=out to-host=true"
+        )
+        assert len(outs) == 2
+        assert len(outs[0].tensors) == 2
+        assert outs[0].tensors[1].shape == (1,)
+
+    def test_shape_inference(self):
+        f = get_subplugin(FILTER, "script")()
+        f.open(FilterProperties(model="y = jnp.mean(x, axis=-1)"))
+        out = f.set_input_info(TensorsInfo.from_str("4:8:8:1", "float32"))
+        assert out[0].shape == (1, 8, 8)
+        f.close()
+
+    def test_bad_script_rejected(self):
+        f = get_subplugin(FILTER, "script")()
+        with pytest.raises(ValueError):
+            f.open(FilterProperties(model="   "))
+        f.open(FilterProperties(model="z = x"))  # no y assigned
+        with pytest.raises(Exception):
+            f.set_input_info(TensorsInfo.from_str("2:2", "float32"))
+
+
+class TestPipelineFilter:
+    def test_nested_pipeline(self):
+        inner = (
+            "appsrc name=in ! tensor_transform mode=arithmetic "
+            "option=mul:3.0 ! tensor_sink name=out"
+        )
+        outs = _run_collect(
+            "videotestsrc num-buffers=3 width=4 height=4 ! "
+            "tensor_converter ! tensor_transform mode=typecast "
+            f'option=float32 ! tensor_filter framework=pipeline '
+            f'model="{inner}" ! tensor_sink name=out to-host=true'
+        )
+        assert len(outs) == 3
+
+    def test_values_and_order(self):
+        from nnstreamer_tpu.filters.pipeline_filter import PipelineFilter
+
+        f = PipelineFilter()
+        f.open(FilterProperties(
+            model="appsrc name=in ! tensor_transform mode=arithmetic "
+                  "option=add:1.0 ! tensor_sink name=out"))
+        for i in range(5):
+            x = np.full((2, 2), float(i), np.float32)
+            (y,) = f.invoke([x])
+            assert np.allclose(np.asarray(y), x + 1.0)
+        f.close()
+
+    def test_missing_ports_rejected(self):
+        from nnstreamer_tpu.filters.pipeline_filter import PipelineFilter
+
+        f = PipelineFilter()
+        with pytest.raises(ValueError):
+            f.open(FilterProperties(model="videotestsrc ! tensor_sink"))
+
+
+class TestTransformersFilter:
+    @pytest.fixture(scope="class")
+    def bert_dir(self, tmp_path_factory):
+        d = tmp_path_factory.mktemp("tiny_bert")
+        cfg = {
+            "model_type": "bert",
+            "architectures": ["BertModel"],
+            "hidden_size": 32,
+            "num_hidden_layers": 2,
+            "num_attention_heads": 2,
+            "intermediate_size": 64,
+            "vocab_size": 128,
+            "max_position_embeddings": 64,
+            "type_vocab_size": 2,
+        }
+        (d / "config.json").write_text(json.dumps(cfg))
+        return str(d)
+
+    def test_flax_from_config(self, bert_dir):
+        f = get_subplugin(FILTER, "transformers")()
+        f.open(FilterProperties(model=bert_dir, custom="from_config:true"))
+        out_info = f.set_input_info(TensorsInfo.from_str("16:2", "int32"))
+        # last_hidden_state [2,16,32] + pooler [2,32]
+        assert out_info[0].shape == (2, 16, 32)
+        ids = np.ones((2, 16), np.int32)
+        outs = f.invoke([ids])
+        assert np.asarray(outs[0]).shape == (2, 16, 32)
+        f.close()
+
+    def test_in_pipeline(self, bert_dir):
+        pipe = parse_launch(
+            "appsrc name=src ! "
+            "tensor_filter framework=transformers "
+            f"model={bert_dir} custom=from_config:true ! "
+            "tensor_sink name=out to-host=true"
+        )
+        src = pipe.get("src")
+        sink = pipe.get("out")
+        pipe.start()
+        try:
+            for _ in range(2):
+                src.push([np.ones((1, 16), np.int32)])
+            src.end_of_stream()
+            msg = pipe.wait(timeout=120)
+            assert msg is not None and msg.kind == "eos", msg
+        finally:
+            pipe.stop()
+        assert len(sink.buffers) == 2
+        assert np.asarray(sink.buffers[0].tensors[0]).shape == (1, 16, 32)
+
+    def test_torch_backend(self, bert_dir):
+        f = get_subplugin(FILTER, "transformers")()
+        f.open(FilterProperties(
+            model=bert_dir, custom="from_config:true,backend:torch"))
+        ids = np.ones((1, 8), np.int64)
+        outs = f.invoke([ids])
+        assert outs[0].shape == (1, 8, 32)
+        f.close()
