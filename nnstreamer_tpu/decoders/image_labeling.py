@@ -38,10 +38,32 @@ class ImageLabeling:
     def decode(self, buf: TensorBuffer, config, options) -> TensorBuffer:
         scores = np.asarray(buf[0]).reshape(-1)
         idx = int(np.argmax(scores))
+        return self._emit(buf, idx, float(scores[idx]), options)
+
+    def _emit(self, buf, idx: int, score: float, options) -> TensorBuffer:
         labels = self._get_labels(options)
         text = labels[idx] if labels and idx < len(labels) else str(idx)
         out = np.frombuffer(text.encode("utf-8"), np.uint8)
         return buf.with_tensors([out]).replace(
             meta={**buf.meta, "label_index": idx, "label": text,
-                  "score": float(scores[idx])}
+                  "score": score}
         )
+
+    # -- fused-region split (elements/decoder.py device_stage) ---------------
+    def device_kernel(self, options):
+        """Device half: argmax + top score stay in the XLA program, so only
+        two scalars ever cross the tunnel instead of the full score tensor."""
+        import jax.numpy as jnp
+
+        def fn(consts, tensors):
+            scores = tensors[0].reshape(-1)
+            return [jnp.argmax(scores).astype(jnp.int32),
+                    jnp.max(scores).astype(jnp.float32)]
+
+        return None, fn
+
+    def host_finalize(self, host_buf: TensorBuffer, config, options
+                      ) -> TensorBuffer:
+        idx = int(host_buf[0])
+        score = float(host_buf[1])
+        return self._emit(host_buf, idx, score, options)

@@ -60,6 +60,38 @@ class TensorDecoder(Element):
         dec = self._get_decoder()
         return dec.out_caps(self._config, self._options())
 
+    def device_stage(self):
+        """Fuse the decoder's math into the device region when the subplugin
+        splits itself: ``device_kernel(options) -> (consts, fn)`` runs on
+        device inside the fused program; ``host_finalize(buf, config,
+        options) -> TensorBuffer`` is deferred to the sink's materialization
+        point (TensorBuffer.finalize), so the decoder never forces a blocking
+        D2H mid-stream. Decoders without ``device_kernel`` stay host-side,
+        exactly like reference decoders (tensordec.c decode cb is host code)."""
+        dec = self._get_decoder()
+        kernel = getattr(dec, "device_kernel", None)
+        # both halves must exist — a kernel without its host completion
+        # can't fuse (fusion is an optimization, never a failure)
+        if kernel is None or getattr(dec, "host_finalize", None) is None:
+            return None
+        from nnstreamer_tpu.pipeline.fuse import DeviceStage
+
+        options = self._options()
+        got = kernel(options)
+        if got is None:
+            return None
+        consts, fn = got
+
+        def finalize(host_buf):
+            return dec.host_finalize(host_buf, self._config, options)
+
+        return DeviceStage(
+            consts=consts, fn=fn,
+            key=("decoder", self.get_property("mode"),
+                 tuple(sorted(options.items()))),
+            finalize=finalize,
+        )
+
     def chain(self, pad, buf):
         dec = self._get_decoder()
         out = dec.decode(buf.to_host(), self._config, self._options())

@@ -27,6 +27,11 @@ class TensorSink(Element):
     buffers or EOS.
     """
 
+    # keeps a pending finalize lazy until chain(): it is applied at this
+    # element's materialization point rather than on pad entry, so upstream
+    # queues can batch the D2H instead of each frame syncing eagerly
+    HANDLES_DEFERRED = True
+
     ELEMENT_NAME = "tensor_sink"
     PROPERTIES = {**Element.PROPERTIES, "sync": False, "max_stored": 4096,
                   "to_host": True}
@@ -44,7 +49,11 @@ class TensorSink(Element):
         self._callbacks.append(callback)
 
     def chain(self, pad, buf):
-        if self.get_property("to_host"):
+        # a pending finalize is ALWAYS applied — even with to_host=false —
+        # so the app sees the same payload/meta as in an unfused pipeline
+        # (with to_host=false the materialization only fetches the deferred
+        # stage's tensors, e.g. two scalars, never full frames)
+        if self.get_property("to_host") or buf.finalize is not None:
             buf = buf.to_host()
         with self._cv:
             if len(self.buffers) < int(self.get_property("max_stored")):
@@ -115,6 +124,8 @@ class FileSink(Element):
 @subplugin(ELEMENT, "fakesink")
 class FakeSink(Element):
     """Discard buffers (gst fakesink); counts them for tests."""
+
+    HANDLES_DEFERRED = True  # discards buffers; never forces the D2H
 
     ELEMENT_NAME = "fakesink"
     PROPERTIES = {**Element.PROPERTIES, "sync": False}

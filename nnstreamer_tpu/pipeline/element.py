@@ -278,11 +278,21 @@ class Element:
         return downstream
 
     # -- dataflow entry (with uniform instrumentation) -----------------------
+    #: Elements that merely hold or hand off buffers (queue, sinks) set this
+    #: True to keep a pending ``TensorBuffer.finalize`` lazy. Everything else
+    #: materializes a finalize-pending buffer on entry, so elements always
+    #: see the same payload they would in an unfused pipeline.
+    HANDLES_DEFERRED = False
+
     def _chain_entry(self, pad: Pad, buf: TensorBuffer) -> FlowReturn:
         if pad.eos:
             return FlowReturn.EOS
         with self.stats.measure():
             try:
+                if buf.finalize is not None and not self.HANDLES_DEFERRED:
+                    # blocking D2H + host finalize — inside measure() so the
+                    # element paying the sync is the one whose stats show it
+                    buf = buf.to_host()
                 ret = self.chain(pad, buf)
             except FlowError:
                 raise

@@ -59,6 +59,13 @@ class DeviceStage:
     consts: Any
     fn: Callable[[Any, List[Any]], List[Any]]
     key: Any = None
+    #: optional deferred host completion ``fn(host_buf) -> TensorBuffer``
+    #: attached to outgoing buffers (TensorBuffer.finalize) — used by
+    #: decoders whose math runs on device but whose output needs host-only
+    #: work (label strings, overlay compose). A finalizing stage terminates
+    #: its fused run: downstream elements see its *device* tensors only
+    #: after a sink materializes them.
+    finalize: Optional[Callable] = None
 
 
 def fusion_enabled() -> bool:
@@ -107,9 +114,10 @@ class FusedRegion(Element):
         #: buffers no longer flow through members)
         self.internal_pad = self.add_sink_pad("fused-internal")
         self.members: List[Element] = list(members)
-        #: (consts_list, jitted) — swapped atomically; readers take one
-        #: local reference so invalidate() can never half-update it
-        self._compiled: Optional[Tuple[list, Callable]] = None
+        #: (consts_list, jitted, finalize) — swapped atomically; readers
+        #: take one local reference so invalidate() can never half-update it
+        self._compiled: Optional[Tuple[list, Callable, Optional[Callable]]] \
+            = None
         #: (keys_list, jitted) from the last trace — reused when a rebuild
         #: finds identical keys, so consts-only changes never recompile
         self._trace_cache: Optional[Tuple[list, Callable]] = None
@@ -146,7 +154,7 @@ class FusedRegion(Element):
 
             jitted = jax.jit(composed)
             self._trace_cache = (keys, jitted)
-        compiled = ([st.consts for st in stages], jitted)
+        compiled = ([st.consts for st in stages], jitted, stages[-1].finalize)
         self._compiled = compiled
         return compiled
 
@@ -196,9 +204,12 @@ class FusedRegion(Element):
                 self.unsplice()
                 first = self.members[0]
                 return first._chain_entry(first.sinkpads[0], buf)
-        consts, jitted = compiled
+        consts, jitted, finalize = compiled
         out = jitted(consts, list(buf.tensors))
-        return self.srcpad.push(buf.with_tensors(list(out)))
+        out_buf = buf.with_tensors(list(out))
+        if finalize is not None:
+            out_buf = out_buf.replace(finalize=finalize)
+        return self.srcpad.push(out_buf)
 
     # -- events --------------------------------------------------------------
     def sink_event(self, pad: Pad, event: Event) -> None:
@@ -274,20 +285,33 @@ def fuse_pipeline(pipe) -> List[FusedRegion]:
     """
     regions: List[FusedRegion] = []
     in_run = set()
+    stage_cache: dict = {}
+
+    def stage_of(el):
+        if id(el) not in stage_cache:
+            stage_cache[id(el)] = _stage_of(el)
+        return stage_cache[id(el)]
+
     for el in pipe.elements:
         if id(el) in in_run or not _single_io(el):
             continue
-        if _stage_of(el) is None:
+        head_stage = stage_of(el)
+        if head_stage is None:
             continue
         up = el.sinkpads[0].peer.element if el.sinkpads[0].peer else None
-        if up is not None and _single_io(up) and _stage_of(up) is not None:
-            continue  # not the head of a run
+        if up is not None and _single_io(up):
+            up_stage = stage_of(up)
+            # upstream fusible and able to extend → el is not a run head;
+            # a finalizing upstream terminates its own run, so el IS a head
+            if up_stage is not None and up_stage.finalize is None:
+                continue
         run = [el]
         cur = el
-        while True:
+        # a finalizing stage ends its run — nothing can fuse after it
+        while stage_of(cur).finalize is None:
             peer = cur.srcpads[0].peer
             nxt = peer.element if peer else None
-            if nxt is None or not _single_io(nxt) or _stage_of(nxt) is None:
+            if nxt is None or not _single_io(nxt) or stage_of(nxt) is None:
                 break
             run.append(nxt)
             cur = nxt

@@ -111,6 +111,7 @@ class Queue(Element):
     """
 
     ELEMENT_NAME = "queue"
+    HANDLES_DEFERRED = True  # pure hand-off: finalize stays lazy across it
     PROPERTIES = {**Element.PROPERTIES, "max_size_buffers": 16, "leaky": "no",
                   "prefetch_host": False}
 

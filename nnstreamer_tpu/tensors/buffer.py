@@ -54,6 +54,12 @@ class TensorBuffer:
     dts: Optional[int] = None
     duration: Optional[int] = None
     meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    #: deferred host-side completion: ``fn(host_buf) -> TensorBuffer``,
+    #: applied by :meth:`to_host` after tensors materialize. Lets a fused
+    #: region keep a decoder's math on device (argmax, box select) and
+    #: delay its host-only part (label strings, overlay compose) to the
+    #: sink's fetch point — so no element forces a blocking D2H mid-stream.
+    finalize: Optional[Any] = None
 
     def __post_init__(self):
         if len(self.tensors) > NNS_TENSOR_SIZE_LIMIT:
@@ -96,11 +102,15 @@ class TensorBuffer:
 
     # -- placement -----------------------------------------------------------
     def to_host(self) -> "TensorBuffer":
-        """Materialize all tensors as numpy arrays (blocking D2H if needed)."""
+        """Materialize all tensors as numpy arrays (blocking D2H if needed),
+        then apply the deferred ``finalize`` hook if one is attached."""
         out = []
         for t in self.tensors:
             out.append(np.asarray(t) if not isinstance(t, np.ndarray) else t)
-        return self.replace(tensors=out)
+        buf = self.replace(tensors=out, finalize=None)
+        if self.finalize is not None:
+            buf = self.finalize(buf)
+        return buf
 
     def to_device(self, device=None, sharding=None) -> "TensorBuffer":
         """Move all tensors onto a JAX device (or sharding)."""
@@ -127,6 +137,7 @@ class TensorBuffer:
             dts=self.dts,
             duration=self.duration,
             meta=dict(self.meta),
+            finalize=self.finalize,
         )
         fields.update(kw)
         return TensorBuffer(**fields)

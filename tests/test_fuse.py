@@ -254,3 +254,126 @@ def test_reload_inside_region(linear_model):
     finally:
         pipe.stop()
         unregister_jax_model("fuse_linear2")
+
+
+# -- fused decoders (device kernel + deferred host finalize) -----------------
+
+DEC_DESC = (
+    "appsrc name=src ! "
+    "tensor_transform mode=arithmetic option=typecast:float32,mul:2.0 ! "
+    "tensor_filter framework=jax model={m} name=filter ! "
+    "tensor_decoder mode=image_labeling {opts} ! "
+    "tensor_sink name=sink to-host=true"
+)
+
+
+def _run_dec(frames, fuse, opts=""):
+    pipe = parse_launch(DEC_DESC.format(m="fuse_linear", opts=opts))
+    pipe._fuse = fuse
+    src, sink = pipe.get("src"), pipe.get("sink")
+    pipe.start()
+    try:
+        for f in frames:
+            src.push([f.copy()])
+        src.end_of_stream()
+        msg = pipe.wait(timeout=60)
+        assert msg is not None and msg.kind == "eos", msg
+    finally:
+        pipe.stop()
+    return pipe, list(sink.buffers)
+
+
+def test_fused_decoder_matches_unfused(linear_model):
+    frames = [np.random.default_rng(i).integers(0, 9, (8, 4)).astype(np.uint8)
+              for i in range(4)]
+    pipe_f, out_f = _run_dec(frames, fuse=True)
+    pipe_u, out_u = _run_dec(frames, fuse=False)
+    # the decoder joined the region (and terminates it)
+    assert pipe_f._regions
+    members = pipe_f._regions[0].members
+    assert members[-1].ELEMENT_NAME == "tensor_decoder"
+    assert len(out_f) == len(out_u) == 4
+    for a, b in zip(out_f, out_u):
+        # finalize already applied by the sink's to_host
+        assert a.finalize is None
+        assert a.meta["label_index"] == b.meta["label_index"]
+        assert a.meta["label"] == b.meta["label"]
+        np.testing.assert_allclose(a.meta["score"], b.meta["score"],
+                                   rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(a[0]), np.asarray(b[0]))
+
+
+def test_fused_decoder_labels_file(linear_model, tmp_path):
+    labels = tmp_path / "labels.txt"
+    names = [f"class{i}" for i in range(24)]
+    labels.write_text("\n".join(names) + "\n")
+    frames = [np.eye(8, 4, k=-1).astype(np.uint8) * 9]
+    _, out = _run_dec(frames, fuse=True, opts=f"option1={labels}")
+    assert out[0].meta["label"] in names
+    assert bytes(np.asarray(out[0][0])).decode() == out[0].meta["label"]
+
+
+def test_buffer_finalize_applied_once():
+    from nnstreamer_tpu.tensors.buffer import TensorBuffer
+
+    calls = []
+
+    def fin(buf):
+        calls.append(1)
+        return buf.replace(meta={**buf.meta, "done": True})
+
+    b = TensorBuffer([np.arange(4)], finalize=fin)
+    h = b.to_host()
+    assert h.meta.get("done") and h.finalize is None
+    h2 = h.to_host()
+    assert len(calls) == 1 and h2.meta.get("done")
+
+
+def test_deferred_finalize_materializes_before_downstream_elements(
+        linear_model, tmp_path):
+    """A finalize-pending buffer must materialize before any element that
+    consumes payload (here filesink), so downstream work never runs on the
+    pre-finalize device scalars (code-review regression)."""
+    out_f = tmp_path / "fused.bin"
+    out_u = tmp_path / "unfused.bin"
+    frames = [np.random.default_rng(7).integers(0, 9, (8, 4)).astype(np.uint8)]
+    for fuse, path in ((True, out_f), (False, out_u)):
+        pipe = parse_launch(
+            "appsrc name=src ! "
+            "tensor_transform mode=arithmetic option=typecast:float32,mul:2.0 ! "
+            f"tensor_filter framework=jax model={linear_model} ! "
+            "tensor_decoder mode=image_labeling ! "
+            f"queue ! filesink location={path}")
+        pipe._fuse = fuse
+        src = pipe.get("src")
+        pipe.start()
+        try:
+            src.push([frames[0].copy()])
+            src.end_of_stream()
+            msg = pipe.wait(timeout=60)
+            assert msg is not None and msg.kind == "eos", msg
+        finally:
+            pipe.stop()
+    data_f, data_u = out_f.read_bytes(), out_u.read_bytes()
+    assert data_f == data_u  # label text, not raw argmax scalars
+    assert data_f.decode().isdigit()
+
+
+def test_fused_decoder_to_host_false_still_finalized(linear_model):
+    """to_host=false must not leak pre-finalize scalars to the app
+    (code-review regression): the sink applies a pending finalize always."""
+    frames = [np.ones((8, 4), np.uint8)]
+    pipe, out = _run_dec(frames, fuse=True, opts="")
+    pipe2 = parse_launch(DEC_DESC.format(m=linear_model, opts="").replace(
+        "to-host=true", "to-host=false"))
+    src, sink = pipe2.get("src"), pipe2.get("sink")
+    pipe2.start()
+    try:
+        src.push([frames[0].copy()])
+        src.end_of_stream()
+        assert pipe2.wait(timeout=60).kind == "eos"
+    finally:
+        pipe2.stop()
+    a, b = out[0], sink.buffers[0]
+    assert b.finalize is None and b.meta["label"] == a.meta["label"]
+    np.testing.assert_array_equal(np.asarray(a[0]), np.asarray(b[0]))
