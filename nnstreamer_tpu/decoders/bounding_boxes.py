@@ -103,6 +103,57 @@ def draw_boxes(width: int, height: int, detections: List[dict]
     return img
 
 
+#: device-path caps: greedy NMS keeps at most this many boxes per class /
+#: in total (fixed shapes for XLA; the host path is unbounded)
+DEVICE_K_PER_CLASS = 32
+DEVICE_K_TOTAL = 100
+
+
+def _jax_nms(boxes, scores, iou_thresh, k):
+    """Greedy NMS with static output size: (indices [k], scores [k]).
+
+    Same selection rule as :func:`nms` (suppress iou > thresh); entries
+    whose score is 0 are padding. Runs as a ``fori_loop`` so the whole
+    decode stays one XLA program."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    def body(i, state):
+        left, keep_i, keep_s = state
+        j = jnp.argmax(left)
+        s = left[j]
+        keep_i = keep_i.at[i].set(j.astype(jnp.int32))
+        keep_s = keep_s.at[i].set(s)
+        b = boxes[j]
+        yy1 = jnp.maximum(b[0], boxes[:, 0])
+        xx1 = jnp.maximum(b[1], boxes[:, 1])
+        yy2 = jnp.minimum(b[2], boxes[:, 2])
+        xx2 = jnp.minimum(b[3], boxes[:, 3])
+        inter = jnp.maximum(0.0, yy2 - yy1) * jnp.maximum(0.0, xx2 - xx1)
+        area_b = (b[2] - b[0]) * (b[3] - b[1])
+        areas = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+        iou = inter / jnp.maximum(area_b + areas - inter, 1e-9)
+        left = jnp.where(iou > iou_thresh, 0.0, left).at[j].set(0.0)
+        return left, keep_i, keep_s
+
+    init = (scores, jnp.zeros((k,), jnp.int32), jnp.zeros((k,), jnp.float32))
+    _, keep_i, keep_s = lax.fori_loop(0, k, body, init)
+    return keep_i, keep_s
+
+
+def _rows_topk(boxes, cls_ids, scores, k_total):
+    """Select the k_total highest-scoring (box, class, score) rows and pack
+    them as [k_total, 6] = (y1,x1,y2,x2,class,score); score==0 is padding."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    top_s, top_i = lax.top_k(scores, min(k_total, scores.shape[0]))
+    sel = boxes[top_i]
+    cls = cls_ids[top_i].astype(jnp.float32)
+    return jnp.concatenate(
+        [sel, cls[:, None], top_s[:, None]], axis=1)
+
+
 @subplugin(DECODER, "bounding_boxes")
 class BoundingBoxes:
     def __init__(self):
@@ -198,6 +249,10 @@ class BoundingBoxes:
         else:
             raise ValueError(f"bounding_boxes: unknown mode {mode!r}")
 
+        return self._emit(buf, dets, o)
+
+    def _emit(self, buf: TensorBuffer, dets: List[dict], o: dict
+              ) -> TensorBuffer:
         if self._labels is None and o["labels_path"]:
             from nnstreamer_tpu.decoders.image_labeling import load_labels
 
@@ -216,3 +271,104 @@ class BoundingBoxes:
             return buf.with_tensors([flat]).replace(meta=meta)
         overlay = draw_boxes(o["width"], o["height"], dets)
         return buf.with_tensors([overlay]).replace(meta=meta)
+
+    # -- fused-region split (elements/decoder.py device_stage) ---------------
+    def device_kernel(self, options):
+        """Device half of decode(): anchor decode + sigmoid + per-class
+        greedy NMS + global top-k, entirely inside the fused XLA program —
+        only [DEVICE_K_TOTAL, 6] rows ever leave the device. The host path
+        (decode()) is unbounded; the device path caps detections at
+        DEVICE_K_PER_CLASS per class / DEVICE_K_TOTAL total."""
+        import jax
+        import jax.numpy as jnp
+
+        o = self._opts(options)
+        mode = o["mode"]
+        thresh, iou_t = o["score_thresh"], o["iou_thresh"]
+
+        if mode == "mobilenet-ssd":
+            from nnstreamer_tpu.models.ssd_mobilenet import anchor_grid
+
+            anchors = jnp.asarray(anchor_grid(o["width"]), jnp.float32)
+
+            def fn(consts, tensors):
+                anc = consts
+                box_enc = tensors[0].astype(jnp.float32)
+                scores = tensors[1].astype(jnp.float32)
+                if box_enc.ndim == 3:  # [N,A,4] batch — host uses image 0
+                    box_enc, scores = box_enc[0], scores[0]
+                box_enc = box_enc.reshape(-1, 4)
+                scores = scores.reshape(box_enc.shape[0], -1)
+                cy = box_enc[:, 0] / 10.0 * anc[:, 2] + anc[:, 0]
+                cx = box_enc[:, 1] / 10.0 * anc[:, 3] + anc[:, 1]
+                h = jnp.exp(box_enc[:, 2] / 5.0) * anc[:, 2]
+                w = jnp.exp(box_enc[:, 3] / 5.0) * anc[:, 3]
+                boxes = jnp.stack([cy - h / 2, cx - w / 2,
+                                   cy + h / 2, cx + w / 2], axis=1)
+                probs = jax.nn.sigmoid(scores)
+
+                def per_class(cls_probs):
+                    s = jnp.where(cls_probs >= thresh, cls_probs, 0.0)
+                    return _jax_nms(boxes, s, iou_t, DEVICE_K_PER_CLASS)
+
+                # class 0 = background (host decode_ssd skips it too)
+                idx, sc = jax.vmap(per_class, in_axes=1)(probs[:, 1:])
+                n_cls = idx.shape[0]
+                cls_ids = jnp.broadcast_to(
+                    jnp.arange(1, n_cls + 1)[:, None], idx.shape)
+                flat_boxes = boxes[idx.reshape(-1)]
+                return [_rows_topk(flat_boxes, cls_ids.reshape(-1),
+                                   sc.reshape(-1), DEVICE_K_TOTAL)]
+
+            return anchors, fn
+
+        if mode == "yolov5":
+            def fn(consts, tensors):
+                pred = tensors[0].astype(jnp.float32)
+                if pred.ndim == 3:  # [N,A,C] batch — host uses image 0
+                    pred = pred[0]
+                pred = pred.reshape(-1, pred.shape[-1])
+                obj = jax.nn.sigmoid(pred[:, 4])
+                cls_p = jax.nn.sigmoid(pred[:, 5:]) * obj[:, None]
+                best = jnp.argmax(cls_p, axis=1)
+                score = jnp.max(cls_p, axis=1)
+                score = jnp.where(score >= thresh, score, 0.0)
+                cx, cy, w, h = (pred[:, i] for i in range(4))
+                boxes = jnp.stack([cy - h / 2, cx - w / 2,
+                                   cy + h / 2, cx + w / 2], axis=1)
+                idx, sc = _jax_nms(boxes, score, iou_t, DEVICE_K_TOTAL)
+                return [jnp.concatenate(
+                    [boxes[idx], best[idx].astype(jnp.float32)[:, None],
+                     sc[:, None]], axis=1)]
+
+            return None, fn
+
+        if mode == "mobilenet-ssd-postprocess":
+            def fn(consts, tensors):
+                boxes = tensors[0].reshape(-1, 4).astype(jnp.float32)
+                scores = tensors[1].reshape(-1).astype(jnp.float32)
+                if len(tensors) > 2:
+                    classes = tensors[2].reshape(-1).astype(jnp.float32)
+                else:
+                    classes = jnp.ones_like(scores)
+                masked = jnp.where(scores >= thresh, scores, 0.0)
+                k = min(DEVICE_K_TOTAL, masked.shape[0])
+                _, top_i = jax.lax.top_k(masked, k)
+                # host path emits in anchor order — restore it
+                top_i = jnp.sort(top_i)
+                return [jnp.concatenate(
+                    [boxes[top_i], classes[top_i][:, None],
+                     masked[top_i][:, None]], axis=1)]
+
+            return None, fn
+
+        return None  # ov-person-detection: host-only semantics
+
+    def host_finalize(self, host_buf: TensorBuffer, config, options
+                      ) -> TensorBuffer:
+        o = self._opts(options)
+        rows = np.asarray(host_buf[0], np.float32).reshape(-1, 6)
+        dets = [{"class": int(r[4]), "score": float(r[5]),
+                 "box": [float(r[0]), float(r[1]), float(r[2]), float(r[3])]}
+                for r in rows if r[5] > 0.0]
+        return self._emit(host_buf, dets, o)

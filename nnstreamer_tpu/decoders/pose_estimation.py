@@ -92,6 +92,9 @@ class PoseEstimation:
             if offs.ndim == 4:
                 offs = offs[0]
         kps = decode_pose(heat, offs, o["threshold"])
+        return self._emit(buf, kps, o)
+
+    def _emit(self, buf: TensorBuffer, kps, o) -> TensorBuffer:
         meta = {**buf.meta, "keypoints": kps}
         if o["meta_only"]:
             flat = np.asarray([[kp["y"], kp["x"], kp["score"]] for kp in kps],
@@ -100,3 +103,47 @@ class PoseEstimation:
         return buf.with_tensors(
             [draw_pose(o["width"], o["height"], kps)]
         ).replace(meta=meta)
+
+    # -- fused-region split (elements/decoder.py device_stage) ---------------
+    def device_kernel(self, options):
+        """Device half of decode(): per-keypoint heatmap argmax (+offset
+        refinement) inside the fused XLA program — [K, 3] (y, x, score)
+        rows leave the device instead of full heatmaps."""
+        import jax.numpy as jnp
+
+        def fn(consts, tensors):
+            heat = tensors[0].astype(jnp.float32)
+            if heat.ndim == 4:
+                heat = heat[0]
+            H, W, K = heat.shape
+            flat = heat.reshape(-1, K)
+            j = jnp.argmax(flat, axis=0)                      # [K]
+            score = jnp.take_along_axis(flat, j[None, :], axis=0)[0]
+            ys = (j // W).astype(jnp.float32)
+            xs = (j % W).astype(jnp.float32)
+            if len(tensors) > 1:
+                offs = tensors[1].astype(jnp.float32)
+                if offs.ndim == 4:
+                    offs = offs[0]
+                offs_flat = offs.reshape(-1, offs.shape[-1])
+                kk = jnp.arange(K)
+                ys = ys + offs_flat[j, kk]
+                xs = xs + offs_flat[j, K + kk]
+            y = ys / max(H - 1, 1)
+            x = xs / max(W - 1, 1)
+            return [jnp.stack([y, x, score], axis=1)]
+
+        return None, fn
+
+    def host_finalize(self, host_buf: TensorBuffer, config, options
+                      ) -> TensorBuffer:
+        o = self._opts(options)
+        rows = np.asarray(host_buf[0], np.float32).reshape(-1, 3)
+        kps = [{
+            "keypoint": k,
+            "y": float(r[0]),
+            "x": float(r[1]),
+            "score": float(r[2]),
+            "visible": float(r[2]) >= o["threshold"],
+        } for k, r in enumerate(rows)]
+        return self._emit(host_buf, kps, o)

@@ -198,18 +198,30 @@ class FusedRegion(Element):
                 compiled = self._build()
             except FlowError:
                 # a member stopped being fusible mid-stream (e.g. throttle
-                # enabled at runtime) — restore the original links and send
-                # this and all future buffers down the member chain; the
-                # unfused pipeline's behavior resumes seamlessly
-                self.unsplice()
-                first = self.members[0]
-                return first._chain_entry(first.sinkpads[0], buf)
+                # enabled at runtime) — the unfused pipeline's behavior
+                # resumes seamlessly
+                return self._fallback(buf)
         consts, jitted, finalize = compiled
-        out = jitted(consts, list(buf.tensors))
+        try:
+            out = jitted(consts, list(buf.tensors))
+        except Exception as e:  # noqa: BLE001 — fusion is an optimization,
+            # never a failure: a stage that won't trace/execute (shape
+            # mismatch only visible at trace time, etc.) falls back to the
+            # member chain, whose own error handling is authoritative
+            log.warning("%s: fused program failed (%s); falling back to "
+                        "member chain", self.name, e)
+            return self._fallback(buf)
         out_buf = buf.with_tensors(list(out))
         if finalize is not None:
             out_buf = out_buf.replace(finalize=finalize)
         return self.srcpad.push(out_buf)
+
+    def _fallback(self, buf):
+        """Restore the original element links and replay ``buf`` (and all
+        future buffers) through the member chain."""
+        self.unsplice()
+        first = self.members[0]
+        return first._chain_entry(first.sinkpads[0], buf)
 
     # -- events --------------------------------------------------------------
     def sink_event(self, pad: Pad, event: Event) -> None:

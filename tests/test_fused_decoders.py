@@ -1,0 +1,205 @@
+"""Fused device kernels for bounding_boxes / pose_estimation must match the
+host decode() paths (fused pipelines indistinguishable except for speed)."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import parse_launch
+from nnstreamer_tpu.filters.jax_backend import (
+    register_jax_model,
+    unregister_jax_model,
+)
+
+
+def _run_pipe(model, dec_opts, frame, fuse):
+    pipe = parse_launch(
+        "appsrc name=src ! tensor_transform mode=typecast option=float32 ! "
+        f"tensor_filter framework=jax model={model} ! "
+        f"tensor_decoder mode={dec_opts} ! tensor_sink name=sink to-host=true")
+    pipe._fuse = fuse
+    src, sink = pipe.get("src"), pipe.get("sink")
+    pipe.start()
+    try:
+        src.push([frame.copy()])
+        src.end_of_stream()
+        msg = pipe.wait(timeout=60)
+        assert msg is not None and msg.kind == "eos", msg
+    finally:
+        pipe.stop()
+    if fuse:
+        assert pipe._regions
+        members = [m.ELEMENT_NAME for m in pipe._regions[0].members]
+        assert "tensor_decoder" in members, members
+    else:
+        assert not pipe._regions
+    return sink.buffers[0]
+
+
+def _det_key(d):
+    return (d["class"], round(d["score"], 5), tuple(round(v, 4) for v in d["box"]))
+
+
+@pytest.fixture
+def ssd_model():
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu.models.ssd_mobilenet import anchor_grid
+
+    anchors = anchor_grid(300)
+    A = anchors.shape[0]
+    rng = np.random.default_rng(3)
+    box_enc = jnp.asarray(rng.normal(0, 0.5, (A, 4)), jnp.float32)
+    # a few strong detections, rest background
+    logits = np.full((A, 5), -6.0, np.float32)
+    for a, c in ((10, 1), (500, 2), (1200, 3), (11, 1)):
+        logits[a, c] = 4.0
+    logits = jnp.asarray(logits)
+
+    def fn(x):
+        return box_enc, logits
+
+    register_jax_model("ssd_toy", fn, None)
+    yield "ssd_toy"
+    unregister_jax_model("ssd_toy")
+
+
+def test_fused_ssd_matches_host(ssd_model):
+    frame = np.zeros((4,), np.uint8)
+    opts = "bounding_boxes option1=mobilenet-ssd option3=0.5 option7=meta"
+    f = _run_pipe(ssd_model, opts, frame, fuse=True)
+    u = _run_pipe(ssd_model, opts, frame, fuse=False)
+    df, du = f.meta["detections"], u.meta["detections"]
+    assert len(df) == len(du) > 0
+    assert {_det_key(d) for d in df} == {_det_key(d) for d in du}
+    np.testing.assert_allclose(np.asarray(f[0]), np.asarray(u[0]), atol=1e-4)
+
+
+@pytest.fixture
+def postproc_model():
+    import jax.numpy as jnp
+
+    boxes = jnp.asarray([[0.1, 0.1, 0.4, 0.4],
+                         [0.5, 0.5, 0.9, 0.9],
+                         [0.2, 0.2, 0.3, 0.3]], jnp.float32)
+    scores = jnp.asarray([0.9, 0.2, 0.7], jnp.float32)
+    classes = jnp.asarray([1, 2, 3], jnp.float32)
+
+    def fn(x):
+        return boxes, scores, classes
+
+    register_jax_model("postproc_toy", fn, None)
+    yield "postproc_toy"
+    unregister_jax_model("postproc_toy")
+
+
+def test_fused_postprocess_matches_host(postproc_model):
+    frame = np.zeros((4,), np.uint8)
+    opts = "bounding_boxes option1=mobilenet-ssd-postprocess option3=0.5 option7=meta"
+    f = _run_pipe(postproc_model, opts, frame, fuse=True)
+    u = _run_pipe(postproc_model, opts, frame, fuse=False)
+    # host path preserves anchor order — fused must too
+    assert [_det_key(d) for d in f.meta["detections"]] == \
+        [_det_key(d) for d in u.meta["detections"]]
+    assert len(f.meta["detections"]) == 2
+
+
+@pytest.fixture
+def yolo_model():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(5)
+    pred = np.full((40, 9), -6.0, np.float32)  # 4 box + obj + 4 classes
+    pred[:, :4] = rng.uniform(0.2, 0.8, (40, 4)).astype(np.float32)
+    for a, c in ((3, 0), (17, 2), (30, 3)):
+        pred[a, 4] = 5.0          # objectness
+        pred[a, 5 + c] = 5.0      # class logit
+    pred = jnp.asarray(pred)
+
+    def fn(x):
+        return pred
+
+    register_jax_model("yolo_toy", fn, None)
+    yield "yolo_toy"
+    unregister_jax_model("yolo_toy")
+
+
+def test_fused_yolov5_matches_host(yolo_model):
+    frame = np.zeros((4,), np.uint8)
+    opts = "bounding_boxes option1=yolov5 option3=0.5 option7=meta"
+    f = _run_pipe(yolo_model, opts, frame, fuse=True)
+    u = _run_pipe(yolo_model, opts, frame, fuse=False)
+    assert {_det_key(d) for d in f.meta["detections"]} == \
+        {_det_key(d) for d in u.meta["detections"]}
+    assert len(f.meta["detections"]) == 3
+
+
+@pytest.fixture
+def pose_model():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(9)
+    H = W = 9
+    K = 5
+    heat = rng.uniform(0, 0.2, (H, W, K)).astype(np.float32)
+    for k in range(K):
+        heat[1 + k, 2 + k, k] = 0.9
+    offs = rng.uniform(-0.4, 0.4, (H, W, 2 * K)).astype(np.float32)
+    heat, offs = jnp.asarray(heat), jnp.asarray(offs)
+
+    def fn(x):
+        return heat, offs
+
+    register_jax_model("pose_toy", fn, None)
+    yield "pose_toy"
+    unregister_jax_model("pose_toy")
+
+
+def test_fused_pose_matches_host(pose_model):
+    frame = np.zeros((4,), np.uint8)
+    opts = "pose_estimation option2=meta option3=0.3"
+    f = _run_pipe(pose_model, opts, frame, fuse=True)
+    u = _run_pipe(pose_model, opts, frame, fuse=False)
+    kf, ku = f.meta["keypoints"], u.meta["keypoints"]
+    assert len(kf) == len(ku) == 5
+    for a, b in zip(kf, ku):
+        assert a["keypoint"] == b["keypoint"] and a["visible"] == b["visible"]
+        np.testing.assert_allclose([a["y"], a["x"], a["score"]],
+                                   [b["y"], b["x"], b["score"]], atol=1e-5)
+    np.testing.assert_allclose(np.asarray(f[0]), np.asarray(u[0]), atol=1e-5)
+
+
+def test_fused_overlay_output_matches(pose_model):
+    """Overlay (video) output path also goes through finalize identically."""
+    frame = np.zeros((4,), np.uint8)
+    opts = "pose_estimation option1=64:64 option3=0.3"
+    f = _run_pipe(pose_model, opts, frame, fuse=True)
+    u = _run_pipe(pose_model, opts, frame, fuse=False)
+    np.testing.assert_array_equal(np.asarray(f[0]), np.asarray(u[0]))
+
+
+def test_trace_failure_falls_back_to_member_chain(postproc_model):
+    """A fused program that fails at trace/execute time must unsplice and
+    resume through the member chain, not kill the stream (fusion is an
+    optimization, never a failure)."""
+    pipe = parse_launch(
+        "appsrc name=src ! tensor_transform mode=typecast option=float32 ! "
+        f"tensor_filter framework=jax model={postproc_model} ! "
+        "tensor_decoder mode=bounding_boxes "
+        "option1=mobilenet-ssd-postprocess option3=0.5 option7=meta ! "
+        "tensor_sink name=sink to-host=true")
+    src, sink = pipe.get("src"), pipe.get("sink")
+    pipe.start()
+    try:
+        region = pipe._regions[0]
+        # sabotage the compiled program: a jit that always explodes
+        def boom(consts, tensors):
+            raise RuntimeError("trace bomb")
+        region._compiled = (None, boom, None)
+        src.push([np.zeros((4,), np.uint8)])
+        src.end_of_stream()
+        msg = pipe.wait(timeout=60)
+        assert msg is not None and msg.kind == "eos", msg
+        assert region._dead  # unspliced
+        assert len(sink.buffers[0].meta["detections"]) == 2  # host path ran
+    finally:
+        pipe.stop()
