@@ -12,6 +12,11 @@ Options:
   -v / --verbose   print caps as they are negotiated and buffer counts
   --confchk        print the effective configuration and registries
                    (the reference's tools/development/confchk) and exit
+  --scaffold KIND NAME   generate subplugin boilerplate (the reference's
+                   tools/development/nnstreamerCodeGenCustomFilter.py):
+                   KIND ∈ {filter, decoder, converter}; writes
+                   nnstreamer_tpu_<KIND>_<NAME>.py, the filename the
+                   registry's external search discovers
 """
 
 from __future__ import annotations
@@ -67,6 +72,129 @@ def confchk() -> int:
     return 0
 
 
+_SCAFFOLDS = {
+    "filter": '''"""Custom filter subplugin "{name}".
+
+Drop this file's directory onto the filter search path and the registry
+discovers it on first use (the reference's dlopen-from-conf-paths flow):
+
+    export NNSTREAMER_TPU_FILTER_PATH=$PWD
+    nns-launch "... ! tensor_filter framework={name} model=x ! ..."
+"""
+
+import numpy as np
+
+from nnstreamer_tpu.filters.api import FilterFramework, FilterProperties
+from nnstreamer_tpu.registry import FILTER, subplugin
+from nnstreamer_tpu.tensors.types import TensorsInfo
+
+
+@subplugin(FILTER, "{name}")
+class {cls}(FilterFramework):
+    NAME = "{name}"
+
+    def open(self, props: FilterProperties) -> None:
+        super().open(props)
+        # load/prepare your model here; props.model / props.custom are set
+
+    def get_model_info(self):
+        # (None, None) = adapt to any input; set_input_info decides output.
+        # Return fixed TensorsInfo pairs instead for a fixed-shape model.
+        return None, None
+
+    def set_input_info(self, in_info: TensorsInfo) -> TensorsInfo:
+        self._info = in_info
+        return in_info  # passthrough: output shapes = input shapes
+
+    def invoke(self, inputs):
+        # inputs: list of arrays; return list of output arrays
+        return [np.asarray(x) for x in inputs]
+''',
+    "decoder": '''"""Custom decoder subplugin "{name}".
+
+    export NNSTREAMER_TPU_DECODER_PATH=$PWD
+    nns-launch "... ! tensor_decoder mode={name} ! ..."
+"""
+
+import numpy as np
+
+from nnstreamer_tpu.pipeline.caps import Caps
+from nnstreamer_tpu.registry import DECODER, subplugin
+
+
+@subplugin(DECODER, "{name}")
+class {cls}:
+    def out_caps(self, config, options) -> Caps:
+        return Caps("other/tensors", {{"format": "flexible"}})
+
+    def decode(self, buf, config, options):
+        # buf.tensors are host numpy arrays; return a new TensorBuffer
+        return buf.with_tensors([np.asarray(t) for t in buf.tensors])
+
+    # Optional fused-device split — delete if host-only:
+    # def device_kernel(self, options):
+    #     def fn(consts, tensors):  # traced by JAX inside the fused region
+    #         return tensors
+    #     return None, fn
+    # def host_finalize(self, host_buf, config, options):
+    #     return host_buf
+''',
+    "converter": '''"""Custom converter subplugin "{name}".
+
+    export NNSTREAMER_TPU_CONVERTER_PATH=$PWD
+    nns-launch "... ! tensor_converter mode=custom-code:{name} ! ..."
+"""
+
+from nnstreamer_tpu.registry import CONVERTER, subplugin
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
+
+
+@subplugin(CONVERTER, "{name}")
+class {cls}:
+    def convert(self, buf: TensorBuffer, in_caps) -> TensorBuffer:
+        # parse buf.tensors (host arrays) into the tensors you want to emit
+        return buf
+''',
+}
+
+
+def scaffold(kind: str, name: str, out_dir: str = ".") -> int:
+    """Write subplugin boilerplate (reference codegen tool equivalent)."""
+    import keyword
+    import os
+    import re
+
+    from nnstreamer_tpu.registry import external_subplugin_filename
+
+    if kind not in _SCAFFOLDS:
+        print(f"nns-launch: unknown scaffold kind {kind!r} "
+              f"(choose from {', '.join(_SCAFFOLDS)})", file=sys.stderr)
+        return 2
+    if not re.fullmatch(r"[A-Za-z_][A-Za-z0-9_-]*", name):
+        print(f"nns-launch: invalid subplugin name {name!r}", file=sys.stderr)
+        return 2
+    cls = "".join(p.capitalize() for p in re.split(r"[_-]+", name))
+    # guard the generated class name: keywords ("none" → None), digit-leading
+    # segments ("_1a" → 1a), or shadowing a template import ("caps" → Caps)
+    if not cls or not cls[0].isalpha():
+        cls = "Plugin" + cls
+    if (not cls.isidentifier() or keyword.iskeyword(cls)
+            or cls in ("TensorBuffer", "TensorsInfo", "Caps",
+                       "FilterFramework", "FilterProperties")):
+        cls += "Plugin"
+    # the registry's external search looks for exactly this filename on the
+    # NNSTREAMER_TPU_<KIND>_PATH search path
+    path = os.path.join(out_dir, external_subplugin_filename(kind, name))
+    if os.path.exists(path):
+        print(f"nns-launch: {path} already exists", file=sys.stderr)
+        return 2
+    with open(path, "w") as f:
+        f.write(_SCAFFOLDS[kind].format(name=name, cls=cls))
+    print(f"wrote {path} ({kind} subplugin '{name}') — add its directory to "
+          f"NNSTREAMER_TPU_{kind.upper()}_PATH to use it")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="nns-launch",
@@ -80,10 +208,15 @@ def main(argv=None) -> int:
     ap.add_argument("-v", "--verbose", action="store_true")
     ap.add_argument("--confchk", action="store_true",
                     help="print effective configuration and exit")
+    ap.add_argument("--scaffold", nargs=2, metavar=("KIND", "NAME"),
+                    help="generate subplugin boilerplate "
+                         "(filter|decoder|converter) and exit")
     args = ap.parse_args(argv)
 
     if args.confchk:
         return confchk()
+    if args.scaffold:
+        return scaffold(*args.scaffold)
     if not args.description:
         ap.error("pipeline description required (or --confchk)")
 

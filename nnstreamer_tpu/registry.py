@@ -114,10 +114,16 @@ def _try_import(module: str) -> bool:
         return False
 
 
+def external_subplugin_filename(kind: str, name: str) -> str:
+    """The on-disk filename the external search expects — shared with the
+    ``--scaffold`` codegen so the two can never drift."""
+    return f"nnstreamer_tpu_{kind}_{name}.py"
+
+
 def _search_external(kind: str, name: str) -> None:
     """Load ``nnstreamer_tpu_<kind>_<name>.py`` from configured search paths
     (the dlopen-from-conf-paths analog, nnstreamer_subplugin.c:107-135)."""
-    fname = f"nnstreamer_tpu_{kind}_{name}.py"
+    fname = external_subplugin_filename(kind, name)
     for path in get_conf().subplugin_paths(kind):
         full = os.path.join(path, fname)
         if os.path.isfile(full):

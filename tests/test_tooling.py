@@ -85,3 +85,59 @@ def test_bounding_boxes_overlay_labels(tmp_path):
     assert overlay.shape == (100, 100, 4)
     box_only = 2 * (80 - 20) + 2 * (60 - 30) + 4  # rough outline pixel count
     assert (overlay[:, :, 1] == 255).sum() > box_only  # text adds pixels
+
+
+def test_scaffold_generates_working_subplugins(tmp_path, monkeypatch):
+    """--scaffold output must be discoverable via the external search path
+    and runnable in a pipeline unmodified (reference codegen tool parity)."""
+    import numpy as np
+
+    from nnstreamer_tpu import parse_launch
+    from nnstreamer_tpu.cli import scaffold
+
+    for kind in ("filter", "decoder", "converter"):
+        assert scaffold(kind, "genx", str(tmp_path)) == 0
+        assert (tmp_path / f"nnstreamer_tpu_{kind}_genx.py").exists()
+    # duplicate refuses
+    assert scaffold("filter", "genx", str(tmp_path)) == 2
+    assert scaffold("bogus", "x", str(tmp_path)) == 2
+    assert scaffold("filter", "bad name!", str(tmp_path)) == 2
+
+    monkeypatch.setenv("NNSTREAMER_TPU_FILTER_PATH", str(tmp_path))
+    monkeypatch.setenv("NNSTREAMER_TPU_DECODER_PATH", str(tmp_path))
+    from nnstreamer_tpu.config import get_conf
+    get_conf(refresh=True)
+
+    pipe = parse_launch(
+        "appsrc name=src ! tensor_transform mode=typecast option=float32 ! "
+        "tensor_filter framework=genx model=unused ! "
+        "tensor_decoder mode=genx ! tensor_sink name=sink")
+    src, sink = pipe.get("src"), pipe.get("sink")
+    pipe.start()
+    try:
+        src.push([np.ones((4, 4), np.uint8)])
+        src.end_of_stream()
+        msg = pipe.wait(timeout=60)
+        assert msg is not None and msg.kind == "eos", msg
+    finally:
+        pipe.stop()
+    assert len(sink.buffers) == 1
+    np.testing.assert_allclose(np.asarray(sink.buffers[0][0]),
+                               np.ones((4, 4), np.float32))
+
+
+def test_scaffold_edge_names(tmp_path):
+    """Keyword / digit-leading / import-shadowing names must still produce
+    importable files with valid class names (code-review regression)."""
+    import ast
+
+    from nnstreamer_tpu.cli import scaffold
+
+    for kind, name in (("decoder", "none"), ("filter", "_1a"),
+                       ("decoder", "caps")):
+        assert scaffold(kind, name, str(tmp_path)) == 0
+        src = (tmp_path / f"nnstreamer_tpu_{kind}_{name}.py").read_text()
+        tree = ast.parse(src)  # would raise SyntaxError for class None/1a
+        cls_names = [n.name for n in ast.walk(tree)
+                     if isinstance(n, ast.ClassDef)]
+        assert cls_names and cls_names[0] not in ("None", "Caps")
