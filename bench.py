@@ -59,38 +59,189 @@ def build_pipeline(batch: int = 1):
 
 
 def measure_pipeline() -> dict:
-    lat = []
     pipe = build_pipeline()
-    sink = pipe.get("sink")
-    t_start = [None]
-    frame_t = []
-
-    def on_data(buf):
-        frame_t.append(time.monotonic())
-
-    sink.connect(on_data)
-    t0 = time.monotonic()
-    msg = pipe.run(timeout=600)
-    t1 = time.monotonic()
-    if msg is None or msg.kind != "eos":
-        raise RuntimeError(f"bench pipeline failed: {msg}")
-    # drop warmup (includes the jit compile). Sustained fps = frames/span
-    # over the steady window — NOT median inter-arrival, which overstates
-    # rate when arrivals are bursty (device→host syncs batch up frames).
+    frame_t = _collect(pipe)
     steady = frame_t[WARMUP:]
     if len(steady) >= 2:
-        span = steady[-1] - steady[0]
-        fps = (len(steady) - 1) / span
         deltas = np.diff(steady)
         p50_ms = float(np.percentile(deltas, 50)) * 1e3
         p90_ms = float(np.percentile(deltas, 90)) * 1e3
+    elif len(frame_t) >= 2:
+        p50_ms = p90_ms = \
+            (frame_t[-1] - frame_t[0]) / (len(frame_t) - 1) * 1e3
     else:
-        fps = N_FRAMES / (t1 - t0)
-        p50_ms = p90_ms = (t1 - t0) / N_FRAMES * 1e3
+        p50_ms = p90_ms = 0.0
     filt = pipe.get("filter")
-    return dict(fps=fps, p50_ms=p50_ms, p90_ms=p90_ms,
+    return dict(fps=_steady_fps(frame_t), p50_ms=p50_ms, p90_ms=p90_ms,
                 invoke_latency_us=filt.get_property("latency"),
                 frames=len(frame_t))
+
+
+def _steady_fps(frame_t, frames_per_buffer: int = 1):
+    """Sustained fps = frames/span over the post-warmup window — NOT median
+    inter-arrival, which overstates rate when arrivals are bursty (device→
+    host syncs batch up frames). Falls back to the whole run when too few
+    frames survive warmup (e.g. tiny BENCH_FRAMES)."""
+    steady = frame_t[WARMUP:]
+    if len(steady) < 2:
+        steady = frame_t
+    if len(steady) < 2:
+        print("bench: too few frames for a rate estimate", file=sys.stderr)
+        return 0.0
+    span = steady[-1] - steady[0]
+    return (len(steady) - 1) * frames_per_buffer / span
+
+
+def _collect(pipe, sink_name="sink", timeout=600):
+    frame_t = []
+    pipe.get(sink_name).connect(lambda b: frame_t.append(time.monotonic()))
+    msg = pipe.run(timeout=timeout)
+    if msg is None or msg.kind != "eos":
+        raise RuntimeError(f"bench pipeline failed: {msg}")
+    return frame_t
+
+
+def measure_ssd() -> dict:
+    """Config #2 (BASELINE.md): SSD-MobileNet + bounding-box decode. The
+    whole post-process — anchor decode, sigmoid, per-class NMS — runs inside
+    the fused XLA program (decoders/bounding_boxes.py device_kernel)."""
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu import parse_launch
+    from nnstreamer_tpu.filters.jax_backend import register_jax_model
+    from nnstreamer_tpu.models.ssd_mobilenet import ssd_mobilenet
+
+    apply_fn, params, in_info, out_info = ssd_mobilenet(
+        image_size=300, batch=1, dtype=jnp.bfloat16)
+    register_jax_model("ssd_bench", apply_fn, params,
+                       in_info=in_info, out_info=out_info)
+    pipe = parse_launch(
+        f"videotestsrc num-buffers={N_FRAMES} width=300 height=300 "
+        "pattern=gradient ! tensor_converter ! "
+        "tensor_transform mode=arithmetic "
+        "option=typecast:float32,add:-127.5,div:127.5 ! "
+        "tensor_filter framework=jax model=ssd_bench name=filter ! "
+        "tensor_decoder mode=bounding_boxes option1=mobilenet-ssd "
+        "option4=300:300 option7=meta ! "
+        "queue max-size-buffers=32 prefetch-host=true ! "
+        "tensor_sink name=sink to-host=true")
+    frame_t = _collect(pipe)
+    return dict(metric="ssd_mobilenet_300_pipeline_fps",
+                fps=_steady_fps(frame_t), frames=len(frame_t))
+
+
+def measure_pose_mux() -> dict:
+    """Config #3: 4 sources → tensor_mux → ONE batched PoseNet invoke on
+    the chip (the reference fans streams out to parallel CPU branches; the
+    TPU way is mux → batch dim → single MXU-friendly program)."""
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu import parse_launch
+    from nnstreamer_tpu.filters.jax_backend import register_jax_model
+    from nnstreamer_tpu.models.posenet import posenet
+
+    apply_fn, params, _, _ = posenet(image_size=257, batch=4,
+                                     dtype=jnp.bfloat16)
+
+    def batched4(p, a, b, c, d):
+        x = jnp.concatenate([a, b, c, d], axis=0).astype(jnp.float32)
+        x = (x - 127.5) / 127.5
+        heat, offs = apply_fn(p, x)
+        return heat, offs
+
+    register_jax_model("pose4_bench", batched4, params)
+    n = max(N_FRAMES // 4, 30)
+    srcs = " ".join(
+        f"videotestsrc num-buffers={n} width=257 height=257 "
+        "pattern=gradient ! tensor_converter ! mux. "
+        for _ in range(4))
+    pipe = parse_launch(
+        f"tensor_mux name=mux sync-mode=slowest ! "
+        "tensor_filter framework=jax model=pose4_bench name=filter ! "
+        "queue max-size-buffers=32 prefetch-host=true ! "
+        "tensor_sink name=sink to-host=false " + srcs)
+    frame_t = _collect(pipe)
+    return dict(metric="posenet_mux4_batched_fps",
+                fps=_steady_fps(frame_t, frames_per_buffer=4),
+                frames=len(frame_t) * 4)
+
+
+def measure_query() -> dict:
+    """Config #4: tensor_query offload loopback — client pipeline sends
+    frames over the framed-TCP query protocol to a server pipeline running
+    the MobileNetV2 filter, results return by client id."""
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu import parse_launch
+    from nnstreamer_tpu.filters.jax_backend import register_jax_model
+    from nnstreamer_tpu.models.mobilenet_v2 import mobilenet_v2
+
+    apply_fn, params, in_info, out_info = mobilenet_v2(
+        image_size=IMAGE, batch=1, dtype=jnp.bfloat16)
+
+    def net(p, x):
+        xf = (x.astype(jnp.float32) - 127.5) / 127.5
+        return apply_fn(p, xf)
+
+    register_jax_model("mnv2_query_bench", net, params)
+    server = parse_launch(
+        "tensor_query_serversrc name=ssrc port=0 ! "
+        "tensor_filter framework=jax model=mnv2_query_bench ! "
+        "tensor_query_serversink")
+    server.start()
+    try:
+        port = server.get("ssrc").port
+        client = parse_launch(
+            f"videotestsrc num-buffers={N_FRAMES} width={IMAGE} "
+            f"height={IMAGE} pattern=gradient ! tensor_converter ! "
+            f"tensor_query_client dest-host=127.0.0.1 dest-port={port} "
+            "timeout=120 ! "  # first server-side jit compile can be slow
+            "tensor_sink name=sink to-host=true")
+        frame_t = _collect(client)
+    finally:
+        server.stop()
+    return dict(metric="query_offload_mobilenetv2_fps",
+                fps=_steady_fps(frame_t), frames=len(frame_t))
+
+
+def measure_lstm() -> dict:
+    """Config #5: tensor_repo recurrence — LSTM state circulates through a
+    repo slot as device-resident arrays; one filter invoke per step."""
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu import parse_launch
+    from nnstreamer_tpu.filters.jax_backend import register_jax_model
+    from nnstreamer_tpu.models.lstm import lstm_cell
+
+    hidden = 128
+    apply_fn, params, _, _ = lstm_cell(input_dim=hidden, hidden=hidden,
+                                       batch=1)
+
+    def step(p, state):
+        s = state.reshape(1, 2 * hidden).astype(jnp.float32)
+        h, c = s[:, :hidden], s[:, hidden:]
+        y, h2, c2 = apply_fn(p, h, h, c)  # self-feeding recurrence
+        return jnp.concatenate([h2, c2], axis=1).reshape(2 * hidden)
+
+    register_jax_model("lstm_bench", step, params)
+    pipe = parse_launch(
+        f"tensor_reposrc slot=lstm_bench num-buffers={N_FRAMES} "
+        f"initial-dim={2 * hidden} initial-type=float32 initial-value=0.01 "
+        "timeout=30 ! "
+        "tensor_filter framework=jax model=lstm_bench name=filter ! "
+        "tee name=t  t. ! tensor_reposink slot=lstm_bench  "
+        "t. ! tensor_sink name=sink to-host=false")
+    frame_t = _collect(pipe)
+    return dict(metric="lstm_repo_recurrence_steps_per_s",
+                fps=_steady_fps(frame_t), frames=len(frame_t))
+
+
+EXTRA_CONFIGS = {
+    "ssd": measure_ssd,
+    "pose4": measure_pose_mux,
+    "query": measure_query,
+    "lstm": measure_lstm,
+}
 
 
 def measure_tflite_baseline() -> float | None:
@@ -133,6 +284,33 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+
+    # secondary configs (BASELINE.md #2-#5): `python bench.py ssd|pose4|
+    # query|lstm` or BENCH_CONFIG env. Default (driver contract): flagship
+    # MobileNetV2 pipeline, ONE JSON line.
+    config = (sys.argv[1] if len(sys.argv) > 1 else
+              os.environ.get("BENCH_CONFIG", "")).strip()
+    if config and config != "mobilenet":
+        if config == "all":
+            for name, fn in EXTRA_CONFIGS.items():
+                r = fn()
+                print(json.dumps({"metric": r["metric"],
+                                  "value": round(r["fps"], 2),
+                                  "unit": "fps", "frames": r["frames"],
+                                  "platform": _platform()}))
+            return
+        if config not in EXTRA_CONFIGS:
+            print(f"bench: unknown config {config!r} "
+                  f"(choose from {', '.join(EXTRA_CONFIGS)})",
+                  file=sys.stderr)
+            sys.exit(2)
+        r = EXTRA_CONFIGS[config]()
+        print(json.dumps({"metric": r["metric"],
+                          "value": round(r["fps"], 2), "unit": "fps",
+                          "frames": r["frames"],
+                          "platform": _platform()}))
+        return
+
     stats = measure_pipeline()
     baseline = measure_tflite_baseline() or FALLBACK_BASELINE_FPS
     result = {

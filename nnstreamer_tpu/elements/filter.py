@@ -182,10 +182,15 @@ class TensorFilter(Element):
         self._in_full_info = cfg.info if cfg.info.is_valid() else None
         if out_info is None:
             if self._in_model_info is None:
-                raise ValueError(
-                    f"{self.name}: cannot negotiate — model has no static "
-                    f"info and input caps carry no dimensions"
-                )
+                # flexible/dimless input caps + shape-polymorphic model:
+                # defer — the first buffer's actual shapes negotiate
+                # (reference flexible-tensor streams, e.g. downstream of
+                # tensor_query_serversrc, carry per-buffer dims)
+                from nnstreamer_tpu.tensors.types import TensorFormat
+
+                self._out_model_info = None
+                return TensorsConfig(format=TensorFormat.FLEXIBLE,
+                                     rate=cfg.rate).to_caps()
             out_info = fw.set_input_info(self._in_model_info)
         self._out_model_info = out_info
         final = self._combined_out_info(out_info)
@@ -218,6 +223,13 @@ class TensorFilter(Element):
             model_inputs = [buf.tensors[i] for _, i in in_comb]
         else:
             model_inputs = buf.tensors
+
+        if self._out_model_info is None and self._in_model_info is None:
+            # deferred negotiation (flexible input): first buffer fixes the
+            # model's shapes
+            derived = TensorsInfo.from_arrays(model_inputs)
+            self._in_model_info = derived
+            self._out_model_info = fw.set_input_info(derived)
 
         if not fw.KEEP_ON_DEVICE:
             model_inputs = [np.asarray(x) if not isinstance(x, np.ndarray)

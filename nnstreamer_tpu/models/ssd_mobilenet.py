@@ -73,7 +73,9 @@ def anchor_grid(image_size: int = 300, strides=(16, 32),
     scales = np.linspace(0.2, 0.9, len(strides) * num_anchors_per_cell)
     si = 0
     for stride in strides:
-        cells = image_size // stride
+        # SAME-padded stride-s convs produce ceil(size/s) cells — the grid
+        # must match the model's feature-map geometry exactly
+        cells = -(-image_size // stride)
         for a in range(num_anchors_per_cell):
             s = scales[si]
             si += 1

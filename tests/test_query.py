@@ -130,3 +130,44 @@ class TestQueryLoopback:
         client.stop()
         assert msg is not None and msg.kind == "error"
         assert "unreachable" in str(msg.error)
+
+
+class TestFlexibleFilterNegotiation:
+    def test_jax_filter_downstream_of_serversrc(self):
+        """A shape-polymorphic jax model must negotiate from the first
+        buffer when input caps are flexible (serversrc output) — the
+        reference's flexible-tensor stream behavior."""
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.filters.jax_backend import (
+            register_jax_model,
+            unregister_jax_model,
+        )
+
+        register_jax_model("flex_double",
+                           lambda x: x.astype(jnp.float32) * 2.0)
+        server = parse_launch(
+            "tensor_query_serversrc name=ssrc port=0 ! "
+            "tensor_filter framework=jax model=flex_double ! "
+            "tensor_query_serversink")
+        server.start()
+        try:
+            port = server.get("ssrc").port
+            client = parse_launch(
+                "videotestsrc num-buffers=3 width=8 height=8 "
+                "pattern=gradient ! tensor_converter ! "
+                f"tensor_query_client dest-host=127.0.0.1 dest-port={port} ! "
+                "tensor_sink name=out")
+            msg = client.run(timeout=60)
+            assert msg is not None and msg.kind == "eos", msg
+            outs = client.get("out").buffers
+            assert len(outs) == 3
+            ref = parse_launch(
+                "videotestsrc num-buffers=1 width=8 height=8 "
+                "pattern=gradient ! tensor_converter ! tensor_sink name=out")
+            ref.run(timeout=30)
+            expected = np.asarray(ref.get("out").buffers[0][0], np.float32) * 2
+            np.testing.assert_allclose(np.asarray(outs[0][0]), expected)
+        finally:
+            server.stop()
+            unregister_jax_model("flex_double")
