@@ -187,6 +187,7 @@ def measure_query() -> dict:
     server = parse_launch(
         "tensor_query_serversrc name=ssrc port=0 ! "
         "tensor_filter framework=jax model=mnv2_query_bench ! "
+        "queue max-size-buffers=32 prefetch-host=true ! "
         "tensor_query_serversink")
     server.start()
     try:
@@ -195,7 +196,8 @@ def measure_query() -> dict:
             f"videotestsrc num-buffers={N_FRAMES} width={IMAGE} "
             f"height={IMAGE} pattern=gradient ! tensor_converter ! "
             f"tensor_query_client dest-host=127.0.0.1 dest-port={port} "
-            "timeout=120 ! "  # first server-side jit compile can be slow
+            "timeout=120 max-in-flight=16 ! "  # pipelined offload; long
+            # timeout covers the first server-side jit compile
             "tensor_sink name=sink to-host=true")
         frame_t = _collect(client)
     finally:

@@ -45,9 +45,36 @@ class ImageSegment:
             labels = seg.argmax(axis=2)
         else:
             labels = seg.reshape(seg.shape[0], seg.shape[1]).astype(int)
+        return self._emit(buf, labels)
+
+    def _emit(self, buf: TensorBuffer, labels: np.ndarray) -> TensorBuffer:
         pal = _palette(int(labels.max()) + 1)
         rgb = pal[labels]
         alpha = np.where(labels > 0, 192, 0).astype(np.uint8)[..., None]
         return buf.with_tensors(
             [np.concatenate([rgb, alpha], axis=2)]
         ).replace(meta={**buf.meta, "segment_labels": labels})
+
+    # -- fused-region split (elements/decoder.py device_stage) ---------------
+    def device_kernel(self, options):
+        """Device half: per-pixel argmax inside the fused program — an
+        [H, W] int32 class map leaves the device instead of [H, W, C]
+        float logits (C× less D2H traffic; palette/alpha stay host-side)."""
+        import jax.numpy as jnp
+
+        def fn(consts, tensors):
+            seg = tensors[0]
+            if seg.ndim == 4:
+                seg = seg[0]
+            if seg.ndim == 3 and seg.shape[2] > 1:
+                labels = jnp.argmax(seg, axis=2)
+            else:
+                labels = seg.reshape(seg.shape[0], seg.shape[1])
+            return [labels.astype(jnp.int32)]
+
+        return None, fn
+
+    def host_finalize(self, host_buf: TensorBuffer, config, options
+                      ) -> TensorBuffer:
+        labels = np.asarray(host_buf[0]).astype(int)
+        return self._emit(host_buf, labels)

@@ -35,6 +35,14 @@ class TensorQueryClient(Element):
         "servers": None,     # failover list "host1:port1,host2:port2"
         "timeout": P.DEFAULT_TIMEOUT,
         "max_retry": 3,
+        # >1 pipelines the offload: up to N requests ride the connection
+        # before the first result is awaited (responses return in order).
+        # Hides the network+invoke round trip behind the stream — essential
+        # when the server's accelerator has dispatch latency. 1 = the
+        # reference's synchronous per-frame round trip (with per-frame
+        # resend-on-reconnect); >1 drops in-flight frames on a connection
+        # error (streaming frame-drop semantics, tensor_filter.c:699-705).
+        "max_in_flight": 1,
         # broker discovery (reference query-hybrid): find servers by
         # operation name instead of static host/port
         "operation": None,
@@ -50,6 +58,8 @@ class TensorQueryClient(Element):
         self._client_id = None
         self._server_idx = 0
         self._lock = threading.Lock()
+        #: (pts, meta) of requests sent but not yet answered (in order)
+        self._pending: List[tuple] = []
 
     def _server_list(self) -> List[Tuple[str, int]]:
         operation = self.get_property("operation")
@@ -121,34 +131,101 @@ class TensorQueryClient(Element):
                 except OSError:
                     pass
                 self._sock = None
+            # in-flight requests die with the connection — a restart must
+            # not pair old (pts, meta) with new results
+            self._pending.clear()
         super().stop()
 
     def transform_caps(self, pad, caps):
         return None  # output caps come from the first result buffer
 
+    def _recv_result(self):
+        cmd, payload = P.recv_msg(self._sock)
+        if cmd is not P.Cmd.RESULT:
+            raise P.QueryProtocolError(f"expected RESULT, got {cmd}")
+        return P.unpack_buffer(payload)
+
+    def _push_result(self, result, pts, meta):
+        result = result.replace(pts=pts, meta=dict(meta))
+        if self.srcpad.caps is None:
+            self.srcpad.set_caps(
+                TensorsConfig.from_arrays(result.tensors).to_caps()
+            )
+        return self.srcpad.push(result)
+
     def chain(self, pad, buf):
+        window = max(1, int(self.get_property("max_in_flight")))
+        if window == 1:
+            # synchronous round trip with per-frame resend on reconnect
+            with self._lock:
+                for attempt in (1, 2):  # one transparent reconnect per frame
+                    if self._sock is None:
+                        self._connect()
+                    try:
+                        P.send_buffer(self._sock, buf)
+                        result = self._recv_result()
+                        break
+                    except (OSError, P.QueryProtocolError) as e:
+                        self.log.warning("query round-trip failed: %s", e)
+                        self._sock = None
+                        if attempt == 2:
+                            raise
+            return self._push_result(result, buf.pts, buf.meta)
+
+        # pipelined: keep up to `window` requests in flight; responses
+        # arrive in order on the same connection. A frame that cannot be
+        # SENT (server unreachable) errors like the sync path; frames
+        # already in flight when the connection dies are dropped (streaming
+        # frame-drop semantics).
+        done = []
         with self._lock:
             for attempt in (1, 2):  # one transparent reconnect per frame
                 if self._sock is None:
                     self._connect()
                 try:
                     P.send_buffer(self._sock, buf)
-                    cmd, payload = P.recv_msg(self._sock)
-                    if cmd is not P.Cmd.RESULT:
-                        raise P.QueryProtocolError(f"expected RESULT, got {cmd}")
-                    result = P.unpack_buffer(payload)
+                    self._pending.append((buf.pts, buf.meta))
                     break
                 except (OSError, P.QueryProtocolError) as e:
-                    self.log.warning("query round-trip failed: %s", e)
+                    self.log.warning("pipelined send failed: %s; dropped %d "
+                                     "in-flight frame(s)", e,
+                                     len(self._pending))
+                    self._pending.clear()
                     self._sock = None
                     if attempt == 2:
                         raise
-        result = result.replace(pts=buf.pts, meta=dict(buf.meta))
-        if self.srcpad.caps is None:
-            self.srcpad.set_caps(
-                TensorsConfig.from_arrays(result.tensors).to_caps()
-            )
-        return self.srcpad.push(result)
+            try:
+                while len(self._pending) >= window:
+                    result = self._recv_result()
+                    pts, meta = self._pending.pop(0)
+                    done.append((result, pts, meta))
+            except (OSError, P.QueryProtocolError) as e:
+                self.log.warning("pipelined receive failed (%s); dropped %d "
+                                 "in-flight frame(s)", e, len(self._pending))
+                self._pending.clear()
+                self._sock = None
+        ret = FlowReturn.OK
+        for result, pts, meta in done:
+            ret = self._push_result(result, pts, meta)
+        return ret
+
+    def handle_eos(self):
+        """Receive every outstanding pipelined result before EOS forwards."""
+        done = []
+        with self._lock:
+            while self._pending and self._sock is not None:
+                try:
+                    result = self._recv_result()
+                except (OSError, P.QueryProtocolError) as e:
+                    self.log.warning("drain failed (%s); dropping %d "
+                                     "frame(s)", e, len(self._pending))
+                    self._pending.clear()
+                    self._sock = None
+                    break
+                pts, meta = self._pending.pop(0)
+                done.append((result, pts, meta))
+        for result, pts, meta in done:
+            self._push_result(result, pts, meta)
 
 
 @subplugin(ELEMENT, "tensor_query_serversrc")

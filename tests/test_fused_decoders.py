@@ -203,3 +203,28 @@ def test_trace_failure_falls_back_to_member_chain(postproc_model):
         assert len(sink.buffers[0].meta["detections"]) == 2  # host path ran
     finally:
         pipe.stop()
+
+
+@pytest.fixture
+def seg_model():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(11)
+    logits = jnp.asarray(rng.normal(0, 1, (1, 12, 10, 6)), jnp.float32)
+
+    def fn(x):
+        return logits
+
+    register_jax_model("seg_toy", fn, None)
+    yield "seg_toy"
+    unregister_jax_model("seg_toy")
+
+
+def test_fused_segment_matches_host(seg_model):
+    frame = np.zeros((4,), np.uint8)
+    f = _run_pipe(seg_model, "image_segment", frame, fuse=True)
+    u = _run_pipe(seg_model, "image_segment", frame, fuse=False)
+    np.testing.assert_array_equal(f.meta["segment_labels"],
+                                  u.meta["segment_labels"])
+    np.testing.assert_array_equal(np.asarray(f[0]), np.asarray(u[0]))
+    assert np.asarray(f[0]).shape == (12, 10, 4)

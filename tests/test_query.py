@@ -171,3 +171,63 @@ class TestFlexibleFilterNegotiation:
         finally:
             server.stop()
             unregister_jax_model("flex_double")
+
+
+class TestPipelinedOffload:
+    def test_pipelined_matches_sync(self):
+        """max-in-flight>1 must deliver the same results in the same order
+        as the synchronous round trip."""
+        from nnstreamer_tpu.filters import register_custom_easy
+        from nnstreamer_tpu.tensors.types import TensorsInfo
+
+        info = TensorsInfo.from_str("3:8:8:1", "uint8")
+        register_custom_easy(
+            "triple_u8",
+            lambda ins: [(np.asarray(ins[0]) * 3).astype(np.uint8)],
+            info, info,
+        )
+        server = parse_launch(
+            "tensor_query_serversrc name=ssrc port=0 ! "
+            "tensor_filter framework=custom-easy model=triple_u8 ! "
+            "tensor_query_serversink")
+        server.start()
+        try:
+            port = server.get("ssrc").port
+            outs = {}
+            for label, extra in (("sync", ""), ("pipe", "max-in-flight=6")):
+                client = parse_launch(
+                    "videotestsrc num-buffers=10 width=8 height=8 "
+                    "pattern=gradient ! tensor_converter ! "
+                    f"tensor_query_client dest-host=127.0.0.1 "
+                    f"dest-port={port} {extra} ! tensor_sink name=out")
+                msg = client.run(timeout=60)
+                assert msg is not None and msg.kind == "eos", (label, msg)
+                outs[label] = [np.asarray(b[0])
+                               for b in client.get("out").buffers]
+            assert len(outs["sync"]) == len(outs["pipe"]) == 10
+            for a, b in zip(outs["sync"], outs["pipe"]):
+                np.testing.assert_array_equal(a, b)
+        finally:
+            server.stop()
+
+    def test_pipelined_dead_server_errors(self):
+        """An unreachable server must surface an error in pipelined mode
+        too, not silently drop the stream (code-review regression)."""
+        from nnstreamer_tpu.pipeline.element import FlowError
+        from nnstreamer_tpu.elements.sink import TensorSink
+        from nnstreamer_tpu.elements.source import AppSrc
+
+        client = parse_launch(
+            "tensor_query_client name=c servers=127.0.0.1:1 timeout=0.3 "
+            "max-retry=1 max-in-flight=4")
+        src, sink = AppSrc(name="src"), TensorSink(name="out")
+        client.add(src, sink)
+        src.link(client.get("c"))
+        client.get("c").link(sink)
+        client.start()
+        src.push([np.zeros(2, np.float32)], pts=0)
+        src.end_of_stream()
+        msg = client.wait(timeout=30)
+        client.stop()
+        assert msg is not None and msg.kind == "error"
+        assert not sink.buffers
