@@ -45,9 +45,11 @@ def build_pipeline(batch: int = 1):
     )
     register_jax_model("mobilenet_v2_bench", apply_fn, params,
                        in_info=in_info, out_info=out_info)
+    # queue after the converter decouples host frame synthesis from device
+    # dispatch (source thread fills frame N+1 while the fused region runs N)
     pipe = parse_launch(
         f"videotestsrc num-buffers={N_FRAMES} width={IMAGE} height={IMAGE} "
-        "pattern=gradient ! tensor_converter ! "
+        "pattern=gradient ! tensor_converter ! queue max-size-buffers=8 ! "
         "tensor_transform mode=arithmetic "
         "option=typecast:float32,add:-127.5,div:127.5 ! "
         "tensor_filter framework=jax model=mobilenet_v2_bench name=filter ! "
@@ -117,7 +119,7 @@ def measure_ssd() -> dict:
                        in_info=in_info, out_info=out_info)
     pipe = parse_launch(
         f"videotestsrc num-buffers={N_FRAMES} width=300 height=300 "
-        "pattern=gradient ! tensor_converter ! "
+        "pattern=gradient ! tensor_converter ! queue max-size-buffers=8 ! "
         "tensor_transform mode=arithmetic "
         "option=typecast:float32,add:-127.5,div:127.5 ! "
         "tensor_filter framework=jax model=ssd_bench name=filter ! "

@@ -194,36 +194,40 @@ class TensorQueryClient(Element):
                     self._sock = None
                     if attempt == 2:
                         raise
-            try:
-                while len(self._pending) >= window:
-                    result = self._recv_result()
-                    pts, meta = self._pending.pop(0)
-                    done.append((result, pts, meta))
-            except (OSError, P.QueryProtocolError) as e:
-                self.log.warning("pipelined receive failed (%s); dropped %d "
-                                 "in-flight frame(s)", e, len(self._pending))
-                self._pending.clear()
-                self._sock = None
+            done = self._drain_locked(min_pending=window)
         ret = FlowReturn.OK
         for result, pts, meta in done:
             ret = self._push_result(result, pts, meta)
         return ret
 
-    def handle_eos(self):
-        """Receive every outstanding pipelined result before EOS forwards."""
+    def _drain_locked(self, min_pending: int):
+        """Receive results until fewer than ``min_pending`` remain in
+        flight (caller holds the lock). A receive TIMEOUT from a healthy
+        connection escalates — a server that stopped answering must surface
+        as a pipeline error, not as silently vanishing frames; a broken
+        connection drops the in-flight frames (streaming semantics)."""
         done = []
-        with self._lock:
-            while self._pending and self._sock is not None:
-                try:
-                    result = self._recv_result()
-                except (OSError, P.QueryProtocolError) as e:
-                    self.log.warning("drain failed (%s); dropping %d "
-                                     "frame(s)", e, len(self._pending))
-                    self._pending.clear()
-                    self._sock = None
-                    break
+        try:
+            while len(self._pending) >= min_pending and \
+                    self._sock is not None:
+                result = self._recv_result()
                 pts, meta = self._pending.pop(0)
                 done.append((result, pts, meta))
+        except TimeoutError:
+            self._pending.clear()
+            self._sock = None
+            raise
+        except (OSError, P.QueryProtocolError) as e:
+            self.log.warning("pipelined receive failed (%s); dropped %d "
+                             "in-flight frame(s)", e, len(self._pending))
+            self._pending.clear()
+            self._sock = None
+        return done
+
+    def handle_eos(self):
+        """Receive every outstanding pipelined result before EOS forwards."""
+        with self._lock:
+            done = self._drain_locked(min_pending=1)
         for result, pts, meta in done:
             self._push_result(result, pts, meta)
 

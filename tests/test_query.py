@@ -178,6 +178,7 @@ class TestPipelinedOffload:
         """max-in-flight>1 must deliver the same results in the same order
         as the synchronous round trip."""
         from nnstreamer_tpu.filters import register_custom_easy
+        from nnstreamer_tpu.filters.custom import unregister_custom_easy
         from nnstreamer_tpu.tensors.types import TensorsInfo
 
         info = TensorsInfo.from_str("3:8:8:1", "uint8")
@@ -209,6 +210,7 @@ class TestPipelinedOffload:
                 np.testing.assert_array_equal(a, b)
         finally:
             server.stop()
+            unregister_custom_easy("triple_u8")
 
     def test_pipelined_dead_server_errors(self):
         """An unreachable server must surface an error in pipelined mode
