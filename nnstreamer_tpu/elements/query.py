@@ -194,42 +194,56 @@ class TensorQueryClient(Element):
                     self._sock = None
                     if attempt == 2:
                         raise
-            done = self._drain_locked(min_pending=window)
+            done, err = self._drain_locked(min_pending=window)
         ret = FlowReturn.OK
         for result, pts, meta in done:
             ret = self._push_result(result, pts, meta)
+        if err is not None:
+            raise err  # after pushing the good results collected so far
         return ret
 
     def _drain_locked(self, min_pending: int):
         """Receive results until fewer than ``min_pending`` remain in
-        flight (caller holds the lock). A receive TIMEOUT from a healthy
-        connection escalates — a server that stopped answering must surface
-        as a pipeline error, not as silently vanishing frames; a broken
-        connection drops the in-flight frames (streaming semantics)."""
+        flight (caller holds the lock). Returns ``(done, err)`` — results
+        successfully received before any failure are always returned so
+        the caller can push them. ``err`` is a TimeoutError when a healthy
+        connection stopped answering (must surface as a pipeline error,
+        not as silently vanishing frames); a broken connection just drops
+        the in-flight frames (streaming semantics)."""
         done = []
+        err = None
         try:
             while len(self._pending) >= min_pending and \
                     self._sock is not None:
                 result = self._recv_result()
                 pts, meta = self._pending.pop(0)
                 done.append((result, pts, meta))
-        except TimeoutError:
+        except TimeoutError as e:
             self._pending.clear()
             self._sock = None
-            raise
+            err = e
         except (OSError, P.QueryProtocolError) as e:
             self.log.warning("pipelined receive failed (%s); dropped %d "
                              "in-flight frame(s)", e, len(self._pending))
             self._pending.clear()
             self._sock = None
-        return done
+        return done, err
 
     def handle_eos(self):
-        """Receive every outstanding pipelined result before EOS forwards."""
+        """Receive every outstanding pipelined result before EOS forwards.
+
+        A drain timeout is POSTED to the bus rather than raised: the EOS
+        sentinel travels paths (e.g. queue worker threads) that do not
+        wrap handlers in try/except, so a raise here could kill a worker
+        silently instead of failing the pipeline."""
         with self._lock:
-            done = self._drain_locked(min_pending=1)
+            done, err = self._drain_locked(min_pending=1)
         for result, pts, meta in done:
             self._push_result(result, pts, meta)
+        if err is not None:
+            from nnstreamer_tpu.pipeline.element import FlowError
+
+            self.post_error(FlowError(f"{self.name}: {err}"))
 
 
 @subplugin(ELEMENT, "tensor_query_serversrc")

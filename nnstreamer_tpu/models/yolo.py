@@ -1,0 +1,81 @@
+"""YOLO-style single-head detector — pairs with the bounding_boxes
+decoder's ``option1=yolov5`` mode (reference tensordec-boundingbox.c
+yolov5 branch decodes [anchors, 5+classes] rows of cx,cy,w,h,objectness,
+class-logits).
+
+The reference consumes external yolov5 .tflite files; this is a native
+flax detector with the same output contract so the full pipeline
+(model → fused device NMS → overlay/meta) runs end-to-end on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from nnstreamer_tpu.models.mobilenet_v2 import InvertedResidual
+from nnstreamer_tpu.tensors.types import TensorsInfo
+
+
+class YoloDetector(nn.Module):
+    num_classes: int = 80
+    anchors_per_cell: int = 3
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (3, 3), strides=(2, 2), padding="SAME",
+                    use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu6(nn.BatchNorm(use_running_average=True,
+                                  dtype=self.dtype)(x))
+        for expand, out_ch, repeats, stride in [
+            (1, 16, 1, 1), (6, 32, 2, 2), (6, 64, 2, 2), (6, 128, 3, 2),
+        ]:
+            for i in range(repeats):
+                x = InvertedResidual(out_ch, stride if i == 0 else 1,
+                                     expand, self.dtype)(x)
+        # one stride-16 head: [N, cells, cells, k*(5+C)] → [N, A, 5+C]
+        k, c = self.anchors_per_cell, self.num_classes
+        head = nn.Conv(k * (5 + c), (1, 1), dtype=self.dtype)(x)
+        n = head.shape[0]
+        pred = head.reshape(n, -1, 5 + c).astype(jnp.float32)
+        # box center/size activations live in the decoder for the
+        # reference contract: rows are (cx, cy, w, h, obj, cls...) with
+        # obj/cls as logits; normalize cx,cy,w,h into [0,1] here
+        cells = x.shape[1]
+        grid = (jnp.arange(cells * cells) % cells).astype(jnp.float32)
+        gy = (jnp.arange(cells * cells) // cells).astype(jnp.float32)
+        gx = jnp.repeat(grid, k).reshape(1, -1)
+        gyr = jnp.repeat(gy, k).reshape(1, -1)
+        cx = (jax.nn.sigmoid(pred[:, :, 0]) + gx) / cells
+        cy = (jax.nn.sigmoid(pred[:, :, 1]) + gyr) / cells
+        w = jax.nn.sigmoid(pred[:, :, 2])
+        h = jax.nn.sigmoid(pred[:, :, 3])
+        return jnp.concatenate(
+            [jnp.stack([cx, cy, w, h], axis=2), pred[:, :, 4:]], axis=2)
+
+
+def yolo_detector(num_classes: int = 80, image_size: int = 320,
+                  batch: int = 1, dtype=jnp.float32, seed: int = 0
+                  ) -> Tuple[Callable, Any, TensorsInfo, TensorsInfo]:
+    """Factory: apply_fn(params, image[N,H,W,3]) → pred [N, A, 5+C] in the
+    bounding_boxes yolov5 decoder contract."""
+    model = YoloDetector(num_classes=num_classes, dtype=dtype)
+    rng = jax.random.PRNGKey(seed)
+    dummy = jnp.zeros((batch, image_size, image_size, 3), jnp.float32)
+    from nnstreamer_tpu.models._init import fast_init
+    variables = fast_init(model.init, rng, dummy, seed=seed)
+    pred = jax.eval_shape(lambda p, x: model.apply(p, x), variables, dummy)
+
+    def apply_fn(params, x):
+        return model.apply(params, x)
+
+    in_info = TensorsInfo.from_str(
+        f"3:{image_size}:{image_size}:{batch}", "float32")
+    out_info = TensorsInfo.from_str(
+        f"{pred.shape[2]}:{pred.shape[1]}:{batch}", "float32")
+    return apply_fn, variables, in_info, out_info

@@ -231,3 +231,43 @@ class TestPipelineParallel:
         params, loss0 = step(params, tokens)
         params, loss1 = step(params, tokens)
         assert np.isfinite(float(loss0)) and float(loss1) < float(loss0)
+
+
+def test_yolo_detector_pipeline():
+    """YOLO model output must flow through the yolov5 decoder mode (fused
+    device NMS) end-to-end."""
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu import parse_launch
+    from nnstreamer_tpu.filters.jax_backend import (
+        register_jax_model,
+        unregister_jax_model,
+    )
+    from nnstreamer_tpu.models.yolo import yolo_detector
+
+    size = 64
+    apply_fn, params, in_info, out_info = yolo_detector(
+        num_classes=4, image_size=size, batch=1)
+    assert out_info[0].shape[-1] == 9  # 5 + 4 classes
+
+    def net(p, x):
+        return apply_fn(p, (x.astype(jnp.float32) - 127.5) / 127.5)
+
+    register_jax_model("yolo_t", net, params)
+    try:
+        pipe = parse_launch(
+            f"videotestsrc num-buffers=2 width={size} height={size} "
+            "pattern=gradient ! tensor_converter ! "
+            "tensor_filter framework=jax model=yolo_t ! "
+            "tensor_decoder mode=bounding_boxes option1=yolov5 "
+            "option3=0.9 option7=meta ! tensor_sink name=out to-host=true")
+        msg = pipe.run(timeout=120)
+        assert msg is not None and msg.kind == "eos", msg
+        outs = pipe.get("out").buffers
+        assert len(outs) == 2
+        # untrained model: detections list exists (possibly empty), every
+        # entry carries normalized boxes
+        for d in outs[0].meta["detections"]:
+            assert 0 <= d["score"] <= 1
+    finally:
+        unregister_jax_model("yolo_t")

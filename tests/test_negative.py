@@ -141,6 +141,8 @@ class TestProtocolRobustness:
             assert len(client.get("out").buffers) == 2
         finally:
             server.stop()
+            from nnstreamer_tpu.filters.custom import unregister_custom_easy
+            unregister_custom_easy("passthrough_n")
 
     def test_sparse_decode_garbage(self):
         from nnstreamer_tpu.elements.sparse import sparse_decode
