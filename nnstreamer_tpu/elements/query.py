@@ -15,7 +15,12 @@ from __future__ import annotations
 import threading
 from typing import List, Optional, Tuple
 
-from nnstreamer_tpu.pipeline.element import CapsEvent, Element, FlowReturn
+from nnstreamer_tpu.pipeline.element import (
+    CapsEvent,
+    Element,
+    FlowError,
+    FlowReturn,
+)
 from nnstreamer_tpu.pipeline.pipeline import SourceElement
 from nnstreamer_tpu.query import protocol as P
 from nnstreamer_tpu.query.server import QueryServer
@@ -241,8 +246,6 @@ class TensorQueryClient(Element):
         for result, pts, meta in done:
             self._push_result(result, pts, meta)
         if err is not None:
-            from nnstreamer_tpu.pipeline.element import FlowError
-
             self.post_error(FlowError(f"{self.name}: {err}"))
 
 

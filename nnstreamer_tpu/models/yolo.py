@@ -38,7 +38,7 @@ class YoloDetector(nn.Module):
             for i in range(repeats):
                 x = InvertedResidual(out_ch, stride if i == 0 else 1,
                                      expand, self.dtype)(x)
-        # one stride-16 head: [N, cells, cells, k*(5+C)] → [N, A, 5+C]
+        # one stride-16 head: [N, ch, cw, k*(5+C)] → [N, A, 5+C]
         k, c = self.anchors_per_cell, self.num_classes
         head = nn.Conv(k * (5 + c), (1, 1), dtype=self.dtype)(x)
         n = head.shape[0]
@@ -46,13 +46,13 @@ class YoloDetector(nn.Module):
         # box center/size activations live in the decoder for the
         # reference contract: rows are (cx, cy, w, h, obj, cls...) with
         # obj/cls as logits; normalize cx,cy,w,h into [0,1] here
-        cells = x.shape[1]
-        grid = (jnp.arange(cells * cells) % cells).astype(jnp.float32)
-        gy = (jnp.arange(cells * cells) // cells).astype(jnp.float32)
+        ch, cw = x.shape[1], x.shape[2]
+        grid = (jnp.arange(ch * cw) % cw).astype(jnp.float32)
+        gy = (jnp.arange(ch * cw) // cw).astype(jnp.float32)
         gx = jnp.repeat(grid, k).reshape(1, -1)
         gyr = jnp.repeat(gy, k).reshape(1, -1)
-        cx = (jax.nn.sigmoid(pred[:, :, 0]) + gx) / cells
-        cy = (jax.nn.sigmoid(pred[:, :, 1]) + gyr) / cells
+        cx = (jax.nn.sigmoid(pred[:, :, 0]) + gx) / cw
+        cy = (jax.nn.sigmoid(pred[:, :, 1]) + gyr) / ch
         w = jax.nn.sigmoid(pred[:, :, 2])
         h = jax.nn.sigmoid(pred[:, :, 3])
         return jnp.concatenate(

@@ -233,3 +233,43 @@ class TestPipelinedOffload:
         client.stop()
         assert msg is not None and msg.kind == "error"
         assert not sink.buffers
+
+    def test_stalling_server_surfaces_error_through_queue(self):
+        """Server that handshakes then never answers: the receive timeout
+        must surface as a pipeline error even with a queue (thread
+        boundary) ahead of the pipelined client (code-review scenario)."""
+        import socket
+        import threading
+
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+
+        def server():
+            conn, _ = srv.accept()
+            P.recv_msg(conn)                      # REQUEST_INFO
+            P.send_msg(conn, P.Cmd.APPROVE, b"")
+            P.send_msg(conn, P.Cmd.CLIENT_ID, b"1")
+            while True:                           # read frames, never reply
+                try:
+                    if P.recv_msg(conn) == (None, None):
+                        break
+                except Exception:
+                    break
+
+        t = threading.Thread(target=server, daemon=True)
+        t.start()
+        try:
+            pipe = parse_launch(
+                "videotestsrc num-buffers=4 width=8 height=8 ! "
+                "tensor_converter ! queue max-size-buffers=2 ! "
+                f"tensor_query_client dest-host=127.0.0.1 dest-port={port} "
+                "timeout=1.5 max-in-flight=3 ! tensor_sink name=out")
+            pipe.start()
+            msg = pipe.wait(timeout=60)
+            pipe.stop()
+            assert msg is not None and msg.kind == "error", msg
+            assert "timed out" in str(msg.error)
+        finally:
+            srv.close()
