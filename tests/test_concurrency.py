@@ -22,13 +22,11 @@ from nnstreamer_tpu.filters.jax_backend import (
 def shared_linear():
     import jax.numpy as jnp
 
-    calls = []
-
     def fn(p, x):
         return x.astype(jnp.float32) * p
 
     register_jax_model("shared_lin", fn, jnp.float32(3.0))
-    yield "shared_lin", calls
+    yield "shared_lin"
     unregister_jax_model("shared_lin")
     shared_model_remove("k_shared_lin")
 
