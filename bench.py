@@ -26,6 +26,9 @@ import numpy as np
 
 N_FRAMES = int(os.environ.get("BENCH_FRAMES", "200"))
 WARMUP = int(os.environ.get("BENCH_WARMUP", "10"))
+#: tunnel throughput varies heavily run-to-run; the flagship reports the
+#: median of this many runs (first run also pays the compile)
+REPEATS = int(os.environ.get("BENCH_REPEATS", "3"))
 IMAGE = 224
 
 # Reference baseline: measured TFLite CPU (xnnpack) MobileNetV2 fp32 FPS on
@@ -37,14 +40,19 @@ def build_pipeline(batch: int = 1):
     import jax.numpy as jnp
 
     from nnstreamer_tpu import parse_launch
-    from nnstreamer_tpu.filters.jax_backend import register_jax_model
-    from nnstreamer_tpu.models.mobilenet_v2 import mobilenet_v2
-
-    apply_fn, params, in_info, out_info = mobilenet_v2(
-        image_size=IMAGE, batch=batch, dtype=jnp.bfloat16
+    from nnstreamer_tpu.filters.jax_backend import (
+        is_jax_model_registered,
+        register_jax_model,
     )
-    register_jax_model("mobilenet_v2_bench", apply_fn, params,
-                       in_info=in_info, out_info=out_info)
+
+    if not is_jax_model_registered("mobilenet_v2_bench"):
+        from nnstreamer_tpu.models.mobilenet_v2 import mobilenet_v2
+
+        apply_fn, params, in_info, out_info = mobilenet_v2(
+            image_size=IMAGE, batch=batch, dtype=jnp.bfloat16
+        )
+        register_jax_model("mobilenet_v2_bench", apply_fn, params,
+                           in_info=in_info, out_info=out_info)
     # queue after the converter decouples host frame synthesis from device
     # dispatch (source thread fills frame N+1 while the fused region runs N)
     pipe = parse_launch(
@@ -240,11 +248,48 @@ def measure_lstm() -> dict:
                 fps=_steady_fps(frame_t), frames=len(frame_t))
 
 
+def measure_attention() -> dict:
+    """Long-context path: Pallas flash attention vs the XLA reference at
+    seq 4096 (ops/flash_attention.py; layout [batch, seq, heads, dim])."""
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(0, 1, (1, 4096, 8, 128)), jnp.float32)
+    k, v = q + 0.1, q - 0.1
+    force = "pallas" if jax.default_backend() == "tpu" else None
+
+    @jax.jit
+    def step(q, k, v):
+        # scalar checksum keeps the full attention on the device but lets
+        # completion be proven by fetching 4 bytes — a remote-tunnel
+        # block_until_ready can ack before execution finishes, so a host
+        # fetch is the only trustworthy sync
+        return jnp.sum(flash_attention(q, k, v, causal=True, force=force))
+
+    np.asarray(step(q, k, v))
+    iters = 20
+    t0 = _t.perf_counter()
+    outs = [step(q, k, v) for _ in range(iters)]
+    for o in outs:
+        o.copy_to_host_async()
+    for o in outs:
+        np.asarray(o)
+    dt = (_t.perf_counter() - t0) / iters
+    return dict(metric="flash_attention_seq4096_iters_per_s",
+                fps=1.0 / dt, frames=iters)
+
+
 EXTRA_CONFIGS = {
     "ssd": measure_ssd,
     "pose4": measure_pose_mux,
     "query": measure_query,
     "lstm": measure_lstm,
+    "attn": measure_attention,
 }
 
 
@@ -332,7 +377,12 @@ def main():
                           "platform": _platform()}))
         return
 
-    stats = measure_pipeline()
+    runs = [measure_pipeline() for _ in range(max(1, REPEATS))]
+    runs.sort(key=lambda r: r["fps"])
+    # lower-middle run: the median for odd REPEATS, the conservative
+    # middle (never the best run) for even
+    stats = runs[(len(runs) - 1) // 2]
+    stats["fps_runs"] = [round(r["fps"], 2) for r in runs]
     baseline = measure_tflite_baseline() or FALLBACK_BASELINE_FPS
     result = {
         "metric": "mobilenetv2_224_pipeline_fps",
@@ -343,6 +393,7 @@ def main():
         "p90_interarrival_ms": round(stats["p90_ms"], 3),
         "invoke_latency_us": stats["invoke_latency_us"],
         "frames": stats["frames"],
+        "fps_runs": stats["fps_runs"],
         "baseline_fps": baseline,
         "platform": _platform(),
     }

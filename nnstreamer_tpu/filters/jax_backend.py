@@ -71,6 +71,11 @@ def unregister_jax_model(name: str) -> bool:
         return _registered.pop(name, None) is not None
 
 
+def is_jax_model_registered(name: str) -> bool:
+    with _reg_lock:
+        return name in _registered
+
+
 def _parse_accelerator(acc: Optional[str]) -> Optional[str]:
     """Reference accelerator grammar "true:tpu" / "false" / "true:cpu"
     (nnstreamer_plugin_api_filter.h:547-568) → jax platform or None."""

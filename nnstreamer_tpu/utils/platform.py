@@ -1,0 +1,21 @@
+"""Platform selection helper for scripts and examples.
+
+Hosts may preset ``JAX_PLATFORMS`` to a plugin this process cannot
+initialize (e.g. a TPU tunnel registered only for some interpreters).
+:func:`ensure_jax_platform` commits the preset backend if it works and
+falls back to CPU XLA otherwise — call it before any other jax work.
+"""
+
+from __future__ import annotations
+
+
+def ensure_jax_platform() -> str:
+    """Initialize the jax backend, falling back to CPU if the preset
+    platform is unusable. Returns the platform name in use."""
+    import jax
+
+    try:
+        return jax.devices()[0].platform
+    except RuntimeError:
+        jax.config.update("jax_platforms", "cpu")
+        return jax.devices()[0].platform
