@@ -45,13 +45,14 @@ def build_pipeline(batch: int = 1):
         register_jax_model,
     )
 
-    if not is_jax_model_registered("mobilenet_v2_bench"):
+    model_name = f"mobilenet_v2_bench_b{batch}"
+    if not is_jax_model_registered(model_name):
         from nnstreamer_tpu.models.mobilenet_v2 import mobilenet_v2
 
         apply_fn, params, in_info, out_info = mobilenet_v2(
             image_size=IMAGE, batch=batch, dtype=jnp.bfloat16
         )
-        register_jax_model("mobilenet_v2_bench", apply_fn, params,
+        register_jax_model(model_name, apply_fn, params,
                            in_info=in_info, out_info=out_info)
     # queue after the converter decouples host frame synthesis from device
     # dispatch (source thread fills frame N+1 while the fused region runs N)
@@ -60,7 +61,7 @@ def build_pipeline(batch: int = 1):
         "pattern=gradient ! tensor_converter ! queue max-size-buffers=8 ! "
         "tensor_transform mode=arithmetic "
         "option=typecast:float32,add:-127.5,div:127.5 ! "
-        "tensor_filter framework=jax model=mobilenet_v2_bench name=filter ! "
+        f"tensor_filter framework=jax model={model_name} name=filter ! "
         "tensor_decoder mode=image_labeling ! "
         "queue max-size-buffers=32 prefetch-host=true ! "
         "tensor_sink name=sink to-host=true"

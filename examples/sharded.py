@@ -37,4 +37,4 @@ for i in range(5):
 src.end_of_stream()
 msg = pipe.wait(timeout=120)
 pipe.stop()
-print("run:", msg.kind)
+print("run:", msg.kind if msg is not None else "timeout")

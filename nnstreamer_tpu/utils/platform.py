@@ -1,21 +1,37 @@
 """Platform selection helper for scripts and examples.
 
-Hosts may preset ``JAX_PLATFORMS`` to a plugin this process cannot
-initialize (e.g. a TPU tunnel registered only for some interpreters).
-:func:`ensure_jax_platform` commits the preset backend if it works and
-falls back to CPU XLA otherwise — call it before any other jax work.
+Hosts may preset ``JAX_PLATFORMS`` to a plugin this process cannot use —
+either one that raises at init, or a remote-tunnel backend that WEDGES
+during PJRT client creation (blocks forever instead of raising). So the
+preset platform is probed in a SUBPROCESS with a timeout, and only a
+healthy probe lets this process initialize it; anything else falls back
+to CPU XLA before the in-process backend is committed.
 """
 
 from __future__ import annotations
 
+import os
+import subprocess
+import sys
 
-def ensure_jax_platform() -> str:
-    """Initialize the jax backend, falling back to CPU if the preset
-    platform is unusable. Returns the platform name in use."""
+
+def ensure_jax_platform(probe_timeout: float | None = None) -> str:
+    """Commit a working jax backend (preset platform if healthy, else CPU)
+    and return the platform name in use. Call before any other jax work."""
+    if probe_timeout is None:
+        probe_timeout = float(os.environ.get("NNSTPU_PROBE_TIMEOUT", "120"))
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, timeout=probe_timeout, text=True,
+        )
+        healthy = proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        healthy = False
+
     import jax
 
-    try:
-        return jax.devices()[0].platform
-    except RuntimeError:
+    if not healthy:
         jax.config.update("jax_platforms", "cpu")
-        return jax.devices()[0].platform
+    return jax.devices()[0].platform

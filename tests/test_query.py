@@ -273,3 +273,53 @@ class TestPipelinedOffload:
             assert "timed out" in str(msg.error)
         finally:
             srv.close()
+
+
+class TestDistributedSharded:
+    def test_offload_into_sharded_filter(self):
+        """SURVEY §2.4 TPU mapping end-to-end: frames arrive over the query
+        protocol (the DCN ingress role) and the server's filter shards the
+        batch over the full device mesh (the ICI role) — XLA inserts the
+        collectives."""
+        import jax
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.filters.jax_backend import (
+            register_jax_model,
+            unregister_jax_model,
+        )
+
+        n_dev = len(jax.devices())
+        assert n_dev >= 2  # conftest forces an 8-device CPU mesh
+
+        register_jax_model(
+            "sharded_scale",
+            lambda p, x: x.astype(jnp.float32) * p, jnp.float32(2.0))
+        server = parse_launch(
+            "tensor_query_serversrc name=ssrc port=0 ! "
+            "tensor_filter framework=jax model=sharded_scale "
+            "custom=sharding:batch ! "
+            "tensor_query_serversink")
+        server.start()
+        try:
+            port = server.get("ssrc").port
+            client = parse_launch(
+                "appsrc name=src ! "
+                f"tensor_query_client dest-host=127.0.0.1 dest-port={port} "
+                "max-in-flight=4 ! tensor_sink name=out")
+            src, sink = client.get("src"), client.get("out")
+            client.start()
+            frames = [np.full((n_dev, 4), j, np.float32) for j in range(6)]
+            for f in frames:
+                src.push([f.copy()])
+            src.end_of_stream()
+            msg = client.wait(timeout=60)
+            assert msg is not None and msg.kind == "eos", msg
+            assert len(sink.buffers) == 6
+            for j, b in enumerate(sink.buffers):
+                np.testing.assert_allclose(
+                    np.asarray(b[0]), np.full((n_dev, 4), j * 2.0))
+        finally:
+            client.stop()
+            server.stop()
+            unregister_jax_model("sharded_scale")
