@@ -307,24 +307,15 @@ def measure_tflite_baseline() -> float | None:
 
 
 def _probe_accelerator(timeout_s: float = None) -> bool:
-    """Check that jax device init doesn't hang (a wedged TPU tunnel blocks
-    forever in PJRT client creation). Probe in a subprocess so the main
-    process stays clean; fall back to CPU when unavailable."""
-    import subprocess
+    """True when a non-CPU accelerator initializes healthily (a wedged TPU
+    tunnel blocks forever in PJRT client creation — the shared subprocess
+    probe guards against that)."""
+    from nnstreamer_tpu.utils.platform import probe_jax_platform
 
     if timeout_s is None:
-        # tunneled TPU backends can take minutes to initialize; real local
-        # chips answer in seconds
         timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT", "300"))
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, timeout=timeout_s, text=True,
-        )
-        return proc.returncode == 0 and "cpu" not in proc.stdout
-    except subprocess.TimeoutExpired:
-        return False
+    platform = probe_jax_platform(timeout_s)
+    return platform is not None and platform != "cpu"
 
 
 def _enable_compile_cache():

@@ -1,10 +1,19 @@
 """python3 converter — user-script media→tensor converters (reference
 ``tensor_converter/tensor_converter_python3.cc``, 404 LoC). The script
-(named by the converter mode string after the colon, or via conf) defines::
+defines::
 
     class Converter:
         def get_out_config(self, caps): ...   # optional
         def convert(self, buf, in_caps): ...
+
+Two ways to use it:
+
+- app registration: ``load_python_converter("myconv", "/path/s.py")``,
+  then ``tensor_converter mode=custom-code:myconv``;
+- conf-driven: set ``[converter] python3_script`` (or env
+  ``NNSTREAMER_TPU_CONVERTER_PYTHON3_SCRIPT``) and use
+  ``tensor_converter mode=custom-code:python3`` — the reference resolves
+  its python subplugin paths through nnstreamer.ini the same way.
 """
 
 from __future__ import annotations
@@ -13,21 +22,58 @@ import importlib.util
 import os
 import sys
 
-from nnstreamer_tpu.registry import CONVERTER, register_subplugin
+from nnstreamer_tpu.registry import CONVERTER, register_subplugin, subplugin
 from nnstreamer_tpu.tensors.buffer import TensorBuffer
 
 
-def load_python_converter(name: str, path: str) -> None:
-    """Load a converter script and register it under ``name`` (apps call
-    this; tensor_converter mode=custom-code:<name> then finds it)."""
+def _load_script(path: str, tag: str):
     if not os.path.isfile(path):
         raise FileNotFoundError(path)
     spec = importlib.util.spec_from_file_location(
-        f"nnstreamer_tpu_pyconv_{name}", path)
+        f"nnstreamer_tpu_pyconv_{tag}", path)
     mod = importlib.util.module_from_spec(spec)
     sys.modules[spec.name] = mod
     spec.loader.exec_module(mod)
     cls = getattr(mod, "Converter", None)
     if cls is None:
         raise ValueError(f"{path!r} must define class Converter")
-    register_subplugin(CONVERTER, name, cls())
+    return cls()
+
+
+def load_python_converter(name: str, path: str) -> None:
+    """Load a converter script and register it under ``name`` (apps call
+    this; tensor_converter mode=custom-code:<name> then finds it)."""
+    register_subplugin(CONVERTER, name, _load_script(path, name))
+
+
+@subplugin(CONVERTER, "python3")
+class Python3Converter:
+    """Conf-driven script converter: the script path comes from
+    ``[converter] python3_script`` (env override supported)."""
+
+    def __init__(self):
+        self._obj = None
+        self._path = None
+
+    def _load(self):
+        from nnstreamer_tpu.config import get_conf
+
+        path = get_conf().get("converter", "python3_script")
+        if not path:
+            raise ValueError(
+                "python3 converter: set [converter] python3_script in the "
+                "conf (or NNSTREAMER_TPU_CONVERTER_PYTHON3_SCRIPT), or "
+                "register a script with load_python_converter()")
+        if self._obj is None or path != self._path:
+            self._obj = _load_script(path, "conf")
+            self._path = path
+        return self._obj
+
+    def get_out_config(self, caps):
+        obj = self._load()
+        if hasattr(obj, "get_out_config"):
+            return obj.get_out_config(caps)
+        return None
+
+    def convert(self, buf: TensorBuffer, in_caps) -> TensorBuffer:
+        return self._load().convert(buf, in_caps)

@@ -1,37 +1,67 @@
-"""Platform selection helper for scripts and examples.
+"""Platform selection helpers for scripts, examples, and bench.
 
 Hosts may preset ``JAX_PLATFORMS`` to a plugin this process cannot use —
 either one that raises at init, or a remote-tunnel backend that WEDGES
-during PJRT client creation (blocks forever instead of raising). So the
-preset platform is probed in a SUBPROCESS with a timeout, and only a
-healthy probe lets this process initialize it; anything else falls back
-to CPU XLA before the in-process backend is committed.
+during PJRT client creation (blocks forever instead of raising). So a
+non-CPU preset is probed in a SUBPROCESS with a timeout before this
+process commits to it. The probe child runs in its own session and the
+whole process group is killed on timeout, so a wedged plugin (or a
+helper process it forked holding our pipe) cannot hang the probe itself.
 """
 
 from __future__ import annotations
 
 import os
+import signal
 import subprocess
 import sys
+from typing import Optional
+
+#: default probe budget — tunneled TPU backends can legitimately take
+#: minutes to create their PJRT client (same default as bench)
+DEFAULT_PROBE_TIMEOUT = 300.0
 
 
-def ensure_jax_platform(probe_timeout: float | None = None) -> str:
+def probe_jax_platform(timeout_s: Optional[float] = None) -> Optional[str]:
+    """Initialize jax in a subprocess; return its platform name, or None
+    if initialization failed or wedged past the timeout."""
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("NNSTPU_PROBE_TIMEOUT",
+                                         str(DEFAULT_PROBE_TIMEOUT)))
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "import jax; print(jax.devices()[0].platform)"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        start_new_session=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        proc.wait()
+        return None
+    if proc.returncode != 0:
+        return None
+    return out.strip().splitlines()[-1] if out.strip() else None
+
+
+def ensure_jax_platform(probe_timeout: Optional[float] = None) -> str:
     """Commit a working jax backend (preset platform if healthy, else CPU)
     and return the platform name in use. Call before any other jax work."""
-    if probe_timeout is None:
-        probe_timeout = float(os.environ.get("NNSTPU_PROBE_TIMEOUT", "120"))
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, timeout=probe_timeout, text=True,
-        )
-        healthy = proc.returncode == 0
-    except subprocess.TimeoutExpired:
-        healthy = False
+    preset = os.environ.get("JAX_PLATFORMS", "").strip().lower()
+    if preset == "cpu":
+        # nothing exotic to probe; in-process init cannot wedge on CPU
+        import jax
+
+        return jax.devices()[0].platform
+
+    healthy = probe_jax_platform(probe_timeout)
 
     import jax
 
-    if not healthy:
+    if healthy is None:
         jax.config.update("jax_platforms", "cpu")
     return jax.devices()[0].platform

@@ -74,3 +74,30 @@ def test_flatbuf_rate_field():
     out = dec(blob)
     assert out.num_tensors == 3
     np.testing.assert_array_equal(out.tensors[0], _buf().tensors[0])
+
+
+def test_python3_converter_conf_driven(tmp_path, monkeypatch):
+    """mode=custom-code:python3 resolves its script from the config system
+    (reference conf-driven python subplugin paths)."""
+    import numpy as np
+
+    from nnstreamer_tpu import parse_launch
+    from nnstreamer_tpu.config import get_conf
+
+    script = tmp_path / "conv.py"
+    script.write_text(
+        "import numpy as np\n"
+        "class Converter:\n"
+        "    def convert(self, buf, in_caps):\n"
+        "        return buf.with_tensors("
+        "[np.asarray(t).astype(np.float32) * 2 for t in buf.tensors])\n")
+    monkeypatch.setenv("NNSTREAMER_TPU_CONVERTER_PYTHON3_SCRIPT",
+                       str(script))
+    get_conf(refresh=True)
+    pipe = parse_launch(
+        "videotestsrc num-buffers=2 width=4 height=4 ! "
+        "tensor_converter mode=custom-code:python3 ! tensor_sink name=out")
+    msg = pipe.run(timeout=30)
+    assert msg is not None and msg.kind == "eos", msg
+    out = np.asarray(pipe.get("out").buffers[0][0])
+    assert out.dtype == np.float32 and out.max() > 0

@@ -301,6 +301,7 @@ class TestDistributedSharded:
             "custom=sharding:batch ! "
             "tensor_query_serversink")
         server.start()
+        client = None
         try:
             port = server.get("ssrc").port
             client = parse_launch(
@@ -320,6 +321,7 @@ class TestDistributedSharded:
                 np.testing.assert_allclose(
                     np.asarray(b[0]), np.full((n_dev, 4), j * 2.0))
         finally:
-            client.stop()
+            if client is not None:
+                client.stop()
             server.stop()
             unregister_jax_model("sharded_scale")
