@@ -21,6 +21,7 @@ from __future__ import annotations
 import importlib.util
 import os
 import sys
+import threading
 
 from nnstreamer_tpu.registry import CONVERTER, register_subplugin, subplugin
 from nnstreamer_tpu.tensors.buffer import TensorBuffer
@@ -53,7 +54,8 @@ class Python3Converter:
 
     def __init__(self):
         self._obj = None
-        self._path = None
+        self._key = None  # (path, mtime) — in-place edits reload
+        self._lock = threading.Lock()
 
     def _load(self):
         from nnstreamer_tpu.config import get_conf
@@ -64,10 +66,21 @@ class Python3Converter:
                 "python3 converter: set [converter] python3_script in the "
                 "conf (or NNSTREAMER_TPU_CONVERTER_PYTHON3_SCRIPT), or "
                 "register a script with load_python_converter()")
-        if self._obj is None or path != self._path:
-            self._obj = _load_script(path, "conf")
-            self._path = path
-        return self._obj
+        try:
+            mtime = os.stat(path).st_mtime_ns
+        except OSError:
+            # script vanished/unreadable after a successful load: keep
+            # serving the loaded object (pre-reload-support behavior)
+            with self._lock:
+                if self._obj is not None and path == self._key[0]:
+                    return self._obj
+            raise FileNotFoundError(path)
+        key = (path, mtime)
+        with self._lock:
+            if self._obj is None or key != self._key:
+                self._obj = _load_script(path, "conf")
+                self._key = key
+            return self._obj
 
     def get_out_config(self, caps):
         obj = self._load()

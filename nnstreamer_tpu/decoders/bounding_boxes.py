@@ -108,13 +108,19 @@ def draw_boxes(width: int, height: int, detections: List[dict]
 DEVICE_K_PER_CLASS = 32
 DEVICE_K_TOTAL = 100
 
+#: padding sentinel in device-path score slots. Distinct from a legitimate
+#: score of exactly 0 (possible in -postprocess mode with option3=0);
+#: sigmoid-derived scores are always > 0 so any value < 0 is safe.
+PAD_SCORE = -1.0
+
 
 def _jax_nms(boxes, scores, iou_thresh, k):
     """Greedy NMS with static output size: (indices [k], scores [k]).
 
     Same selection rule as :func:`nms` (suppress iou > thresh); entries
-    whose score is 0 are padding. Runs as a ``fori_loop`` so the whole
-    decode stays one XLA program."""
+    whose score is :data:`PAD_SCORE` are padding. ``scores`` must already
+    have invalid rows set to PAD_SCORE. Runs as a ``fori_loop`` so the
+    whole decode stays one XLA program."""
     import jax.numpy as jnp
     from jax import lax
 
@@ -123,7 +129,8 @@ def _jax_nms(boxes, scores, iou_thresh, k):
         j = jnp.argmax(left)
         s = left[j]
         keep_i = keep_i.at[i].set(j.astype(jnp.int32))
-        keep_s = keep_s.at[i].set(s)
+        # pool exhausted → argmax lands on a PAD_SCORE entry: keep padding
+        keep_s = keep_s.at[i].set(jnp.where(s > PAD_SCORE / 2, s, PAD_SCORE))
         b = boxes[j]
         yy1 = jnp.maximum(b[0], boxes[:, 0])
         xx1 = jnp.maximum(b[1], boxes[:, 1])
@@ -133,17 +140,20 @@ def _jax_nms(boxes, scores, iou_thresh, k):
         area_b = (b[2] - b[0]) * (b[3] - b[1])
         areas = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
         iou = inter / jnp.maximum(area_b + areas - inter, 1e-9)
-        left = jnp.where(iou > iou_thresh, 0.0, left).at[j].set(0.0)
+        left = jnp.where(iou > iou_thresh, PAD_SCORE, left).at[j].set(
+            PAD_SCORE)
         return left, keep_i, keep_s
 
-    init = (scores, jnp.zeros((k,), jnp.int32), jnp.zeros((k,), jnp.float32))
+    init = (scores, jnp.zeros((k,), jnp.int32),
+            jnp.full((k,), PAD_SCORE, jnp.float32))
     _, keep_i, keep_s = lax.fori_loop(0, k, body, init)
     return keep_i, keep_s
 
 
 def _rows_topk(boxes, cls_ids, scores, k_total):
     """Select the k_total highest-scoring (box, class, score) rows and pack
-    them as [k_total, 6] = (y1,x1,y2,x2,class,score); score==0 is padding."""
+    them as [k_total, 6] = (y1,x1,y2,x2,class,score); score==PAD_SCORE is
+    padding."""
     import jax.numpy as jnp
     from jax import lax
 
@@ -159,6 +169,7 @@ class BoundingBoxes:
     def __init__(self):
         self._labels = None
         self._anchors = None
+        self._warned_saturated = False
 
     def _opts(self, options: Dict[str, str]) -> dict:
         size = (options.get("option4") or "300:300").split(":")
@@ -308,7 +319,7 @@ class BoundingBoxes:
                 probs = jax.nn.sigmoid(scores)
 
                 def per_class(cls_probs):
-                    s = jnp.where(cls_probs >= thresh, cls_probs, 0.0)
+                    s = jnp.where(cls_probs >= thresh, cls_probs, PAD_SCORE)
                     return _jax_nms(boxes, s, iou_t, DEVICE_K_PER_CLASS)
 
                 # class 0 = background (host decode_ssd skips it too)
@@ -332,7 +343,7 @@ class BoundingBoxes:
                 cls_p = jax.nn.sigmoid(pred[:, 5:]) * obj[:, None]
                 best = jnp.argmax(cls_p, axis=1)
                 score = jnp.max(cls_p, axis=1)
-                score = jnp.where(score >= thresh, score, 0.0)
+                score = jnp.where(score >= thresh, score, PAD_SCORE)
                 cx, cy, w, h = (pred[:, i] for i in range(4))
                 boxes = jnp.stack([cy - h / 2, cx - w / 2,
                                    cy + h / 2, cx + w / 2], axis=1)
@@ -351,7 +362,7 @@ class BoundingBoxes:
                     classes = tensors[2].reshape(-1).astype(jnp.float32)
                 else:
                     classes = jnp.ones_like(scores)
-                masked = jnp.where(scores >= thresh, scores, 0.0)
+                masked = jnp.where(scores >= thresh, scores, PAD_SCORE)
                 k = min(DEVICE_K_TOTAL, masked.shape[0])
                 _, top_i = jax.lax.top_k(masked, k)
                 # host path emits in anchor order — restore it
@@ -370,5 +381,14 @@ class BoundingBoxes:
         rows = np.asarray(host_buf[0], np.float32).reshape(-1, 6)
         dets = [{"class": int(r[4]), "score": float(r[5]),
                  "box": [float(r[0]), float(r[1]), float(r[2]), float(r[3])]}
-                for r in rows if r[5] > 0.0]
+                for r in rows if r[5] > PAD_SCORE / 2]
+        if len(dets) >= DEVICE_K_TOTAL and not self._warned_saturated:
+            self._warned_saturated = True
+            from nnstreamer_tpu.log import get_logger
+
+            get_logger("decoders.bounding_boxes").warning(
+                "device top-k saturated (all %d rows valid): dense scenes "
+                "may be truncated vs the unbounded host path — raise "
+                "DEVICE_K_TOTAL or disable fusion for exact results",
+                DEVICE_K_TOTAL)
         return self._emit(host_buf, dets, o)

@@ -53,6 +53,11 @@ class TensorQueryClient(Element):
         "operation": None,
         "broker_host": "127.0.0.1",
         "broker_port": 1883,
+        # read-only counter: frames lost to connection failures while in
+        # flight (max_in_flight>1). Lets callers detect lossy runs without
+        # log scraping — a flaky link can otherwise drop a large fraction
+        # of the stream while still ending in a clean EOS.
+        "frames_dropped": 0,
     }
 
     def __init__(self, name=None, **props):
@@ -65,6 +70,21 @@ class TensorQueryClient(Element):
         self._lock = threading.Lock()
         #: (pts, meta) of requests sent but not yet answered (in order)
         self._pending: List[tuple] = []
+
+    def set_property(self, key: str, value) -> None:
+        if key.replace("-", "_") == "frames_dropped":
+            raise ValueError("tensor_query_client: frames-dropped is "
+                             "read-only")
+        super().set_property(key, value)
+
+    def _drop_pending_locked(self) -> int:
+        """Clear in-flight requests, bumping the frames-dropped counter."""
+        n = len(self._pending)
+        if n:
+            self._pending.clear()
+            self._props["frames_dropped"] = \
+                int(self._props.get("frames_dropped", 0)) + n
+        return n
 
     def _server_list(self) -> List[Tuple[str, int]]:
         operation = self.get_property("operation")
@@ -138,7 +158,7 @@ class TensorQueryClient(Element):
                 self._sock = None
             # in-flight requests die with the connection — a restart must
             # not pair old (pts, meta) with new results
-            self._pending.clear()
+            self._drop_pending_locked()
         super().stop()
 
     def transform_caps(self, pad, caps):
@@ -192,10 +212,9 @@ class TensorQueryClient(Element):
                     self._pending.append((buf.pts, buf.meta))
                     break
                 except (OSError, P.QueryProtocolError) as e:
+                    n = self._drop_pending_locked()
                     self.log.warning("pipelined send failed: %s; dropped %d "
-                                     "in-flight frame(s)", e,
-                                     len(self._pending))
-                    self._pending.clear()
+                                     "in-flight frame(s)", e, n)
                     self._sock = None
                     if attempt == 2:
                         raise
@@ -224,13 +243,13 @@ class TensorQueryClient(Element):
                 pts, meta = self._pending.pop(0)
                 done.append((result, pts, meta))
         except TimeoutError as e:
-            self._pending.clear()
+            self._drop_pending_locked()
             self._sock = None
             err = e
         except (OSError, P.QueryProtocolError) as e:
+            n = self._drop_pending_locked()
             self.log.warning("pipelined receive failed (%s); dropped %d "
-                             "in-flight frame(s)", e, len(self._pending))
-            self._pending.clear()
+                             "in-flight frame(s)", e, n)
             self._sock = None
         return done, err
 

@@ -122,6 +122,7 @@ class FusedRegion(Element):
         #: finds identical keys, so consts-only changes never recompile
         self._trace_cache: Optional[Tuple[list, Callable]] = None
         self._dead = False  # set when un-spliced back out of the graph
+        self._verified = False  # first frame after a (re)compile is synced
 
     # -- stage (re)build -----------------------------------------------------
     def _build(self) -> Tuple[list, Callable]:
@@ -156,6 +157,7 @@ class FusedRegion(Element):
             self._trace_cache = (keys, jitted)
         compiled = ([st.consts for st in stages], jitted, stages[-1].finalize)
         self._compiled = compiled
+        self._verified = False  # first frame after (re)compile syncs
         return compiled
 
     def invalidate(self) -> None:
@@ -204,10 +206,22 @@ class FusedRegion(Element):
         consts, jitted, finalize = compiled
         try:
             out = jitted(consts, list(buf.tensors))
+            if not self._verified:
+                import jax
+                # JAX dispatch is asynchronous: a data-dependent RUNTIME
+                # failure would otherwise surface later at materialization
+                # (sink to_host) as a pipeline error instead of here. Sync
+                # the first frame after every (re)compile so both trace-time
+                # and first-frame runtime failures take the fallback path;
+                # steady-state frames stay fully async.
+                jax.block_until_ready(out)
+                self._verified = True
         except Exception as e:  # noqa: BLE001 — fusion is an optimization,
-            # never a failure: a stage that won't trace/execute (shape
-            # mismatch only visible at trace time, etc.) falls back to the
-            # member chain, whose own error handling is authoritative
+            # never a failure: a stage that won't trace or whose first
+            # post-compile execution fails falls back to the member chain,
+            # whose own error handling is authoritative. (Runtime failures
+            # on later frames surface at materialization like any other
+            # pipeline error.)
             log.warning("%s: fused program failed (%s); falling back to "
                         "member chain", self.name, e)
             return self._fallback(buf)

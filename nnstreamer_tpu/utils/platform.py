@@ -11,15 +11,60 @@ helper process it forked holding our pipe) cannot hang the probe itself.
 
 from __future__ import annotations
 
+import json
 import os
 import signal
 import subprocess
 import sys
+import tempfile
+import time
 from typing import Optional
 
 #: default probe budget — tunneled TPU backends can legitimately take
 #: minutes to create their PJRT client (same default as bench)
 DEFAULT_PROBE_TIMEOUT = 300.0
+
+#: how long a cached probe verdict stays valid (seconds); override with
+#: NNSTPU_PROBE_CACHE_TTL, disable caching with NNSTPU_PROBE_NOCACHE=1
+DEFAULT_PROBE_CACHE_TTL = 600.0
+
+
+def _probe_cache_path(preset: str) -> str:
+    tag = "".join(c if c.isalnum() else "_" for c in preset) or "default"
+    return os.path.join(tempfile.gettempdir(),
+                        f"nnstpu_probe_{os.getuid()}_{tag}.json")
+
+
+def _probe_cache_get(preset: str) -> Optional[dict]:
+    if os.environ.get("NNSTPU_PROBE_NOCACHE"):
+        return None
+    try:
+        ttl = float(os.environ.get("NNSTPU_PROBE_CACHE_TTL",
+                                   str(DEFAULT_PROBE_CACHE_TTL)))
+    except ValueError:
+        ttl = DEFAULT_PROBE_CACHE_TTL
+    path = _probe_cache_path(preset)
+    try:
+        if time.time() - os.stat(path).st_mtime > ttl:
+            return None
+        with open(path) as f:
+            entry = json.load(f)
+        return entry if isinstance(entry, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def _probe_cache_put(preset: str, platform: Optional[str]) -> None:
+    if os.environ.get("NNSTPU_PROBE_NOCACHE"):
+        return
+    path = _probe_cache_path(preset)
+    try:
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump({"platform": platform}, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass
 
 
 def probe_jax_platform(timeout_s: Optional[float] = None) -> Optional[str]:
@@ -50,15 +95,29 @@ def probe_jax_platform(timeout_s: Optional[float] = None) -> Optional[str]:
 
 def ensure_jax_platform(probe_timeout: Optional[float] = None) -> str:
     """Commit a working jax backend (preset platform if healthy, else CPU)
-    and return the platform name in use. Call before any other jax work."""
+    and return the platform name in use. Call before any other jax work.
+
+    Only explicit non-CPU ``JAX_PLATFORMS`` presets are probed (those are
+    the ones that can wedge); an unset or ``cpu`` preset initializes
+    in-process directly. Probe verdicts are cached in a temp file keyed by
+    the preset (TTL ``NNSTPU_PROBE_CACHE_TTL``, default 600 s) so repeated
+    example/bench invocations don't re-pay the subprocess jax import or a
+    tunneled backend's PJRT init.
+    """
     preset = os.environ.get("JAX_PLATFORMS", "").strip().lower()
-    if preset == "cpu":
-        # nothing exotic to probe; in-process init cannot wedge on CPU
+    if preset in ("", "cpu"):
+        # nothing exotic to probe: CPU init cannot wedge, and with no
+        # preset jax's own backend-selection fallback applies
         import jax
 
         return jax.devices()[0].platform
 
-    healthy = probe_jax_platform(probe_timeout)
+    cached = _probe_cache_get(preset)
+    if cached is not None:
+        healthy = cached.get("platform")
+    else:
+        healthy = probe_jax_platform(probe_timeout)
+        _probe_cache_put(preset, healthy)
 
     import jax
 

@@ -156,3 +156,50 @@ class TestConfig:
 
         assert "jax" in Conf().framework_priority("model.msgpack")
         assert "torch" in Conf().framework_priority("model.pt")
+
+
+class TestPlatformProbe:
+    """ensure_jax_platform skips the subprocess probe for unset/cpu presets
+    and caches non-CPU probe verdicts (ADVICE r1)."""
+
+    def test_cpu_preset_never_probes(self, monkeypatch):
+        from nnstreamer_tpu.utils import platform as plat
+
+        def boom(*a, **k):
+            raise AssertionError("probe ran for a cpu preset")
+
+        monkeypatch.setattr(plat, "probe_jax_platform", boom)
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        assert plat.ensure_jax_platform() == "cpu"
+        monkeypatch.setenv("JAX_PLATFORMS", "")
+        assert plat.ensure_jax_platform() == "cpu"
+
+    def test_probe_cache_roundtrip(self, monkeypatch, tmp_path):
+        from nnstreamer_tpu.utils import platform as plat
+
+        monkeypatch.setenv("TMPDIR", str(tmp_path))
+        monkeypatch.delenv("NNSTPU_PROBE_NOCACHE", raising=False)
+        import tempfile
+        monkeypatch.setattr(tempfile, "gettempdir", lambda: str(tmp_path))
+        plat._probe_cache_put("faketpu", "tpu")
+        assert plat._probe_cache_get("faketpu") == {"platform": "tpu"}
+        # failed probes are cached too (repeated startups skip the wait)
+        plat._probe_cache_put("deadtpu", None)
+        assert plat._probe_cache_get("deadtpu") == {"platform": None}
+        # TTL expiry invalidates
+        monkeypatch.setenv("NNSTPU_PROBE_CACHE_TTL", "0")
+        assert plat._probe_cache_get("faketpu") is None
+
+    def test_cached_verdict_skips_probe(self, monkeypatch, tmp_path):
+        from nnstreamer_tpu.utils import platform as plat
+
+        import tempfile
+        monkeypatch.setattr(tempfile, "gettempdir", lambda: str(tmp_path))
+        calls = []
+        monkeypatch.setattr(plat, "probe_jax_platform",
+                            lambda *a, **k: calls.append(1) or None)
+        monkeypatch.setenv("JAX_PLATFORMS", "bogus_backend")
+        # jax is already initialized on cpu in tests; a failed probe keeps it
+        assert plat.ensure_jax_platform() == "cpu"
+        assert plat.ensure_jax_platform() == "cpu"
+        assert len(calls) == 1  # second call served from the cache

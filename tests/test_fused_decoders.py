@@ -104,6 +104,36 @@ def test_fused_postprocess_matches_host(postproc_model):
 
 
 @pytest.fixture
+def zero_score_model():
+    import jax.numpy as jnp
+
+    boxes = jnp.asarray([[0.1, 0.1, 0.4, 0.4],
+                         [0.5, 0.5, 0.9, 0.9]], jnp.float32)
+    scores = jnp.asarray([0.0, 0.6], jnp.float32)  # legit 0-score row
+    classes = jnp.asarray([1, 2], jnp.float32)
+
+    def fn(x):
+        return boxes, scores, classes
+
+    register_jax_model("zeroscore_toy", fn, None)
+    yield "zeroscore_toy"
+    unregister_jax_model("zeroscore_toy")
+
+
+def test_fused_postprocess_keeps_zero_score_at_thresh_zero(zero_score_model):
+    """option3=0: a row whose score is exactly 0 passes the host filter
+    (score >= thresh) and must not be conflated with device-path padding
+    (PAD_SCORE sentinel, not score==0)."""
+    frame = np.zeros((4,), np.uint8)
+    opts = "bounding_boxes option1=mobilenet-ssd-postprocess option3=0 option7=meta"
+    f = _run_pipe(zero_score_model, opts, frame, fuse=True)
+    u = _run_pipe(zero_score_model, opts, frame, fuse=False)
+    assert [_det_key(d) for d in f.meta["detections"]] == \
+        [_det_key(d) for d in u.meta["detections"]]
+    assert len(f.meta["detections"]) == 2  # 0-score row kept on both paths
+
+
+@pytest.fixture
 def yolo_model():
     import jax.numpy as jnp
 

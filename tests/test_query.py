@@ -234,6 +234,48 @@ class TestPipelinedOffload:
         assert msg is not None and msg.kind == "error"
         assert not sink.buffers
 
+    def test_disconnect_drops_are_counted(self):
+        """A mid-stream disconnect with max-in-flight>1 drops the in-flight
+        window (streaming semantics) and the run can still end in a clean
+        EOS — the client's frames-dropped counter must record the loss so
+        callers don't need to scrape logs (ADVICE r1)."""
+        import socket
+        import threading
+
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+
+        def server():
+            conn, _ = srv.accept()
+            P.recv_msg(conn)                      # REQUEST_INFO
+            P.send_msg(conn, P.Cmd.APPROVE, b"")
+            P.send_msg(conn, P.Cmd.CLIENT_ID, b"1")
+            for _ in range(3):                    # absorb the frames...
+                P.recv_msg(conn)
+            conn.close()                          # ...then die unanswered
+
+        t = threading.Thread(target=server, daemon=True)
+        t.start()
+        try:
+            pipe = parse_launch(
+                "videotestsrc num-buffers=3 width=8 height=8 ! "
+                "tensor_converter ! "
+                f"tensor_query_client name=c dest-host=127.0.0.1 "
+                f"dest-port={port} timeout=5 max-in-flight=4 ! "
+                "tensor_sink name=out")
+            pipe.start()
+            msg = pipe.wait(timeout=60)
+            client = pipe.get("c")
+            dropped = int(client.get_property("frames_dropped"))
+            pipe.stop()
+            assert msg is not None and msg.kind == "eos", msg
+            assert not pipe.get("out").buffers
+            assert dropped == 3
+        finally:
+            srv.close()
+
     def test_stalling_server_surfaces_error_through_queue(self):
         """Server that handshakes then never answers: the receive timeout
         must surface as a pipeline error even with a queue (thread
