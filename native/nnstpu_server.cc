@@ -1,0 +1,478 @@
+// nnstpu_server — GIL-free query-server transport core.
+//
+// Native equivalent of the reference's server halves of
+// gst/nnstreamer/tensor_query/tensor_query_common.c + tensor_query_server.c:
+// listen, accept, per-client framed TCP reassembly, handshake
+// (REQUEST_INFO → APPROVE + CLIENT_ID), PING, and result routing by client
+// id. One epoll thread owns all sockets — no per-client Python threads, no
+// GIL churn per frame; Python pops complete TRANSFER payloads and pushes
+// RESULT frames through ctypes (nnstreamer_tpu/query/server.py).
+//
+// Concurrency contract:
+// - the epoll thread is the ONLY thread that creates/destroys connections;
+//   foreign threads request closes via the to_close list + wake eventfd
+// - per-connection write mutex serializes epoll-thread replies (handshake,
+//   ping) against Python-thread result sends, so frames never interleave
+// - nnstpu_server_take is the single wait+copy+pop primitive (atomic under
+//   the server mutex — no wait/pop pairing races)
+// - nnstpu_server_stop drains blocked takers (waiters counter) before the
+//   Server is freed
+//
+// Framing (little-endian, shared with nnstpu.cc / query/protocol.py):
+//   u32 magic 'NTQ1'  u32 command  u64 payload_len  payload…
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <poll.h>
+#include <unistd.h>
+#include <fcntl.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <utility>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x4E545131;  // 'NTQ1'
+enum Cmd : uint32_t {
+  kRequestInfo = 1,
+  kApprove = 2,
+  kTransfer = 4,
+  kResult = 5,
+  kClientId = 6,
+  kPing = 7,
+  kBye = 8,
+};
+
+struct Frame {
+  uint32_t client_id;
+  std::vector<uint8_t> payload;
+};
+
+struct Conn {
+  int fd = -1;
+  uint32_t id = 0;
+  std::vector<uint8_t> inbuf;
+  // serializes writers to this socket: epoll-thread replies vs Python-
+  // thread result sends (shared_ptr: senders may outlive the Conn)
+  std::shared_ptr<std::mutex> wmu = std::make_shared<std::mutex>();
+};
+
+int set_nonblock(int fd) {
+  int fl = fcntl(fd, F_GETFL, 0);
+  return fl < 0 ? -1 : fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+}
+
+// blocking send of a whole frame on a possibly-nonblocking fd; caller must
+// hold the connection's write mutex
+int send_frame_all(int fd, uint32_t cmd, const uint8_t* payload,
+                   uint64_t len) {
+  uint8_t hdr[16];
+  memcpy(hdr, &kMagic, 4);
+  memcpy(hdr + 4, &cmd, 4);
+  memcpy(hdr + 8, &len, 8);
+  const uint8_t* bufs[2] = {hdr, payload};
+  size_t lens[2] = {sizeof(hdr), (size_t)len};
+  for (int part = 0; part < 2; part++) {
+    size_t off = 0;
+    while (off < lens[part]) {
+      ssize_t n = send(fd, bufs[part] + off, lens[part] - off, MSG_NOSIGNAL);
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          struct pollfd p = {fd, POLLOUT, 0};
+          if (poll(&p, 1, 10000) <= 0) return -1;  // 10 s write stall cap
+          continue;
+        }
+        return -1;
+      }
+      off += (size_t)n;
+    }
+  }
+  return 0;
+}
+
+struct Server {
+  int listen_fd = -1;
+  int epoll_fd = -1;
+  int wake_fd = -1;  // eventfd: stop / queue-drain re-arm / deferred close
+  uint16_t port = 0;
+  std::string caps;
+  size_t max_queue = 64;
+
+  std::thread loop;
+  std::atomic<bool> stopping{false};
+
+  std::mutex mu;  // guards all fields below
+  std::condition_variable cv;
+  std::unordered_map<int, Conn> conns;  // by fd; epoll thread only mutates
+  std::unordered_map<uint32_t, std::pair<int, std::shared_ptr<std::mutex>>>
+      by_id;  // id → (fd, write mutex)
+  std::deque<Frame> queue;
+  // foreign-thread close requests, by CLIENT ID — fds can be closed and
+  // reused by a new accept before the epoll thread processes the request;
+  // ids are monotonic and never reused
+  std::vector<uint32_t> to_close;
+  uint32_t next_id = 1;
+  bool paused = false;  // EPOLLIN de-registered while queue is full
+  int waiters = 0;      // threads blocked in nnstpu_server_take
+
+  void run();
+  void close_conn_locked(int fd);
+  void handle_readable(int fd);
+  bool parse_frames(Conn& c);  // false → close the connection
+  void set_reads_enabled_locked(bool on);
+  void wake() {
+    uint64_t v = 1;
+    ssize_t r = write(wake_fd, &v, 8);
+    (void)r;
+  }
+};
+
+void Server::close_conn_locked(int fd) {
+  auto it = conns.find(fd);
+  if (it == conns.end()) return;
+  by_id.erase(it->second.id);
+  conns.erase(it);
+  epoll_ctl(epoll_fd, EPOLL_CTL_DEL, fd, nullptr);
+  close(fd);
+}
+
+void Server::set_reads_enabled_locked(bool on) {
+  if (paused == !on) return;
+  paused = !on;
+  for (auto& [fd, c] : conns) {
+    struct epoll_event ev {};
+    ev.data.fd = fd;
+    ev.events = on ? (uint32_t)EPOLLIN : 0u;
+    epoll_ctl(epoll_fd, EPOLL_CTL_MOD, fd, &ev);
+  }
+}
+
+bool Server::parse_frames(Conn& c) {
+  size_t off = 0;
+  while (c.inbuf.size() - off >= 16) {
+    uint32_t magic, cmd;
+    uint64_t len;
+    memcpy(&magic, c.inbuf.data() + off, 4);
+    memcpy(&cmd, c.inbuf.data() + off + 4, 4);
+    memcpy(&len, c.inbuf.data() + off + 8, 8);
+    if (magic != kMagic || len > (1ULL << 33)) return false;
+    if (c.inbuf.size() - off - 16 < len) break;  // incomplete
+    const uint8_t* payload = c.inbuf.data() + off + 16;
+    off += 16 + len;
+    switch (cmd) {
+      case kRequestInfo: {
+        std::lock_guard<std::mutex> w(*c.wmu);
+        if (send_frame_all(c.fd, kApprove, (const uint8_t*)caps.data(),
+                           caps.size()) != 0)
+          return false;
+        char idbuf[16];
+        int n = snprintf(idbuf, sizeof(idbuf), "%u", c.id);
+        if (send_frame_all(c.fd, kClientId, (const uint8_t*)idbuf,
+                           (uint64_t)n) != 0)
+          return false;
+        break;
+      }
+      case kPing: {
+        std::lock_guard<std::mutex> w(*c.wmu);
+        if (send_frame_all(c.fd, kPing, nullptr, 0) != 0) return false;
+        break;
+      }
+      case kBye:
+        return false;  // orderly close
+      case kTransfer: {
+        std::lock_guard<std::mutex> g(mu);
+        queue.push_back({c.id, std::vector<uint8_t>(payload, payload + len)});
+        if (queue.size() >= max_queue) set_reads_enabled_locked(false);
+        cv.notify_all();
+        break;
+      }
+      default:
+        return false;  // unknown command: drop the connection
+    }
+  }
+  if (off) c.inbuf.erase(c.inbuf.begin(), c.inbuf.begin() + off);
+  return true;
+}
+
+void Server::handle_readable(int fd) {
+  Conn* c;
+  {
+    std::lock_guard<std::mutex> g(mu);
+    auto it = conns.find(fd);
+    if (it == conns.end()) return;
+    c = &it->second;  // stable: only this (epoll) thread erases conns
+  }
+  uint8_t tmp[1 << 16];
+  for (;;) {
+    ssize_t n = recv(fd, tmp, sizeof(tmp), 0);
+    if (n > 0) {
+      c->inbuf.insert(c->inbuf.end(), tmp, tmp + n);
+      if (!parse_frames(*c)) {
+        std::lock_guard<std::mutex> g(mu);
+        close_conn_locked(fd);
+        return;
+      }
+      // stop pulling more once the queue paused reads
+      std::lock_guard<std::mutex> g(mu);
+      if (paused) return;
+      continue;
+    }
+    if (n == 0 || (errno != EINTR && errno != EAGAIN &&
+                   errno != EWOULDBLOCK)) {
+      std::lock_guard<std::mutex> g(mu);
+      close_conn_locked(fd);
+      return;
+    }
+    if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+  }
+}
+
+void Server::run() {
+  constexpr int kMaxEvents = 64;
+  struct epoll_event evs[kMaxEvents];
+  while (!stopping.load(std::memory_order_relaxed)) {
+    {  // deferred closes requested by foreign threads (kick)
+      std::lock_guard<std::mutex> g(mu);
+      for (uint32_t id : to_close) {
+        auto it = by_id.find(id);
+        if (it != by_id.end()) close_conn_locked(it->second.first);
+      }
+      to_close.clear();
+    }
+    int n = epoll_wait(epoll_fd, evs, kMaxEvents, 200);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    for (int i = 0; i < n; i++) {
+      int fd = evs[i].data.fd;
+      if (fd == wake_fd) {
+        uint64_t v;
+        ssize_t r = read(wake_fd, &v, 8);
+        (void)r;  // drained; purpose is the wakeup itself
+        continue;
+      }
+      if (fd == listen_fd) {
+        for (;;) {
+          int cfd = accept(listen_fd, nullptr, nullptr);
+          if (cfd < 0) break;
+          set_nonblock(cfd);
+          int one = 1;
+          setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          std::lock_guard<std::mutex> g(mu);
+          Conn c;
+          c.fd = cfd;
+          c.id = next_id++;
+          by_id[c.id] = {cfd, c.wmu};
+          conns.emplace(cfd, std::move(c));
+          struct epoll_event ev {};
+          ev.data.fd = cfd;
+          ev.events = paused ? 0u : (uint32_t)EPOLLIN;
+          epoll_ctl(epoll_fd, EPOLL_CTL_ADD, cfd, &ev);
+        }
+        continue;
+      }
+      if (evs[i].events & (EPOLLHUP | EPOLLERR)) {
+        std::lock_guard<std::mutex> g(mu);
+        close_conn_locked(fd);
+        continue;
+      }
+      handle_readable(fd);
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* nnstpu_server_start(const char* host, int port, const char* caps,
+                          int max_queue) {
+  auto* s = new Server();
+  s->caps = caps ? caps : "";
+  if (max_queue > 0) s->max_queue = (size_t)max_queue;
+  s->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) {
+    delete s;
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  if (!host || !*host) {
+    addr.sin_addr.s_addr = INADDR_ANY;
+  } else {
+    // resolve like the Python transport does ("localhost" must NOT widen
+    // to all interfaces)
+    struct addrinfo hints {};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    struct addrinfo* res = nullptr;
+    if (getaddrinfo(host, nullptr, &hints, &res) != 0 || !res) {
+      close(s->listen_fd);
+      delete s;
+      return nullptr;
+    }
+    addr.sin_addr = ((sockaddr_in*)res->ai_addr)->sin_addr;
+    freeaddrinfo(res);
+  }
+  if (bind(s->listen_fd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
+      listen(s->listen_fd, 16) != 0) {
+    close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(s->listen_fd, (sockaddr*)&addr, &alen);
+  s->port = ntohs(addr.sin_port);
+  set_nonblock(s->listen_fd);
+
+  s->epoll_fd = epoll_create1(0);
+  s->wake_fd = eventfd(0, EFD_NONBLOCK);
+  struct epoll_event ev {};
+  ev.data.fd = s->listen_fd;
+  ev.events = EPOLLIN;
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, s->listen_fd, &ev);
+  ev.data.fd = s->wake_fd;
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, s->wake_fd, &ev);
+  s->loop = std::thread([s] { s->run(); });
+  return s;
+}
+
+int nnstpu_server_port(void* h) {
+  return h ? ((Server*)h)->port : -1;
+}
+
+// Atomically wait for, copy out, and pop one TRANSFER frame.
+//   0 → *out_client/*out_len filled, payload copied into out
+//  -1 → timeout            -2 → server stopping
+//  -3 → head frame larger than cap; *out_len = required size (frame stays
+//       queued — retry with a bigger buffer)
+int nnstpu_server_take(void* h, int timeout_ms, uint8_t* out, uint64_t cap,
+                       uint32_t* out_client, uint64_t* out_len) {
+  auto* s = (Server*)h;
+  bool rearm = false;
+  int rc;
+  {
+    std::unique_lock<std::mutex> g(s->mu);
+    s->waiters++;
+    bool got = s->cv.wait_for(g, std::chrono::milliseconds(timeout_ms),
+                              [s] {
+                                return !s->queue.empty() ||
+                                       s->stopping.load();
+                              });
+    s->waiters--;
+    if (s->stopping.load() && s->queue.empty()) {
+      s->cv.notify_all();  // let stop() observe the waiter count drop
+      return -2;
+    }
+    if (!got || s->queue.empty()) return -1;
+    auto& f = s->queue.front();
+    *out_client = f.client_id;
+    *out_len = f.payload.size();
+    if (f.payload.size() > cap) {
+      rc = -3;
+    } else {
+      if (!f.payload.empty()) memcpy(out, f.payload.data(),
+                                     f.payload.size());
+      s->queue.pop_front();
+      if (s->paused && s->queue.size() < s->max_queue / 2) {
+        s->set_reads_enabled_locked(true);
+        rearm = true;
+      }
+      rc = 0;
+    }
+  }
+  if (rearm) s->wake();  // kick epoll so re-armed fds are polled promptly
+  return rc;
+}
+
+// Send a framed message to one client. 0 ok, -1 unknown client, -2 error.
+int nnstpu_server_send(void* h, uint32_t client_id, uint32_t cmd,
+                       const uint8_t* payload, uint64_t len) {
+  auto* s = (Server*)h;
+  int dupfd;
+  std::shared_ptr<std::mutex> wmu;
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    auto it = s->by_id.find(client_id);
+    if (it == s->by_id.end()) return -1;
+    // dup under the lock: the epoll thread may close the original fd at
+    // any time, and a raw fd number could be reused — the dup stays valid
+    dupfd = dup(it->second.first);
+    if (dupfd < 0) return -2;
+    wmu = it->second.second;
+  }
+  int rc;
+  {
+    std::lock_guard<std::mutex> w(*wmu);
+    rc = send_frame_all(dupfd, cmd, payload, len);
+  }
+  close(dupfd);
+  return rc == 0 ? 0 : -2;
+}
+
+// Request disconnect of one client (processed by the epoll thread).
+int nnstpu_server_kick(void* h, uint32_t client_id) {
+  auto* s = (Server*)h;
+  std::lock_guard<std::mutex> g(s->mu);
+  if (s->by_id.find(client_id) == s->by_id.end()) return -1;
+  s->to_close.push_back(client_id);
+  s->wake();
+  return 0;
+}
+
+// Make blocked/future takes return -2 without freeing anything (callers
+// drain their in-flight calls between signal_stop and stop).
+void nnstpu_server_signal_stop(void* h) {
+  auto* s = (Server*)h;
+  s->stopping.store(true);
+  s->wake();
+  std::lock_guard<std::mutex> g(s->mu);
+  s->cv.notify_all();
+}
+
+void nnstpu_server_stop(void* h) {
+  auto* s = (Server*)h;
+  s->stopping.store(true);
+  s->wake();
+  // drain threads blocked in nnstpu_server_take before freeing: they hold
+  // (or are about to re-acquire) s->mu / s->cv
+  {
+    std::unique_lock<std::mutex> g(s->mu);
+    s->cv.notify_all();
+    while (s->waiters > 0) {
+      s->cv.notify_all();
+      g.unlock();
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+      g.lock();
+    }
+  }
+  if (s->loop.joinable()) s->loop.join();
+  for (auto& [fd, c] : s->conns) close(fd);
+  close(s->listen_fd);
+  close(s->epoll_fd);
+  close(s->wake_fd);
+  delete s;
+}
+
+}  // extern "C"

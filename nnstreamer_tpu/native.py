@@ -40,9 +40,8 @@ def build(quiet: bool = True) -> bool:
     try:
         with open(lock_path, "w") as lock:
             fcntl.flock(lock, fcntl.LOCK_EX)
-            if os.path.isfile(_LIB_PATH) and os.path.getmtime(
-                    _LIB_PATH) >= os.path.getmtime(
-                    os.path.join(_LIB_DIR, "nnstpu.cc")):
+            if os.path.isfile(_LIB_PATH) and \
+                    os.path.getmtime(_LIB_PATH) >= _newest_source_mtime():
                 return True  # another process already built it
             subprocess.run(["make", "-C", _LIB_DIR],
                            capture_output=quiet, check=True)
@@ -50,6 +49,20 @@ def build(quiet: bool = True) -> bool:
     except (subprocess.CalledProcessError, FileNotFoundError, OSError) as e:
         log.warning("native build failed: %s", e)
         return False
+
+
+def _newest_source_mtime() -> float:
+    """Newest mtime across every native source — a .so older than ANY
+    source (e.g. built before nnstpu_server.cc existed) must rebuild."""
+    newest = 0.0
+    try:
+        for fn in os.listdir(_LIB_DIR):
+            if fn.endswith((".cc", ".h")):
+                newest = max(newest,
+                             os.path.getmtime(os.path.join(_LIB_DIR, fn)))
+    except OSError:
+        pass
+    return newest
 
 
 def get_lib() -> Optional[ctypes.CDLL]:
@@ -60,7 +73,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
     _tried = True
     src = os.path.join(_LIB_DIR, "nnstpu.cc")
     stale = (os.path.isfile(_LIB_PATH) and os.path.isfile(src)
-             and os.path.getmtime(_LIB_PATH) < os.path.getmtime(src))
+             and os.path.getmtime(_LIB_PATH) < _newest_source_mtime())
     if not os.path.isfile(_LIB_PATH) or stale:
         if os.path.isfile(src):
             if not build():
@@ -76,6 +89,19 @@ def get_lib() -> Optional[ctypes.CDLL]:
     if lib.nnstpu_abi_version() != 1:
         log.warning("native ABI mismatch; rebuilding may help")
         return None
+    try:
+        return _bind(lib)
+    except AttributeError as e:
+        # a stale .so missing newer symbols (e.g. prebuilt before the
+        # server core landed, with sources absent so no rebuild happened):
+        # degrade to pure Python rather than crash at import
+        log.warning("native library is missing symbols (%s); "
+                    "rebuild with `python -m nnstreamer_tpu.native`", e)
+        return None
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    global _lib
     # signatures
     lib.nnstpu_cpu_features.restype = ctypes.c_int
     lib.nnstpu_fnv1a.restype = ctypes.c_uint64
@@ -102,6 +128,25 @@ def get_lib() -> Optional[ctypes.CDLL]:
         ctypes.c_int, ctypes.c_void_p, ctypes.c_uint64]
     lib.nnstpu_set_nodelay.restype = ctypes.c_int
     lib.nnstpu_set_nodelay.argtypes = [ctypes.c_int]
+    lib.nnstpu_server_start.restype = ctypes.c_void_p
+    lib.nnstpu_server_start.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+    lib.nnstpu_server_port.restype = ctypes.c_int
+    lib.nnstpu_server_port.argtypes = [ctypes.c_void_p]
+    lib.nnstpu_server_take.restype = ctypes.c_int
+    lib.nnstpu_server_take.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint64)]
+    lib.nnstpu_server_send.restype = ctypes.c_int
+    lib.nnstpu_server_send.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32,
+        ctypes.c_char_p, ctypes.c_uint64]
+    lib.nnstpu_server_kick.restype = ctypes.c_int
+    lib.nnstpu_server_kick.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.nnstpu_server_signal_stop.restype = None
+    lib.nnstpu_server_signal_stop.argtypes = [ctypes.c_void_p]
+    lib.nnstpu_server_stop.restype = None
+    lib.nnstpu_server_stop.argtypes = [ctypes.c_void_p]
     _lib = lib
     return _lib
 
@@ -188,6 +233,119 @@ def send_frame(sock, magic: int, command: int, payload: bytes) -> None:
     import struct
 
     sock.sendall(struct.pack("<IIQ", magic, command, len(payload)) + payload)
+
+
+class NativeServerCore:
+    """Handle to the C++ epoll query-server transport (nnstpu_server.cc).
+
+    Owns the listener + all client sockets on one native thread; Python
+    sees only complete TRANSFER payloads (``wait_pop``) and pushes framed
+    replies (``send``). Raises OSError if the native library is missing or
+    the port cannot be bound — callers fall back to the pure-Python server.
+
+    ``stop`` is safe against concurrent callers: it signals the native core
+    (blocked takes return immediately), waits for in-flight calls to drain,
+    and only then frees the handle.
+    """
+
+    #: initial take buffer; grows to the reported frame size on demand
+    _INITIAL_CAP = 1 << 16
+
+    def __init__(self, host: str, port: int, caps_str: str = "",
+                 max_queue: int = 64):
+        import threading
+
+        lib = get_lib()
+        if lib is None:
+            raise OSError("native library unavailable")
+        self._lib = lib
+        self._cv = threading.Condition()
+        self._inflight = 0
+        self._h = lib.nnstpu_server_start(
+            (host or "").encode(), int(port), caps_str.encode(),
+            int(max_queue))
+        if not self._h:
+            raise OSError(f"nnstpu_server: cannot bind {host}:{port}")
+        self.port = int(lib.nnstpu_server_port(self._h))
+
+    def _enter(self) -> Optional[int]:
+        """Return the handle to use for ONE native call (never re-read
+        self._h after this — a concurrent stop() nulls it, and the capture
+        is what keeps the handle alive until _exit)."""
+        with self._cv:
+            if self._h is None:
+                return None
+            self._inflight += 1
+            return self._h
+
+    def _exit(self) -> None:
+        with self._cv:
+            self._inflight -= 1
+            self._cv.notify_all()
+
+    def wait_pop(self, timeout: Optional[float]
+                 ) -> Optional[Tuple[int, bytes]]:
+        """Block up to ``timeout`` s (None = forever) for one TRANSFER;
+        (client_id, payload) or None on timeout/stop. GIL released while
+        waiting."""
+        h = self._enter()
+        if h is None:
+            return None
+        try:
+            cid = ctypes.c_uint32()
+            ln = ctypes.c_uint64()
+            buf = bytearray(self._INITIAL_CAP)
+            while True:
+                # None = block forever: re-arm hour-long native waits (the
+                # C side wants a finite ms value)
+                step_ms = 3_600_000 if timeout is None \
+                    else max(0, int(timeout * 1000))
+                rc = self._lib.nnstpu_server_take(
+                    h, step_ms,
+                    (ctypes.c_char * len(buf)).from_buffer(buf), len(buf),
+                    ctypes.byref(cid), ctypes.byref(ln))
+                if rc == 0:
+                    return int(cid.value), bytes(buf[:ln.value])
+                if rc == -3:  # head frame bigger than our buffer: grow
+                    buf = bytearray(ln.value)
+                    continue
+                if rc == -1 and timeout is None:
+                    continue  # infinite wait: keep re-arming
+                return None  # timeout or stopping
+        finally:
+            self._exit()
+
+    def send(self, client_id: int, cmd: int, payload: bytes) -> bool:
+        h = self._enter()
+        if h is None:
+            return False
+        try:
+            rc = self._lib.nnstpu_server_send(
+                h, int(client_id), int(cmd), payload, len(payload))
+            return rc == 0
+        finally:
+            self._exit()
+
+    def kick(self, client_id: int) -> None:
+        """Disconnect one client (native parity with the pure-Python
+        loop's close-on-bad-frame)."""
+        h = self._enter()
+        if h is None:
+            return
+        try:
+            self._lib.nnstpu_server_kick(h, int(client_id))
+        finally:
+            self._exit()
+
+    def stop(self) -> None:
+        with self._cv:
+            h, self._h = self._h, None
+            if h is None:
+                return
+            self._lib.nnstpu_server_signal_stop(h)
+            while self._inflight:
+                self._cv.wait()
+        self._lib.nnstpu_server_stop(h)
 
 
 def main(argv=None):

@@ -8,6 +8,7 @@ Reference: ``tensor_query_server.c`` (262 LoC) + the server halves of
 
 from __future__ import annotations
 
+import os
 import queue as _queue
 import socket
 import threading
@@ -21,13 +22,25 @@ log = get_logger("query.server")
 
 
 class QueryServer:
-    """Accepts query clients; exposes a queue of (client_id, buffer)."""
+    """Accepts query clients; exposes a queue of (client_id, buffer).
+
+    Transport backends, in preference order:
+
+    - **native** — the C++ epoll core (``native/nnstpu_server.cc``): one
+      native thread owns all sockets, handshake/framing/reassembly run
+      GIL-free, Python only unpacks complete buffers. The reference's
+      server is native C for the same reason (tensor_query_common.c).
+    - **pure-Python** — thread-per-client fallback, always available;
+      forced with ``NNSTPU_PURE_PY_SERVER=1`` (also what CI uses to keep
+      the fallback honest).
+    """
 
     def __init__(self, host: str = "0.0.0.0", port: int = 3000,
                  caps_str: str = "", max_queue: int = 64):
         self.host = host
         self.port = port
         self.caps_str = caps_str
+        self.max_queue = max_queue
         self.incoming: _queue.Queue = _queue.Queue(maxsize=max_queue)
         self._clients: Dict[int, socket.socket] = {}
         self._clients_lock = threading.Lock()
@@ -35,9 +48,26 @@ class QueryServer:
         self._listener: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        self._core = None  # NativeServerCore when the native path is live
+
+    @property
+    def native(self) -> bool:
+        return self._core is not None
 
     def start(self) -> "QueryServer":
         self._stop.clear()
+        if not os.environ.get("NNSTPU_PURE_PY_SERVER"):
+            try:
+                from nnstreamer_tpu.native import NativeServerCore
+
+                self._core = NativeServerCore(
+                    self.host, self.port, self.caps_str, self.max_queue)
+                self.port = self._core.port
+                return self
+            except OSError as e:
+                log.info("native server core unavailable (%s); "
+                         "using pure-Python transport", e)
+                self._core = None
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((self.host, self.port))
@@ -52,6 +82,10 @@ class QueryServer:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._core is not None:
+            self._core.stop()
+            self._core = None
+            return
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=5)
             self._accept_thread = None
@@ -65,6 +99,10 @@ class QueryServer:
                 except OSError:
                     pass
             self._clients.clear()
+        try:  # unblock a consumer waiting in get_buffer (native parity)
+            self.incoming.put_nowait(None)
+        except _queue.Full:
+            pass  # consumer isn't blocked on an empty queue
 
     # -- accept/receive ------------------------------------------------------
     def _accept_loop(self):
@@ -116,6 +154,13 @@ class QueryServer:
 
     # -- results -------------------------------------------------------------
     def send_result(self, client_id: int, buf: TensorBuffer) -> bool:
+        if self._core is not None:
+            ok = self._core.send(client_id, int(P.Cmd.RESULT),
+                                 P.pack_buffer(buf))
+            if not ok:
+                log.warning("result for client %d not deliverable",
+                            client_id)
+            return ok
         with self._clients_lock:
             conn = self._clients.get(client_id)
         if conn is None:
@@ -130,6 +175,33 @@ class QueryServer:
 
     def get_buffer(self, timeout: Optional[float] = None
                    ) -> Optional[TensorBuffer]:
+        if self._core is not None:
+            import time as _time
+
+            deadline = None if timeout is None \
+                else _time.monotonic() + timeout
+            while True:
+                if deadline is None:
+                    remaining = None  # block-forever parity with Queue.get
+                else:
+                    remaining = deadline - _time.monotonic()
+                    if remaining <= 0:
+                        return None
+                got = self._core.wait_pop(remaining)
+                if got is None:
+                    return None
+                client_id, payload = got
+                try:
+                    buf = P.unpack_buffer(payload)
+                except Exception as e:  # noqa: BLE001 — corrupt frame:
+                    # disconnect the sender (pure-Python parity: its client
+                    # loop dies on a bad frame) and keep waiting
+                    log.warning("bad frame from client %d (%s); "
+                                "disconnecting it", client_id, e)
+                    self._core.kick(client_id)
+                    continue
+                buf.meta["query_client_id"] = client_id
+                return buf
         try:
             return self.incoming.get(timeout=timeout)
         except _queue.Empty:

@@ -97,17 +97,18 @@ def ensure_jax_platform(probe_timeout: Optional[float] = None) -> str:
     """Commit a working jax backend (preset platform if healthy, else CPU)
     and return the platform name in use. Call before any other jax work.
 
-    Only explicit non-CPU ``JAX_PLATFORMS`` presets are probed (those are
-    the ones that can wedge); an unset or ``cpu`` preset initializes
-    in-process directly. Probe verdicts are cached in a temp file keyed by
-    the preset (TTL ``NNSTPU_PROBE_CACHE_TTL``, default 600 s) so repeated
-    example/bench invocations don't re-pay the subprocess jax import or a
-    tunneled backend's PJRT init.
+    An explicit ``cpu`` preset initializes in-process directly (CPU init
+    cannot wedge). Everything else is probed — including an UNSET preset,
+    because jax's no-preset plugin auto-discovery initializes any installed
+    accelerator plugin first and can wedge exactly like an explicit one
+    (a sitecustomize may even force the platform at interpreter boot).
+    Probe verdicts are cached in a temp file keyed by the preset (TTL
+    ``NNSTPU_PROBE_CACHE_TTL``, default 600 s) so repeated example/bench
+    invocations don't re-pay the subprocess jax import or a tunneled
+    backend's PJRT init.
     """
     preset = os.environ.get("JAX_PLATFORMS", "").strip().lower()
-    if preset in ("", "cpu"):
-        # nothing exotic to probe: CPU init cannot wedge, and with no
-        # preset jax's own backend-selection fallback applies
+    if preset == "cpu":
         import jax
 
         return jax.devices()[0].platform

@@ -171,8 +171,21 @@ class TestPlatformProbe:
         monkeypatch.setattr(plat, "probe_jax_platform", boom)
         monkeypatch.setenv("JAX_PLATFORMS", "cpu")
         assert plat.ensure_jax_platform() == "cpu"
+
+    def test_unset_preset_probes_and_caches(self, monkeypatch, tmp_path):
+        """No preset still probes (plugin auto-discovery can wedge the
+        same way an explicit preset can) — but only once per cache TTL."""
+        from nnstreamer_tpu.utils import platform as plat
+
+        import tempfile
+        monkeypatch.setattr(tempfile, "gettempdir", lambda: str(tmp_path))
+        calls = []
+        monkeypatch.setattr(plat, "probe_jax_platform",
+                            lambda *a, **k: calls.append(1) or "cpu")
         monkeypatch.setenv("JAX_PLATFORMS", "")
         assert plat.ensure_jax_platform() == "cpu"
+        assert plat.ensure_jax_platform() == "cpu"
+        assert len(calls) == 1
 
     def test_probe_cache_roundtrip(self, monkeypatch, tmp_path):
         from nnstreamer_tpu.utils import platform as plat

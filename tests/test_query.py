@@ -367,3 +367,124 @@ class TestDistributedSharded:
                 client.stop()
             server.stop()
             unregister_jax_model("sharded_scale")
+
+
+class TestServerTransports:
+    """Same behavior from the native epoll core and the pure-Python
+    fallback (native/nnstpu_server.cc vs query/server.py threads)."""
+
+    @pytest.fixture(params=["native", "purepy"])
+    def server(self, request, monkeypatch):
+        from nnstreamer_tpu.query.server import QueryServer
+
+        if request.param == "purepy":
+            monkeypatch.setenv("NNSTPU_PURE_PY_SERVER", "1")
+        srv = QueryServer(host="127.0.0.1", port=0,
+                          caps_str="other/tensors").start()
+        if request.param == "native" and not srv.native:
+            srv.stop()
+            pytest.skip("native library not built")
+        assert srv.native == (request.param == "native")
+        yield srv
+        srv.stop()
+
+    def _handshake(self, port):
+        sock = P.connect("127.0.0.1", port, timeout=10)
+        P.send_msg(sock, P.Cmd.REQUEST_INFO, b"caps")
+        cmd, payload = P.recv_msg(sock)
+        assert cmd is P.Cmd.APPROVE and payload == b"other/tensors"
+        cmd, payload = P.recv_msg(sock)
+        assert cmd is P.Cmd.CLIENT_ID
+        return sock, int(payload.decode())
+
+    def test_handshake_transfer_result(self, server, rng):
+        sock, cid = self._handshake(server.port)
+        buf = TensorBuffer([rng.standard_normal((3, 4)).astype(np.float32)],
+                           pts=7)
+        P.send_buffer(sock, buf)
+        got = server.get_buffer(timeout=10)
+        assert got is not None and got.meta["query_client_id"] == cid
+        np.testing.assert_array_equal(got[0], buf[0])
+        assert server.send_result(cid, got)
+        cmd, payload = P.recv_msg(sock)
+        assert cmd is P.Cmd.RESULT
+        back = P.unpack_buffer(payload)
+        np.testing.assert_array_equal(back[0], buf[0])
+        sock.close()
+
+    def test_ping_and_bye(self, server):
+        sock, cid = self._handshake(server.port)
+        P.send_msg(sock, P.Cmd.PING)
+        assert P.recv_msg(sock)[0] is P.Cmd.PING
+        P.send_msg(sock, P.Cmd.BYE)
+        sock.close()
+        # after BYE the client is gone: results are undeliverable
+        import time
+        deadline = time.monotonic() + 5
+        while server.send_result(cid, TensorBuffer([np.zeros(1)])):
+            assert time.monotonic() < deadline, "BYE never processed"
+            time.sleep(0.02)
+
+    def test_many_clients_routing(self, server):
+        socks = {}
+        for _ in range(8):
+            sock, cid = self._handshake(server.port)
+            socks[cid] = sock
+        for cid, sock in socks.items():
+            P.send_buffer(sock, TensorBuffer(
+                [np.full((2,), cid, np.int32)], pts=cid))
+        for _ in range(len(socks)):
+            got = server.get_buffer(timeout=10)
+            assert got is not None
+            cid = got.meta["query_client_id"]
+            assert int(got[0][0]) == cid  # payload matches its client
+            assert server.send_result(cid, got)
+        for cid, sock in socks.items():
+            cmd, payload = P.recv_msg(sock)
+            assert cmd is P.Cmd.RESULT
+            assert int(P.unpack_buffer(payload)[0][0]) == cid
+            sock.close()
+
+    def test_large_frame_growth(self, server, rng):
+        """Frames bigger than the take buffer's initial capacity (64 KiB)
+        exercise the grow-and-retry path."""
+        sock, cid = self._handshake(server.port)
+        big = rng.standard_normal((512, 600)).astype(np.float32)  # ~1.2 MB
+        P.send_buffer(sock, TensorBuffer([big]))
+        got = server.get_buffer(timeout=10)
+        assert got is not None and got.meta["query_client_id"] == cid
+        np.testing.assert_array_equal(got[0], big)
+        sock.close()
+
+    def test_bad_frame_disconnects_client(self, server):
+        """A TRANSFER payload that fails buffer unpack must disconnect the
+        sender on both transports (not stall the consumer)."""
+        sock, cid = self._handshake(server.port)
+        P.send_msg(sock, P.Cmd.TRANSFER, b"\x01garbage-not-a-buffer")
+        assert server.get_buffer(timeout=2) is None
+        # connection is closed server-side: recv sees EOF (possibly after
+        # a short delay while the close is processed)
+        sock.settimeout(5)
+        with pytest.raises((P.QueryProtocolError, OSError)):
+            while True:
+                P.recv_msg(sock)
+        sock.close()
+
+    def test_stop_while_consumer_blocked(self, server):
+        """stop() must unblock a thread waiting in get_buffer and never
+        crash (native core frees only after in-flight calls drain)."""
+        import threading
+        import time
+
+        results = []
+
+        def consumer():
+            results.append(server.get_buffer(timeout=30))
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        time.sleep(0.2)  # let it block inside the wait
+        server.stop()
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert results == [None]
