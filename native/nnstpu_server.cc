@@ -79,9 +79,13 @@ int set_nonblock(int fd) {
 }
 
 // blocking send of a whole frame on a possibly-nonblocking fd; caller must
-// hold the connection's write mutex
+// hold the connection's write mutex. stall_ms caps each EAGAIN wait:
+// result sends from Python threads tolerate slow readers (10 s); the epoll
+// thread uses a short cap so one unresponsive client cannot stall accept
+// and every other connection — a client that cannot drain a 16-byte reply
+// within it is closed instead.
 int send_frame_all(int fd, uint32_t cmd, const uint8_t* payload,
-                   uint64_t len) {
+                   uint64_t len, int stall_ms = 10000) {
   uint8_t hdr[16];
   memcpy(hdr, &kMagic, 4);
   memcpy(hdr + 4, &cmd, 4);
@@ -96,7 +100,7 @@ int send_frame_all(int fd, uint32_t cmd, const uint8_t* payload,
         if (errno == EINTR) continue;
         if (errno == EAGAIN || errno == EWOULDBLOCK) {
           struct pollfd p = {fd, POLLOUT, 0};
-          if (poll(&p, 1, 10000) <= 0) return -1;  // 10 s write stall cap
+          if (poll(&p, 1, stall_ms) <= 0) return -1;  // write stall cap
           continue;
         }
         return -1;
@@ -106,6 +110,9 @@ int send_frame_all(int fd, uint32_t cmd, const uint8_t* payload,
   }
   return 0;
 }
+
+// epoll-thread reply budget (handshake/ping frames are tiny)
+constexpr int kLoopSendStallMs = 1000;
 
 struct Server {
   int listen_fd = -1;
@@ -180,18 +187,19 @@ bool Server::parse_frames(Conn& c) {
       case kRequestInfo: {
         std::lock_guard<std::mutex> w(*c.wmu);
         if (send_frame_all(c.fd, kApprove, (const uint8_t*)caps.data(),
-                           caps.size()) != 0)
+                           caps.size(), kLoopSendStallMs) != 0)
           return false;
         char idbuf[16];
         int n = snprintf(idbuf, sizeof(idbuf), "%u", c.id);
         if (send_frame_all(c.fd, kClientId, (const uint8_t*)idbuf,
-                           (uint64_t)n) != 0)
+                           (uint64_t)n, kLoopSendStallMs) != 0)
           return false;
         break;
       }
       case kPing: {
         std::lock_guard<std::mutex> w(*c.wmu);
-        if (send_frame_all(c.fd, kPing, nullptr, 0) != 0) return false;
+        if (send_frame_all(c.fd, kPing, nullptr, 0, kLoopSendStallMs) != 0)
+          return false;
         break;
       }
       case kBye:
@@ -351,9 +359,24 @@ void* nnstpu_server_start(const char* host, int port, const char* caps,
   struct epoll_event ev {};
   ev.data.fd = s->listen_fd;
   ev.events = EPOLLIN;
-  epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, s->listen_fd, &ev);
+  // fd exhaustion etc. must fail loudly here (→ pure-Python fallback), not
+  // hand back a live-looking server whose event loop is dead
+  if (s->epoll_fd < 0 || s->wake_fd < 0 ||
+      epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, s->listen_fd, &ev) != 0) {
+    if (s->epoll_fd >= 0) close(s->epoll_fd);
+    if (s->wake_fd >= 0) close(s->wake_fd);
+    close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
   ev.data.fd = s->wake_fd;
-  epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, s->wake_fd, &ev);
+  if (epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, s->wake_fd, &ev) != 0) {
+    close(s->epoll_fd);
+    close(s->wake_fd);
+    close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
   s->loop = std::thread([s] { s->run(); });
   return s;
 }

@@ -171,10 +171,20 @@ class BoundingBoxes:
         self._anchors = None
         self._warned_saturated = False
 
+    #: legacy names and same-format aliases (reference bb_modes[],
+    #: tensordec-boundingbox.c:157-166: tflite-ssd/tf-ssd are the old names;
+    #: ov-face-detection shares the ov-person row format end to end)
+    MODE_ALIASES = {
+        "tflite-ssd": "mobilenet-ssd",
+        "tf-ssd": "mobilenet-ssd-postprocess",
+        "ov-face-detection": "ov-person-detection",
+    }
+
     def _opts(self, options: Dict[str, str]) -> dict:
         size = (options.get("option4") or "300:300").split(":")
+        mode = options.get("option1", "mobilenet-ssd")
         return dict(
-            mode=options.get("option1", "mobilenet-ssd"),
+            mode=self.MODE_ALIASES.get(mode, mode),
             labels_path=options.get("option2"),
             score_thresh=float(options.get("option3") or 0.5),
             width=int(size[0]), height=int(size[1]),

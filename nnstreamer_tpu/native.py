@@ -261,6 +261,9 @@ class NativeServerCore:
         self._lib = lib
         self._cv = threading.Condition()
         self._inflight = 0
+        #: per-thread reusable take buffer — idle polls (10/s in the
+        #: serversrc loop) must not churn 64 KiB allocations
+        self._tls = threading.local()
         self._h = lib.nnstpu_server_start(
             (host or "").encode(), int(port), caps_str.encode(),
             int(max_queue))
@@ -294,7 +297,9 @@ class NativeServerCore:
         try:
             cid = ctypes.c_uint32()
             ln = ctypes.c_uint64()
-            buf = bytearray(self._INITIAL_CAP)
+            buf = getattr(self._tls, "buf", None)
+            if buf is None:
+                buf = self._tls.buf = bytearray(self._INITIAL_CAP)
             while True:
                 # None = block forever: re-arm hour-long native waits (the
                 # C side wants a finite ms value)
@@ -307,7 +312,7 @@ class NativeServerCore:
                 if rc == 0:
                     return int(cid.value), bytes(buf[:ln.value])
                 if rc == -3:  # head frame bigger than our buffer: grow
-                    buf = bytearray(ln.value)
+                    buf = self._tls.buf = bytearray(ln.value)
                     continue
                 if rc == -1 and timeout is None:
                     continue  # infinite wait: keep re-arming

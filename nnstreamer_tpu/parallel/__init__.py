@@ -22,3 +22,4 @@ from nnstreamer_tpu.parallel.mesh import (  # noqa: F401
     BatchSharding,
 )
 from nnstreamer_tpu.parallel.ring import ring_attention  # noqa: F401
+from nnstreamer_tpu.parallel import multihost  # noqa: F401

@@ -1,0 +1,124 @@
+"""Multi-host (multi-process) SPMD support.
+
+The reference scales across machines with hand-rolled CPU transports
+(tensor_query TCP, MQTT, gRPC — SURVEY §2.3); tensors always transit host
+memory. The TPU-native equivalent keeps *control* on DCN but moves tensor
+traffic onto XLA collectives: every host runs the same program, jax's
+distributed runtime forms the global device mesh, and pjit/shard_map
+insert ICI/DCN collectives. This module is the thin bootstrap around
+that — the moral peer of the reference's query-server handshake, not of
+its data path.
+
+Usage (same script on every host)::
+
+    from nnstreamer_tpu.parallel import multihost
+
+    multihost.initialize()            # env-driven; no-op single-process
+    mesh = multihost.global_mesh([("dp", -1)])
+    ...                               # pjit/shard_map as usual
+
+Env (mirroring jax.distributed's own knobs):
+  NNSTPU_COORDINATOR  host:port of process 0 (or JAX_COORDINATOR_ADDRESS)
+  NNSTPU_NUM_PROCESSES / NNSTPU_PROCESS_ID
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+from nnstreamer_tpu.log import get_logger
+
+log = get_logger("parallel.multihost")
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> bool:
+    """Join the jax distributed runtime. Explicit args beat env vars; with
+    neither (or a single process) this is a no-op returning False —
+    single-host pipelines never pay a coordinator round trip."""
+    global _initialized
+    if _initialized:
+        return True
+    coordinator_address = (coordinator_address
+                           or os.environ.get("NNSTPU_COORDINATOR")
+                           or os.environ.get("JAX_COORDINATOR_ADDRESS"))
+    if num_processes is None:
+        env = os.environ.get("NNSTPU_NUM_PROCESSES")
+        num_processes = int(env) if env else None
+    if process_id is None:
+        env = os.environ.get("NNSTPU_PROCESS_ID")
+        process_id = int(env) if env else None
+    if not coordinator_address or not num_processes or num_processes <= 1:
+        return False
+    import jax
+
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _initialized = True
+    log.info("joined distributed runtime: process %d/%d via %s",
+             jax.process_index(), jax.process_count(), coordinator_address)
+    return True
+
+
+def process_info() -> Tuple[int, int]:
+    """(process_index, process_count) — (0, 1) when single-process."""
+    import jax
+
+    return jax.process_index(), jax.process_count()
+
+
+def global_mesh(axes: Sequence[Tuple[str, int]]):
+    """A mesh over ALL devices across every host (``jax.devices()`` is
+    global after :func:`initialize`). An axis size of -1 absorbs the
+    remaining device count, so the same spec works on any slice size."""
+    import jax
+
+    from nnstreamer_tpu.parallel.mesh import make_mesh
+
+    total = len(jax.devices())
+    fixed = 1
+    wildcard = None
+    resolved = []
+    for name, size in axes:
+        if size == -1:
+            if wildcard is not None:
+                raise ValueError("only one axis may be -1")
+            wildcard = name
+            resolved.append((name, -1))
+        else:
+            fixed *= size
+            resolved.append((name, size))
+    if wildcard is not None:
+        if total % fixed:
+            raise ValueError(
+                f"{total} devices not divisible by fixed axes ({fixed})")
+        resolved = [(n, total // fixed if s == -1 else s)
+                    for n, s in resolved]
+    return make_mesh(resolved)
+
+
+def local_batch_slice(global_batch: int) -> slice:
+    """Which rows of a global batch THIS host feeds (data loading is
+    per-host in SPMD: every process reads only its shard)."""
+    idx, count = process_info()
+    if global_batch % count:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by {count} hosts")
+    per = global_batch // count
+    return slice(idx * per, (idx + 1) * per)
+
+
+def host_local_to_global(arrays, mesh, pspec):
+    """Assemble per-host shards into one global ``jax.Array``
+    (``jax.make_array_from_process_local_data``) — feed pipelines on each
+    host, train globally."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, pspec), arrays)

@@ -122,11 +122,14 @@ def _make_element(factory_name: str, props: List[Tuple[str, str]]) -> Element:
     from nnstreamer_tpu.config import get_conf
 
     conf = get_conf()
-    # element-restriction allowlist (reference meson option
-    # enable-element-restriction + [element-restriction] restricted_elements)
-    if conf.get_bool("element-restriction", "enable"):
+    # element-restriction allowlist (reference meson.build:531-540:
+    # [element-restriction] enable_element_restriction + allowed_elements;
+    # the short `enable`/`restricted_elements` spellings are also accepted)
+    if (conf.get_bool("element-restriction", "enable_element_restriction")
+            or conf.get_bool("element-restriction", "enable")):
         allowed = {e.strip() for e in
-                   (conf.get("element-restriction", "restricted_elements")
+                   (conf.get("element-restriction", "allowed_elements")
+                    or conf.get("element-restriction", "restricted_elements")
                     or "").split(",") if e.strip()}
         if factory_name not in allowed:
             raise ValueError(

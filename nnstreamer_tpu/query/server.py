@@ -135,7 +135,14 @@ class QueryServer:
                     P.send_msg(conn, P.Cmd.CLIENT_ID,
                                str(client_id).encode())
                 elif cmd is P.Cmd.TRANSFER:
-                    buf = P.unpack_buffer(payload)
+                    try:
+                        buf = P.unpack_buffer(payload)
+                    except Exception as e:  # noqa: BLE001 — corrupt frame:
+                        # orderly disconnect (matches the native path's
+                        # kick-on-bad-frame), not a thread-killing traceback
+                        log.warning("bad frame from client %d (%s); "
+                                    "disconnecting it", client_id, e)
+                        break
                     buf.meta["query_client_id"] = client_id
                     self.incoming.put(buf)
                 elif cmd is P.Cmd.PING:
@@ -154,9 +161,10 @@ class QueryServer:
 
     # -- results -------------------------------------------------------------
     def send_result(self, client_id: int, buf: TensorBuffer) -> bool:
-        if self._core is not None:
-            ok = self._core.send(client_id, int(P.Cmd.RESULT),
-                                 P.pack_buffer(buf))
+        core = self._core  # capture once: stop() nulls the attribute
+        if core is not None:
+            ok = core.send(client_id, int(P.Cmd.RESULT),
+                           P.pack_buffer(buf))
             if not ok:
                 log.warning("result for client %d not deliverable",
                             client_id)
@@ -175,7 +183,8 @@ class QueryServer:
 
     def get_buffer(self, timeout: Optional[float] = None
                    ) -> Optional[TensorBuffer]:
-        if self._core is not None:
+        core = self._core  # capture once: stop() nulls the attribute
+        if core is not None:
             import time as _time
 
             deadline = None if timeout is None \
@@ -187,7 +196,7 @@ class QueryServer:
                     remaining = deadline - _time.monotonic()
                     if remaining <= 0:
                         return None
-                got = self._core.wait_pop(remaining)
+                got = core.wait_pop(remaining)
                 if got is None:
                     return None
                 client_id, payload = got
@@ -198,7 +207,7 @@ class QueryServer:
                     # loop dies on a bad frame) and keep waiting
                     log.warning("bad frame from client %d (%s); "
                                 "disconnecting it", client_id, e)
-                    self._core.kick(client_id)
+                    core.kick(client_id)
                     continue
                 buf.meta["query_client_id"] = client_id
                 return buf

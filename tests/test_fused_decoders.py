@@ -258,3 +258,18 @@ def test_fused_segment_matches_host(seg_model):
                                   u.meta["segment_labels"])
     np.testing.assert_array_equal(np.asarray(f[0]), np.asarray(u[0]))
     assert np.asarray(f[0]).shape == (12, 10, 4)
+
+
+def test_mode_aliases_match_reference(postproc_model):
+    """Legacy names tflite-ssd/tf-ssd and ov-face-detection resolve to
+    their modern equivalents (reference bb_modes[],
+    tensordec-boundingbox.c:157-166)."""
+    frame = np.zeros((4,), np.uint8)
+    new = _run_pipe(postproc_model,
+                    "bounding_boxes option1=mobilenet-ssd-postprocess "
+                    "option3=0.5 option7=meta", frame, fuse=False)
+    old = _run_pipe(postproc_model,
+                    "bounding_boxes option1=tf-ssd option3=0.5 option7=meta",
+                    frame, fuse=False)
+    assert [_det_key(d) for d in new.meta["detections"]] == \
+        [_det_key(d) for d in old.meta["detections"]]

@@ -271,3 +271,50 @@ def test_yolo_detector_pipeline():
             assert 0 <= d["score"] <= 1
     finally:
         unregister_jax_model("yolo_t")
+
+
+class TestMultihost:
+    """Single-process behavior of the multi-host bootstrap (the real
+    multi-process path reuses jax.distributed; here we pin the no-op and
+    mesh/slicing semantics every host relies on)."""
+
+    def test_initialize_noop_single_process(self, monkeypatch):
+        from nnstreamer_tpu.parallel import multihost
+
+        for var in ("NNSTPU_COORDINATOR", "NNSTPU_NUM_PROCESSES",
+                    "NNSTPU_PROCESS_ID", "JAX_COORDINATOR_ADDRESS"):
+            monkeypatch.delenv(var, raising=False)
+        assert multihost.initialize() is False
+        assert multihost.process_info() == (0, 1)
+
+    def test_global_mesh_wildcard(self):
+        from nnstreamer_tpu.parallel import multihost
+
+        mesh = multihost.global_mesh([("dp", -1), ("tp", 2)])
+        assert mesh.shape["tp"] == 2
+        assert mesh.shape["dp"] * 2 == 8  # conftest: 8 virtual devices
+
+    def test_global_mesh_indivisible(self):
+        from nnstreamer_tpu.parallel import multihost
+
+        import pytest
+        with pytest.raises(ValueError):
+            multihost.global_mesh([("dp", -1), ("tp", 3)])
+
+    def test_local_batch_slice(self):
+        from nnstreamer_tpu.parallel import multihost
+
+        assert multihost.local_batch_slice(32) == slice(0, 32)
+
+    def test_host_local_to_global_roundtrip(self):
+        import jax
+        import numpy as np
+        from jax.sharding import PartitionSpec as P
+
+        from nnstreamer_tpu.parallel import multihost
+
+        mesh = multihost.global_mesh([("dp", -1)])
+        data = np.arange(8 * 3, dtype=np.float32).reshape(8, 3)
+        arr = multihost.host_local_to_global(data, mesh, P("dp"))
+        assert isinstance(arr, jax.Array)
+        np.testing.assert_array_equal(np.asarray(arr), data)
