@@ -138,7 +138,7 @@ def measure_ssd() -> dict:
         "tensor_filter framework=jax model=ssd_bench name=filter ! "
         "tensor_decoder mode=bounding_boxes option1=mobilenet-ssd "
         "option4=300:300 option7=meta ! "
-        "queue max-size-buffers=32 prefetch-host=true ! "
+        "queue max-size-buffers=64 prefetch-host=true ! "
         "tensor_sink name=sink to-host=true")
     frame_t = _collect(pipe)
     return dict(metric="ssd_mobilenet_300_pipeline_fps",
@@ -173,7 +173,7 @@ def measure_pose_mux() -> dict:
     pipe = parse_launch(
         f"tensor_mux name=mux sync-mode=slowest ! "
         "tensor_filter framework=jax model=pose4_bench name=filter ! "
-        "queue max-size-buffers=32 prefetch-host=true ! "
+        "queue max-size-buffers=64 prefetch-host=true ! "
         "tensor_sink name=sink to-host=false " + srcs)
     frame_t = _collect(pipe)
     return dict(metric="posenet_mux4_batched_fps",
@@ -202,7 +202,7 @@ def measure_query() -> dict:
     server = parse_launch(
         "tensor_query_serversrc name=ssrc port=0 ! "
         "tensor_filter framework=jax model=mnv2_query_bench ! "
-        "queue max-size-buffers=32 prefetch-host=true ! "
+        "queue max-size-buffers=64 prefetch-host=true ! "
         "tensor_query_serversink")
     server.start()
     try:

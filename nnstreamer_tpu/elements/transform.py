@@ -109,7 +109,17 @@ class _TransformSpec:
             raise ValueError(f"unknown stand option {kind!r}")
         if mode == "clamp":
             lo, hi = (float(p) for p in option.split(":"))
-            return xp.clip(x, lo, hi)
+            # typed clamp: bounds saturate into the tensor's own dtype so
+            # the output dtype is preserved (reference gst_tensor_data
+            # typed math — clamping a uint8 stream must not promote to
+            # float, and option=-1:300 must saturate, not overflow)
+            dt = np.dtype(x.dtype)
+            if dt.kind in "iu":
+                info = np.iinfo(dt)
+                lo = int(np.clip(lo, info.min, info.max))
+                hi = int(np.clip(hi, info.min, info.max))
+            return xp.clip(x, xp.asarray(lo, dtype=x.dtype),
+                           xp.asarray(hi, dtype=x.dtype))
         raise ValueError(f"unknown transform mode {mode!r}")
 
     def __call__(self, x):

@@ -1,0 +1,186 @@
+"""SSAT-style golden-output pipeline tests.
+
+The reference's primary integration harness is SSAT
+(`Documentation/how-to-write-testcase.md`): shell scripts launch real
+gst-launch pipelines, dump via filesink, and byte-compare against golden
+files (`tests/<group>/runTest.sh`, helpers gstTest/compareAll). Same
+pattern here: every case is a LAUNCH STRING (the user-facing surface, not
+element objects), output is dumped by `filesink`, and the bytes are
+compared against a numpy-computed golden.
+
+Determinism: videotestsrc patterns are pure functions of (pattern, frame
+index) (`elements/source.py`), so goldens are derived, not stored.
+"""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import parse_launch
+
+
+def _src_frames(n, w, h, pattern="gradient"):
+    """Reference frames exactly as videotestsrc produces them."""
+    pipe = parse_launch(
+        f"videotestsrc num-buffers={n} width={w} height={h} "
+        f"pattern={pattern} ! tensor_converter ! tensor_sink name=out")
+    msg = pipe.run(timeout=60)
+    assert msg.kind == "eos"
+    return [np.asarray(b[0]) for b in pipe.get("out").buffers]
+
+
+def _run_golden(tmp_path, description, golden_bytes):
+    out = tmp_path / "result.raw"
+    pipe = parse_launch(description.format(out=out))
+    msg = pipe.run(timeout=120)
+    assert msg is not None and msg.kind == "eos", msg
+    assert out.read_bytes() == golden_bytes  # SSAT byte-compare
+
+
+def test_golden_typecast_arith(tmp_path):
+    # -127.5 and /128 are exactly representable at every step, so numpy
+    # and XLA produce byte-identical float32 output (SSAT needs exactness)
+    frames = _src_frames(6, 16, 16)
+    golden = b"".join(
+        ((f.astype(np.float32) - 127.5) / 128.0).tobytes() for f in frames)
+    _run_golden(
+        tmp_path,
+        "videotestsrc num-buffers=6 width=16 height=16 pattern=gradient ! "
+        "tensor_converter ! tensor_transform mode=arithmetic "
+        "option=typecast:float32,add:-127.5,div:128 ! "
+        "filesink location={out}",
+        golden)
+
+
+def test_golden_transpose(tmp_path):
+    # frames are (1, h, w, c); option indexes nnstreamer dims
+    # (innermost-first: 0=ch 1=w 2=h 3=frame) — 0:2:1:3 swaps w/h
+    frames = _src_frames(4, 12, 8)
+    golden = b"".join(np.ascontiguousarray(
+        f.transpose(0, 2, 1, 3)).tobytes() for f in frames)
+    _run_golden(
+        tmp_path,
+        "videotestsrc num-buffers=4 width=12 height=8 pattern=gradient ! "
+        "tensor_converter ! tensor_transform mode=transpose option=0:2:1:3 ! "
+        "filesink location={out}",
+        golden)
+
+
+def test_golden_clamp(tmp_path):
+    frames = _src_frames(4, 16, 16)
+    golden = b"".join(np.clip(f, 64, 192).tobytes() for f in frames)
+    _run_golden(
+        tmp_path,
+        "videotestsrc num-buffers=4 width=16 height=16 pattern=gradient ! "
+        "tensor_converter ! tensor_transform mode=clamp option=64:192 ! "
+        "filesink location={out}",
+        golden)
+
+
+def test_golden_mux_two_sources(tmp_path):
+    """Two lock-stepped sources mux into one 2-tensor frame; filesink dumps
+    both memories per frame (reference tensor_mux SSAT group)."""
+    a = _src_frames(5, 8, 8, "gradient")
+    b = _src_frames(5, 8, 8, "black")
+    golden = b"".join(x.tobytes() + y.tobytes() for x, y in zip(a, b))
+    _run_golden(
+        tmp_path,
+        "tensor_mux name=m sync-mode=nosync ! filesink location={out} "
+        "videotestsrc num-buffers=5 width=8 height=8 pattern=gradient ! "
+        "tensor_converter ! m. "
+        "videotestsrc num-buffers=5 width=8 height=8 pattern=black ! "
+        "tensor_converter ! m.",
+        golden)
+
+
+def test_golden_aggregator(tmp_path):
+    """frames-in=1 frames-out=4 along the frame dim (nnstreamer dim 3 =
+    numpy axis 0 for video): every output concatenates 4 inputs
+    (reference tensor_aggregator SSAT group)."""
+    frames = _src_frames(8, 8, 8)
+    golden = b"".join(
+        np.concatenate(frames[i:i + 4], axis=0).tobytes() for i in (0, 4))
+    _run_golden(
+        tmp_path,
+        "videotestsrc num-buffers=8 width=8 height=8 pattern=gradient ! "
+        "tensor_converter ! tensor_aggregator frames-in=1 frames-out=4 "
+        "frames-flush=4 frames-dim=3 concat=true ! filesink location={out}",
+        golden)
+
+
+def test_golden_sparse_roundtrip(tmp_path):
+    """dense → sparse_enc → sparse_dec → identical bytes (reference
+    tensor_sparse SSAT group)."""
+    frames = _src_frames(4, 8, 8, "ball")  # mostly-zero pattern
+    golden = b"".join(f.tobytes() for f in frames)
+    _run_golden(
+        tmp_path,
+        "videotestsrc num-buffers=4 width=8 height=8 pattern=ball ! "
+        "tensor_converter ! tensor_sparse_enc ! tensor_sparse_dec ! "
+        "filesink location={out}",
+        golden)
+
+
+def test_golden_demux_pick(tmp_path):
+    """mux two sources then demux-pick the second back out."""
+    b = _src_frames(5, 8, 8, "black")
+    golden = b"".join(y.tobytes() for y in b)
+    _run_golden(
+        tmp_path,
+        "tensor_mux name=m sync-mode=nosync ! tensor_demux tensorpick=1 ! "
+        "filesink location={out} "
+        "videotestsrc num-buffers=5 width=8 height=8 pattern=gradient ! "
+        "tensor_converter ! m. "
+        "videotestsrc num-buffers=5 width=8 height=8 pattern=black ! "
+        "tensor_converter ! m.",
+        golden)
+
+
+def test_golden_filter_custom_easy(tmp_path):
+    """Inference in the SSAT loop: deterministic fake backend (the
+    reference's custom_example_scaler pattern)."""
+    from nnstreamer_tpu.filters import register_custom_easy
+    from nnstreamer_tpu.tensors.types import TensorsInfo
+
+    info = TensorsInfo.from_str("3:16:16:1", "uint8")
+    register_custom_easy(
+        "golden_half", lambda ins: [(np.asarray(ins[0]) // 2).astype(
+            np.uint8)], info, info)
+    frames = _src_frames(5, 16, 16)
+    golden = b"".join((f // 2).astype(np.uint8).tobytes() for f in frames)
+    _run_golden(
+        tmp_path,
+        "videotestsrc num-buffers=5 width=16 height=16 pattern=gradient ! "
+        "tensor_converter ! "
+        "tensor_filter framework=custom-easy model=golden_half ! "
+        "filesink location={out}",
+        golden)
+
+
+def test_golden_multifilesrc_roundtrip(tmp_path):
+    """filesrc-family ingest: raw frame files → tensors → filesink dump
+    equals the concatenated inputs (reference multifilesrc SSAT groups)."""
+    rng = np.random.default_rng(7)
+    frames = [rng.integers(0, 255, (8, 8, 3), dtype=np.uint8)
+              for _ in range(3)]
+    for i, f in enumerate(frames):
+        (tmp_path / f"img_{i:03d}.raw").write_bytes(f.tobytes())
+    golden = b"".join(f.tobytes() for f in frames)
+    _run_golden(
+        tmp_path,
+        f"multifilesrc location={tmp_path}/img_%03d.raw ! "
+        "tensor_converter input-dim=3:8:8:1 input-type=uint8 ! "
+        "filesink location={out}",
+        golden)
+
+
+def test_golden_clamp_out_of_range_bounds(tmp_path):
+    """Bounds outside the dtype's range saturate instead of overflowing
+    (option=-1:300 on uint8 ≡ 0:255 — reference typed-math semantics)."""
+    frames = _src_frames(2, 8, 8)
+    golden = b"".join(f.tobytes() for f in frames)  # no-op clamp
+    _run_golden(
+        tmp_path,
+        "videotestsrc num-buffers=2 width=8 height=8 pattern=gradient ! "
+        "tensor_converter ! tensor_transform mode=clamp option=-1:300 ! "
+        "filesink location={out}",
+        golden)
