@@ -24,7 +24,10 @@ import time
 
 import numpy as np
 
-N_FRAMES = int(os.environ.get("BENCH_FRAMES", "200"))
+#: 400 frames ≈ six deep-prefetch flush cycles in steady state — enough to
+#: average the tunnel's bursty flush cadence; 200 left only ~3 cycles and
+#: quantization noise dominated run-to-run spread
+N_FRAMES = int(os.environ.get("BENCH_FRAMES", "400"))
 WARMUP = int(os.environ.get("BENCH_WARMUP", "10"))
 #: tunnel throughput varies heavily run-to-run; the flagship reports the
 #: median of this many runs (first run also pays the compile)

@@ -116,8 +116,12 @@ class _TransformSpec:
             dt = np.dtype(x.dtype)
             if dt.kind in "iu":
                 info = np.iinfo(dt)
-                lo = int(np.clip(lo, info.min, info.max))
-                hi = int(np.clip(hi, info.min, info.max))
+                # exact integer arithmetic: float64 rounding of iinfo.max
+                # (int64/uint64) would overflow the cast below
+                lo = info.min if lo <= info.min else \
+                    min(int(lo), info.max)
+                hi = info.max if hi >= info.max else \
+                    max(int(hi), info.min)
             return xp.clip(x, xp.asarray(lo, dtype=x.dtype),
                            xp.asarray(hi, dtype=x.dtype))
         raise ValueError(f"unknown transform mode {mode!r}")

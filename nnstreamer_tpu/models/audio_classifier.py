@@ -1,0 +1,74 @@
+"""1-D conv audio classifier — the audio model family.
+
+The reference streams audio through the same tensor pipeline as video
+(`tensor_converter` chunks S16LE/F32LE samples, `tensor_aggregator`
+windows them — gst/nnstreamer/tensor_converter audio path,
+`tensor_aggregator/README.md`); its test suites use trivial custom
+filters on audio caps. This gives the audio path a REAL model: a compact
+keyword-spotting-style network (conv1d stack → global pool → dense),
+MXU-friendly (channels stay multiples of 8, all matmul/conv work in
+bfloat16 under jit).
+
+Pipeline shape:
+  audiotestsrc ! tensor_converter frames-per-tensor=16000 !
+  tensor_transform mode=arithmetic option=typecast:float32,div:32768 !
+  tensor_filter framework=jax model=kws ! tensor_decoder mode=image_labeling
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from nnstreamer_tpu.models._init import fast_init
+from nnstreamer_tpu.tensors.types import TensorsInfo
+
+
+class AudioClassifier(nn.Module):
+    """Conv1D keyword-spotting classifier over a mono window."""
+
+    num_classes: int = 12
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        # x: [batch, samples, channels]
+        h = x.astype(self.dtype)
+        for i, stride in enumerate((4, 4, 2, 2)):
+            h = nn.Conv(self.width * (1 + i // 2), kernel_size=(9,),
+                        strides=(stride,), dtype=self.dtype)(h)
+            h = nn.relu(h)
+        h = h.mean(axis=1)  # global average pool over time
+        h = nn.Dense(self.width * 2, dtype=self.dtype)(h)
+        h = nn.relu(h)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(h)
+
+
+def audio_classifier(samples: int = 16000, channels: int = 1,
+                     num_classes: int = 12, batch: int = 1,
+                     dtype=jnp.bfloat16, seed: int = 0
+                     ) -> Tuple[Callable, Any, TensorsInfo, TensorsInfo]:
+    """(apply_fn, params, in_info, out_info) for the jax filter backend.
+
+    in_info matches the converter's audio layout (samples × channels per
+    frame); out_info is the class-logit vector the image_labeling decoder
+    consumes (argmax → label, same contract as vision classifiers).
+    """
+    model = AudioClassifier(num_classes=num_classes, dtype=dtype)
+
+    def apply_fn(params, x):
+        if x.ndim == 2:  # converter emits [samples, ch]; add batch
+            x = x[None]
+        return model.apply(params, x.astype(jnp.float32))
+
+    rng = jax.random.PRNGKey(seed)
+    params = fast_init(model.init, rng,
+                       jnp.zeros((batch, samples, channels), jnp.float32),
+                       seed=seed)
+    in_info = TensorsInfo.from_str(f"{channels}:{samples}", "float32")
+    out_info = TensorsInfo.from_str(f"{num_classes}:1", "float32")
+    return apply_fn, params, in_info, out_info

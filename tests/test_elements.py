@@ -247,3 +247,65 @@ class TestDecoder:
         caps = out.sinkpad.caps
         assert caps.name == "video/x-raw"
         assert caps["width"] == 16 and caps["height"] == 8
+
+
+class TestAudioModelPipeline:
+    """End-to-end audio inference: the audio stream path gets a real model
+    (models/audio_classifier), not just a custom-filter stand-in — same
+    converter/window/filter/decoder contract the vision pipelines use."""
+
+    def test_audio_classifier_pipeline(self):
+        from nnstreamer_tpu.filters.jax_backend import (
+            register_jax_model, unregister_jax_model)
+        from nnstreamer_tpu.models.audio_classifier import audio_classifier
+
+        samples = 1600  # small window keeps CPU-XLA compile snappy
+        apply_fn, params, in_info, out_info = audio_classifier(
+            samples=samples, num_classes=4)
+        register_jax_model("kws_test", apply_fn, params,
+                           in_info=in_info, out_info=out_info)
+        try:
+            pipe = run_pipeline(
+                f"audiotestsrc num-buffers=3 samplesperbuffer={samples} ! "
+                f"tensor_converter frames-per-tensor={samples} ! "
+                "tensor_transform mode=arithmetic "
+                "option=typecast:float32,div:32768 ! "
+                "tensor_filter framework=jax model=kws_test ! "
+                "tensor_decoder mode=image_labeling ! "
+                "tensor_sink name=out to-host=true", timeout=120)
+            outs = pipe.get("out").buffers
+            assert len(outs) == 3
+            for b in outs:
+                # decoder output is the label text (utf8) + index in meta
+                assert 0 <= int(b.meta["label_index"]) < 4
+                text = np.asarray(b[0]).tobytes().decode("utf-8")
+                assert text == str(b.meta["label_index"])
+        finally:
+            unregister_jax_model("kws_test")
+
+    def test_audio_windowed_aggregation(self):
+        """aggregator windows small audio chunks into the model's frame
+        size (the reference's aggregator-before-filter audio pattern)."""
+        from nnstreamer_tpu.filters.jax_backend import (
+            register_jax_model, unregister_jax_model)
+        from nnstreamer_tpu.models.audio_classifier import audio_classifier
+
+        apply_fn, params, in_info, out_info = audio_classifier(
+            samples=800, num_classes=3)
+        register_jax_model("kws_win", apply_fn, params,
+                           in_info=in_info, out_info=out_info)
+        try:
+            pipe = run_pipeline(
+                "audiotestsrc num-buffers=8 samplesperbuffer=200 ! "
+                "tensor_converter ! "
+                "tensor_aggregator frames-in=200 frames-out=800 "
+                "frames-dim=1 concat=true ! "
+                "tensor_transform mode=arithmetic "
+                "option=typecast:float32,div:32768 ! "
+                "tensor_filter framework=jax model=kws_win ! "
+                "tensor_sink name=out to-host=true", timeout=120)
+            outs = pipe.get("out").buffers
+            assert len(outs) == 2  # 8 × 200 samples → 2 × 800 windows
+            assert np.asarray(outs[0][0]).reshape(-1).shape == (3,)
+        finally:
+            unregister_jax_model("kws_win")
