@@ -25,6 +25,7 @@ from nnstreamer_tpu.elements import cond  # noqa: F401
 from nnstreamer_tpu.elements import crop  # noqa: F401
 from nnstreamer_tpu.elements import repo  # noqa: F401
 from nnstreamer_tpu.elements import sparse  # noqa: F401
+from nnstreamer_tpu.elements import quant  # noqa: F401
 from nnstreamer_tpu.elements import query  # noqa: F401
 from nnstreamer_tpu.elements import pubsub  # noqa: F401
 

@@ -1,0 +1,90 @@
+"""tensor_quant_enc / tensor_quant_dec — int8 stream transcoding.
+
+The dense-activation peer of the sparse pair: where ``tensor_sparse_enc``
+saves bandwidth on mostly-zero tensors (reference
+``gsttensorsparseenc.c``), this pair ships DENSE float tensors as
+per-tensor absmax int8 (+ float32 scale) — 4× fewer bytes over
+query/pubsub/gRPC transports, with ``ops/quantize.py`` providing the
+device-side kernels when the payload is still in HBM.
+
+Wire layout per tensor: TensorMetaInfo header carrying the ORIGINAL
+dtype/dims (format=flexible), then float32 scale, then int8[num_elements].
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from nnstreamer_tpu.pipeline.element import Element
+from nnstreamer_tpu.registry import ELEMENT, subplugin
+from nnstreamer_tpu.tensors.meta import HEADER_SIZE, TensorMetaInfo
+from nnstreamer_tpu.tensors.types import (
+    TensorFormat,
+    TensorInfo,
+    TensorsConfig,
+)
+
+
+def quant_encode(arr: np.ndarray) -> bytes:
+    arr = np.ascontiguousarray(np.asarray(arr))
+    xf = arr.astype(np.float32)
+    scale = float(np.max(np.abs(xf))) / 127.0 if arr.size else 0.0
+    scale = max(scale, 1e-30)
+    q = np.clip(np.round(xf / scale), -127, 127).astype(np.int8)
+    meta = TensorMetaInfo.from_info(
+        TensorInfo.from_array(arr), format=TensorFormat.FLEXIBLE)
+    return meta.pack() + np.float32(scale).tobytes() + q.tobytes()
+
+
+def quant_decode(blob: bytes, offset: int = 0):
+    meta = TensorMetaInfo.unpack(blob[offset:offset + HEADER_SIZE])
+    info = meta.to_info()
+    p = offset + HEADER_SIZE
+    scale = np.frombuffer(blob[p:p + 4], np.float32)[0]
+    p += 4
+    q = np.frombuffer(blob[p:p + info.num_elements], np.int8)
+    p += info.num_elements
+    xf = q.astype(np.float32) * scale
+    return xf.astype(info.type.np_dtype).reshape(info.shape), p
+
+
+@subplugin(ELEMENT, "tensor_quant_enc")
+class TensorQuantEnc(Element):
+    ELEMENT_NAME = "tensor_quant_enc"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+
+    def transform_caps(self, pad, caps):
+        return TensorsConfig(format=TensorFormat.FLEXIBLE).to_caps()
+
+    def chain(self, pad, buf):
+        host = buf.to_host()  # applies any deferred finalize exactly once
+        blobs = [np.frombuffer(quant_encode(t), np.uint8)
+                 for t in host.tensors]
+        return self.srcpad.push(host.with_tensors(blobs))
+
+
+@subplugin(ELEMENT, "tensor_quant_dec")
+class TensorQuantDec(Element):
+    ELEMENT_NAME = "tensor_quant_dec"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+
+    def transform_caps(self, pad, caps):
+        return None  # static caps derive from the first decoded frame
+
+    def chain(self, pad, buf):
+        host = buf.to_host()
+        outs = []
+        for t in host.tensors:
+            dense, _ = quant_decode(np.ascontiguousarray(t).tobytes())
+            outs.append(dense)
+        if self.srcpad.caps is None:
+            self.srcpad.set_caps(TensorsConfig.from_arrays(outs).to_caps())
+        return self.srcpad.push(host.with_tensors(outs))

@@ -66,9 +66,10 @@ class TensorSparseEnc(Element):
         return TensorsConfig(format=TensorFormat.SPARSE).to_caps()
 
     def chain(self, pad, buf):
+        host = buf.to_host()  # applies any deferred finalize exactly once
         blobs = [np.frombuffer(sparse_encode(t), np.uint8)
-                 for t in buf.to_host().tensors]
-        return self.srcpad.push(buf.with_tensors(blobs))
+                 for t in host.tensors]
+        return self.srcpad.push(host.with_tensors(blobs))
 
 
 @subplugin(ELEMENT, "tensor_sparse_dec")
@@ -84,10 +85,11 @@ class TensorSparseDec(Element):
         return None  # static caps derive from the first decoded frame
 
     def chain(self, pad, buf):
+        host = buf.to_host()
         outs = []
-        for t in buf.to_host().tensors:
+        for t in host.tensors:
             dense, _ = sparse_decode(np.ascontiguousarray(t).tobytes())
             outs.append(dense)
         if self.srcpad.caps is None:
             self.srcpad.set_caps(TensorsConfig.from_arrays(outs).to_caps())
-        return self.srcpad.push(buf.with_tensors(outs))
+        return self.srcpad.push(host.with_tensors(outs))

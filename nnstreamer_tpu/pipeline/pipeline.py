@@ -113,7 +113,7 @@ class Queue(Element):
     ELEMENT_NAME = "queue"
     HANDLES_DEFERRED = True  # pure hand-off: finalize stays lazy across it
     PROPERTIES = {**Element.PROPERTIES, "max_size_buffers": 16, "leaky": "no",
-                  "prefetch_host": False}
+                  "prefetch_host": False, "prefetch_device": False}
 
     _EOS = object()
 
@@ -156,6 +156,13 @@ class Queue(Element):
                 start_async = getattr(t, "copy_to_host_async", None)
                 if start_async is not None:
                     start_async()
+        if self.get_property("prefetch_device") and not buf.on_device():
+            # mirror image of prefetch_host: start H2D for host tensors NOW
+            # so the downstream jitted consumer dispatches against device
+            # arrays (transfer overlaps the previous frame's compute; on a
+            # tunneled chip the per-call transfer RPC otherwise serializes
+            # into every dispatch)
+            buf = buf.to_device()
         if self._worker is None:  # not started: degenerate passthrough
             return self.srcpad.push(buf)
         if self.get_property("leaky") == "downstream":

@@ -154,3 +154,28 @@ class TestPipeline:
         src = _NumSrc()
         src.set_property("num_buffers", "12")
         assert src.get_property("num_buffers") == 12
+
+
+def test_queue_prefetch_device_hands_off_device_arrays():
+    """prefetch-device starts H2D at enqueue: the consumer side of the
+    queue sees jax Arrays, so a downstream jitted call dispatches without
+    paying a per-frame transfer RPC."""
+    import jax
+    import numpy as np
+
+    from nnstreamer_tpu import parse_launch
+
+    pipe = parse_launch(
+        "appsrc name=src ! queue prefetch-device=true ! tensor_sink "
+        "name=out to-host=false")
+    seen = []
+    pipe.get("out").connect(lambda b: seen.append(b))
+    pipe.start()
+    pipe.get("src").push([np.arange(6, dtype=np.float32)], pts=0)
+    pipe.get("src").end_of_stream()
+    msg = pipe.wait(timeout=30)
+    pipe.stop()
+    assert msg is not None and msg.kind == "eos"
+    assert isinstance(seen[0][0], jax.Array)
+    np.testing.assert_array_equal(np.asarray(seen[0][0]),
+                                  np.arange(6, dtype=np.float32))

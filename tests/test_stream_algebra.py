@@ -277,3 +277,142 @@ class TestCrop:
         assert out[0].shape == (5, 4, 3)
         assert out[1].shape == (8, 8, 3)
         np.testing.assert_array_equal(out[1], img[0, :8, :8])
+
+
+class TestRepoDynamicity:
+    """Runtime slot switching (reference nnstreamer_repo_dynamicity:
+    tensor_repo_dynamic_test.c flips reposink's slot mid-stream)."""
+
+    def test_switch_slot_mid_stream(self):
+        from nnstreamer_tpu.elements.repo import GLOBAL_REPO, TensorRepoSink
+
+        sink = TensorRepoSink(slot="dyn_a")
+        from nnstreamer_tpu.elements.source import AppSrc
+
+        pipe = Pipeline()
+        src = AppSrc(name="src")
+        pipe.add(src, sink)
+        src.link(sink)
+        pipe.start()
+        try:
+            src.push([np.full(4, 1.0, np.float32)], pts=0)
+            # AppSrc delivers on its own thread — wait until frame 0 has
+            # landed before switching (the reference flips the property
+            # from a pad probe, i.e. also after delivery)
+            assert GLOBAL_REPO.get("dyn_a", timeout=10) is not None
+            sink.set_property("slot", "dyn_b")  # runtime switch
+            src.push([np.full(4, 2.0, np.float32)], pts=1)
+            src.end_of_stream()
+            pipe.wait(timeout=30)
+            a = GLOBAL_REPO.get("dyn_a", timeout=5, consume=True)
+            b = GLOBAL_REPO.get("dyn_b", timeout=5, consume=True)
+            np.testing.assert_array_equal(np.asarray(a[0]),
+                                          np.full(4, 1.0, np.float32))
+            np.testing.assert_array_equal(np.asarray(b[0]),
+                                          np.full(4, 2.0, np.float32))
+        finally:
+            pipe.stop()
+
+
+class TestQuantEncDec:
+    """int8 stream transcoding — the dense-activation peer of sparse
+    enc/dec (elements/quant.py; device kernels in ops/quantize.py)."""
+
+    def test_roundtrip_accuracy_and_size(self):
+        rng = np.random.default_rng(11)
+        x = rng.normal(0, 1, (64, 32)).astype(np.float32)
+        from nnstreamer_tpu.elements.quant import quant_decode, quant_encode
+
+        blob = quant_encode(x)
+        assert len(blob) < x.nbytes / 2  # ~4x smaller than float32
+        back, _ = quant_decode(blob)
+        assert back.shape == x.shape and back.dtype == x.dtype
+        # absmax int8: error bounded by scale/2
+        scale = np.abs(x).max() / 127.0
+        assert np.abs(back - x).max() <= scale * 0.5 + 1e-6
+
+    def test_pipeline_roundtrip(self):
+        pipe = run_pipeline(
+            "videotestsrc num-buffers=3 width=8 height=8 pattern=gradient ! "
+            "tensor_converter ! tensor_transform mode=arithmetic "
+            "option=typecast:float32,div:255 ! "
+            "tensor_quant_enc ! tensor_quant_dec ! tensor_sink name=out")
+        ref = run_pipeline(
+            "videotestsrc num-buffers=3 width=8 height=8 pattern=gradient ! "
+            "tensor_converter ! tensor_transform mode=arithmetic "
+            "option=typecast:float32,div:255 ! tensor_sink name=out")
+        outs = pipe.get("out").buffers
+        refs = ref.get("out").buffers
+        assert len(outs) == len(refs) == 3
+        for o, r in zip(outs, refs):
+            a, b = np.asarray(o[0]), np.asarray(r[0])
+            assert a.shape == b.shape
+            assert np.abs(a - b).max() <= (np.abs(b).max() / 127.0) * 0.5 + 1e-6
+
+    def test_offload_with_quant_transport(self):
+        """query offload with int8-compressed payloads: enc on the client,
+        dec server-side before the filter."""
+        from nnstreamer_tpu.filters import register_custom_easy
+        from nnstreamer_tpu.tensors.types import TensorsInfo
+
+        info = TensorsInfo.from_str("4", "float32")
+        register_custom_easy("qpass", lambda ins: [np.asarray(ins[0]) + 1.0],
+                             info, info)
+        server = parse_launch(
+            "tensor_query_serversrc name=ss port=0 id=41 ! tensor_quant_dec ! "
+            "tensor_filter framework=custom-easy model=qpass ! "
+            "tensor_query_serversink id=41")
+        server.start()
+        try:
+            port = server.get("ss").port
+            from nnstreamer_tpu.elements.sink import TensorSink
+            from nnstreamer_tpu.elements.source import AppSrc
+
+            client = parse_launch(
+                f"tensor_quant_enc name=enc ! tensor_query_client "
+                f"dest-host=127.0.0.1 dest-port={port}")
+            src, sink = AppSrc(name="src"), TensorSink(name="out")
+            client.add(src, sink)
+            src.link(client.get("enc"))
+            qc = [e for e in client.elements
+                  if e.ELEMENT_NAME == "tensor_query_client"][0]
+            qc.link(sink)
+            client.start()
+            src.push([np.array([1.0, -2.0, 3.0, 0.5], np.float32)], pts=0)
+            src.end_of_stream()
+            msg = client.wait(timeout=60)
+            assert msg is not None and msg.kind == "eos", msg
+            out = np.asarray(sink.buffers[0][0])
+            np.testing.assert_allclose(
+                out, [2.0, -1.0, 4.0, 1.5], atol=3 / 127.0)
+        finally:
+            client.stop()
+            server.stop()
+
+    def test_enc_consumes_deferred_finalize_once(self):
+        """A buffer carrying a deferred finalize (fused-decoder output)
+        must have it applied exactly once by the transcoder, never leaked
+        downstream (code-review regression)."""
+        from nnstreamer_tpu.elements.quant import TensorQuantEnc
+        from nnstreamer_tpu.elements.sparse import TensorSparseEnc
+        from nnstreamer_tpu.tensors.buffer import TensorBuffer
+
+        calls = []
+
+        def finalize(host_buf):
+            calls.append(1)
+            return host_buf.with_tensors(
+                [np.asarray(host_buf[0]) * 2.0])
+
+        for enc_cls in (TensorQuantEnc, TensorSparseEnc):
+            calls.clear()
+            enc = enc_cls()
+            got = []
+            enc.srcpad.push = lambda b: got.append(b)  # capture output
+            buf = TensorBuffer([np.ones(4, np.float32)], pts=0,
+                               finalize=finalize)
+            enc.chain(enc.sinkpads[0], buf)
+            assert calls == [1], enc_cls.__name__
+            assert got[0].finalize is None  # not leaked downstream
+            got[0].to_host()
+            assert calls == [1]  # still once
