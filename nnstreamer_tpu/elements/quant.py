@@ -8,7 +8,8 @@ query/pubsub/gRPC transports, with ``ops/quantize.py`` providing the
 device-side kernels when the payload is still in HBM.
 
 Wire layout per tensor: TensorMetaInfo header carrying the ORIGINAL
-dtype/dims (format=flexible), then float32 scale, then int8[num_elements].
+dtype/dims (format=flexible), then u32 magic 'NQT1' (discriminates quant
+blobs from other flexible payloads), float32 scale, int8[num_elements].
 """
 
 from __future__ import annotations
@@ -25,6 +26,10 @@ from nnstreamer_tpu.tensors.types import (
 )
 
 
+#: discriminates quant blobs from other flexible-format payloads
+_QUANT_MAGIC = b"NQT1"
+
+
 def quant_encode(arr: np.ndarray) -> bytes:
     arr = np.ascontiguousarray(np.asarray(arr))
     xf = arr.astype(np.float32)
@@ -33,19 +38,30 @@ def quant_encode(arr: np.ndarray) -> bytes:
     q = np.clip(np.round(xf / scale), -127, 127).astype(np.int8)
     meta = TensorMetaInfo.from_info(
         TensorInfo.from_array(arr), format=TensorFormat.FLEXIBLE)
-    return meta.pack() + np.float32(scale).tobytes() + q.tobytes()
+    return meta.pack() + _QUANT_MAGIC + np.float32(scale).tobytes() \
+        + q.tobytes()
 
 
 def quant_decode(blob: bytes, offset: int = 0):
     meta = TensorMetaInfo.unpack(blob[offset:offset + HEADER_SIZE])
     info = meta.to_info()
     p = offset + HEADER_SIZE
+    if blob[p:p + 4] != _QUANT_MAGIC:
+        raise ValueError("quant_decode: not a quant payload (bad magic)")
+    p += 4
+    need = p + 4 + info.num_elements
+    if len(blob) < need:
+        raise ValueError(
+            f"quant_decode: truncated payload ({len(blob)} < {need} bytes)")
     scale = np.frombuffer(blob[p:p + 4], np.float32)[0]
     p += 4
     q = np.frombuffer(blob[p:p + info.num_elements], np.int8)
     p += info.num_elements
     xf = q.astype(np.float32) * scale
-    return xf.astype(info.type.np_dtype).reshape(info.shape), p
+    dt = info.type.np_dtype
+    if np.dtype(dt).kind in "iu":
+        xf = np.rint(xf)  # nearest, not truncate-toward-zero
+    return xf.astype(dt).reshape(info.shape), p
 
 
 @subplugin(ELEMENT, "tensor_quant_enc")

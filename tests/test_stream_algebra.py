@@ -416,3 +416,26 @@ class TestQuantEncDec:
             assert got[0].finalize is None  # not leaked downstream
             got[0].to_host()
             assert calls == [1]  # still once
+
+    def test_decode_rejects_non_quant_payload(self):
+        """Mis-wired streams (sparse blob, random bytes, truncation) must
+        raise a protocol error, not emit garbage (code-review regression)."""
+        from nnstreamer_tpu.elements.quant import quant_decode, quant_encode
+        from nnstreamer_tpu.elements.sparse import sparse_encode
+
+        with pytest.raises(ValueError, match="magic"):
+            quant_decode(sparse_encode(np.zeros((4, 4), np.float32)))
+        blob = quant_encode(np.ones((8,), np.float32))
+        with pytest.raises(ValueError, match="truncated"):
+            quant_decode(blob[:-3])
+
+    def test_integer_roundtrip_rounds_to_nearest(self):
+        from nnstreamer_tpu.elements.quant import quant_decode, quant_encode
+
+        x = np.arange(0, 256, 1, dtype=np.uint8)
+        back, _ = quant_decode(quant_encode(x))
+        assert back.dtype == np.uint8
+        scale = 255.0 / 127.0
+        # nearest-rounding: error bounded by scale/2 + 0.5 cast rounding
+        assert np.abs(back.astype(int) - x.astype(int)).max() <= \
+            int(np.ceil(scale / 2 + 0.5))
