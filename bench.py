@@ -327,6 +327,45 @@ def measure_batch4() -> dict:
                 frames=len(frame_t) * 4)
 
 
+def measure_decode() -> dict:
+    """LM token streaming: KV-cached transformer decode through the
+    tensor_repo loop (examples/llm_stream.py topology). The cache lives in
+    HBM as loop state; only token ids circulate host-side. Metric:
+    sustained decode steps (tokens) per second."""
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu import parse_launch
+    from nnstreamer_tpu.elements.repo import GLOBAL_REPO
+    from nnstreamer_tpu.filters.jax_backend import register_jax_model
+    from nnstreamer_tpu.models.transformer import (
+        TransformerConfig,
+        build_greedy_stream_step,
+        init_cache,
+        init_params,
+    )
+    from nnstreamer_tpu.tensors.buffer import TensorBuffer
+
+    cfg = TransformerConfig(vocab=32000, d_model=512, n_heads=8,
+                            n_layers=8, d_ff=2048, max_seq=1024,
+                            dtype=jnp.bfloat16)
+    params = init_params(cfg)
+    register_jax_model("lm_decode_bench", build_greedy_stream_step(cfg),
+                       params)
+    n = min(N_FRAMES, 1000)
+    GLOBAL_REPO.set("lm_bench", TensorBuffer(
+        [np.asarray([1], np.int32),
+         np.asarray(init_cache(cfg, batch=1)),
+         np.asarray(0, np.int32)], pts=0))
+    pipe = parse_launch(
+        f"tensor_reposrc slot=lm_bench num-buffers={n} timeout=120 ! "
+        "tensor_filter framework=jax model=lm_decode_bench name=filter ! "
+        "tee name=t  t. ! tensor_reposink slot=lm_bench  "
+        "t. ! tensor_sink name=sink to-host=false")
+    frame_t = _collect(pipe)
+    return dict(metric="lm_decode_tokens_per_s_d512_l8_kv1024",
+                fps=_steady_fps(frame_t), frames=len(frame_t))
+
+
 EXTRA_CONFIGS = {
     "ssd": measure_ssd,
     "pose4": measure_pose_mux,
@@ -334,6 +373,7 @@ EXTRA_CONFIGS = {
     "lstm": measure_lstm,
     "attn": measure_attention,
     "batch4": measure_batch4,
+    "decode": measure_decode,
 }
 
 

@@ -1,0 +1,56 @@
+"""Autoregressive LM token streaming through a tensor_repo loop.
+
+The LSTM recurrence pattern (recurrence.py) scaled to transformer decode:
+the KV cache is DEVICE-RESIDENT state circulating through a repo slot as
+jax.Array handles — each pipeline iteration is one cached decode step
+(models/transformer.build_decode_step), and only the sampled token ids
+ever reach the host. The reference's tensor_repo enables exactly this
+loop topology (tests/nnstreamer_repo_lstm); the KV-cache-in-HBM part is
+what TPU adds.
+
+Run: PYTHONPATH=.. python llm_stream.py   (CPU XLA works; TPU if available)
+"""
+
+from nnstreamer_tpu.utils.platform import ensure_jax_platform
+
+ensure_jax_platform()  # fall back to CPU if the preset backend is unusable
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import nnstreamer_tpu as nt  # noqa: E402
+from nnstreamer_tpu.elements.repo import GLOBAL_REPO  # noqa: E402
+from nnstreamer_tpu.filters.jax_backend import register_jax_model  # noqa: E402
+from nnstreamer_tpu.models.transformer import (  # noqa: E402
+    TransformerConfig,
+    build_greedy_stream_step,
+    init_cache,
+    init_params,
+)
+from nnstreamer_tpu.tensors.buffer import TensorBuffer  # noqa: E402
+
+N_TOKENS = 16
+cfg = TransformerConfig(vocab=256, d_model=64, n_heads=4, n_layers=2,
+                        d_ff=128, max_seq=64, dtype=jnp.float32)
+params = init_params(cfg)
+register_jax_model("lm_decode", build_greedy_stream_step(cfg), params)
+
+# seed the loop: (token, kv-cache, position) as one multi-tensor state
+GLOBAL_REPO.set("lm", TensorBuffer(
+    [np.asarray([1], np.int32),
+     np.asarray(init_cache(cfg, batch=1)),
+     np.asarray(0, np.int32)], pts=0))
+
+pipe = nt.parse_launch(
+    f"tensor_reposrc slot=lm num-buffers={N_TOKENS} timeout=30 ! "
+    "tensor_filter framework=jax model=lm_decode name=f ! "
+    "tee name=t  t. ! tensor_reposink slot=lm  "
+    "t. ! tensor_sink name=out to-host=false")
+
+tokens = []
+pipe.get("out").connect(
+    lambda b: tokens.append(int(np.asarray(b[0]).reshape(-1)[0])))
+msg = pipe.run(timeout=300)
+assert msg is not None and msg.kind == "eos", msg
+print(f"streamed {len(tokens)} tokens: {tokens}")
+print(f"decode-step latency: {pipe.get('f').get_property('latency')} µs")

@@ -101,6 +101,27 @@ def _dense_ffn(x, w_in, w_out, dtype):
     return jnp.einsum("bsf,fd->bsd", h, w_out.astype(dtype))
 
 
+def _block_qkv(x, lp, positions, dtype):
+    """Pre-norm + qkv projection + rope — shared by the full forward's
+    layer body and the KV-cached decode body."""
+    h = _rmsnorm(x, lp["ln1"])
+    qkv = jnp.einsum("bsd,dthc->btshc", h, lp["qkv"].astype(dtype))
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]            # [b,s,h,dh]
+    return _rope(q, positions), _rope(k, positions), v
+
+
+def _block_tail(x, a, lp, cfg):
+    """Attention-output projection + residual + FFN block — shared by the
+    full forward's layer body and the KV-cached decode body."""
+    dtype = cfg.dtype
+    x = x + jnp.einsum("bshc,hcd->bsd", a, lp["proj"].astype(dtype))
+    h2 = _rmsnorm(x, lp["ln2"])
+    if cfg.num_experts:
+        return x + _moe_ffn(h2, lp["router"], lp["w_in"], lp["w_out"],
+                            dtype)
+    return x + _dense_ffn(h2, lp["w_in"], lp["w_out"], dtype)
+
+
 def _moe_ffn(x, router, w_in, w_out, dtype):
     """Top-1 routed MoE: expert axis shards over mesh axis ``ep`` (the
     one-hot dispatch einsum lets GSPMD all-to-all tokens to experts)."""
@@ -130,17 +151,9 @@ def make_layer_body(cfg: TransformerConfig,
 
     def layer_body(x_and_pos, lp):
         x, positions = x_and_pos
-        h = _rmsnorm(x, lp["ln1"])
-        qkv = jnp.einsum("bsd,dthc->btshc", h, lp["qkv"].astype(dtype))
-        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]       # [b,s,h,dh]
-        q, k = _rope(q, positions), _rope(k, positions)
+        q, k, v = _block_qkv(x, lp, positions, dtype)
         a = attn(q, k, v)                                # [b,s,h,dh]
-        x = x + jnp.einsum("bshc,hcd->bsd", a, lp["proj"].astype(dtype))
-        h2 = _rmsnorm(x, lp["ln2"])
-        if cfg.num_experts:
-            x = x + _moe_ffn(h2, lp["router"], lp["w_in"], lp["w_out"], dtype)
-        else:
-            x = x + _dense_ffn(h2, lp["w_in"], lp["w_out"], dtype)
+        x = _block_tail(x, a, lp, cfg)
         return (x, positions), None
 
     return layer_body
@@ -174,6 +187,90 @@ def build_forward(cfg: TransformerConfig,
         return logits
 
     return apply_fn
+
+
+def init_cache(cfg: TransformerConfig, batch: int,
+               max_seq: Optional[int] = None):
+    """Device-resident KV cache [L, 2, b, S, h, dh] (k=0, v=1 slots)."""
+    s = max_seq or cfg.max_seq
+    return jnp.zeros((cfg.n_layers, 2, batch, s, cfg.n_heads,
+                      cfg.head_dim), cfg.dtype)
+
+
+def build_decode_step(cfg: TransformerConfig,
+                      max_seq: Optional[int] = None) -> Callable:
+    """Incremental (KV-cached) single-token decode.
+
+    ``step(params, token[int32 b], cache, pos[int32 scalar]) ->
+    (logits[b, vocab], new_cache)`` — one position's q/k/v are computed,
+    k/v written into the cache at ``pos`` (``dynamic_update_slice``), and
+    attention runs against the cached prefix under a ``<= pos`` mask. The
+    cache is a jittable carry: it stays in HBM across steps, the streaming
+    pipeline's tensor_repo loop circulating only array handles (the
+    reference's LSTM repo pattern, tests/nnstreamer_repo_lstm, scaled to
+    autoregressive LM decode). Jit with ``donate_argnums`` on the cache to
+    update it in place.
+
+    Cache-length contract: ``pos`` is clamped to the last cache slot — a
+    step past ``max_seq`` overwrites slot S-1 and attends over the stored
+    prefix (bounded degradation, never an unmasked-garbage read). Callers
+    streaming longer sequences should size the cache accordingly or reset
+    it.
+    """
+    dtype = cfg.dtype
+    s_max = max_seq or cfg.max_seq
+
+    def step(params, token, cache, pos):
+        b = token.shape[0]
+        pos_c = jnp.minimum(pos, s_max - 1)  # see cache-length contract
+        x = params["embed"].astype(dtype)[token][:, None]       # [b,1,d]
+        positions = jnp.full((b, 1), pos, jnp.int32)
+        layer_params = {k: v for k, v in params.items()
+                        if k not in ("embed", "ln_f")}
+
+        def layer(carry, lp_and_cache):
+            x, = carry
+            lp, layer_cache = lp_and_cache                # [2,b,S,h,dh]
+            q, k, v = _block_qkv(x, lp, positions, dtype)  # [b,1,h,dh]
+            new_cache = jax.lax.dynamic_update_slice(
+                layer_cache, jnp.stack([k, v]).astype(layer_cache.dtype),
+                (0, 0, pos_c, 0, 0))
+            ck, cv = new_cache[0], new_cache[1]           # [b,S,h,dh]
+            scores = jnp.einsum("bqhc,bshc->bhqs",
+                                q.astype(jnp.float32),
+                                ck.astype(jnp.float32))
+            scores = scores / np.sqrt(cfg.head_dim)
+            mask = jnp.arange(s_max)[None, None, None, :] <= pos_c
+            scores = jnp.where(mask, scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+            a = jnp.einsum("bhqs,bshc->bqhc", probs, cv)
+            x = _block_tail(x, a, lp, cfg)
+            return (x,), new_cache
+
+        (x,), new_cache = lax.scan(layer, (x,), (layer_params, cache))
+        x = _rmsnorm(x, params["ln_f"])
+        logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
+                            params["embed"])
+        return logits[:, 0], new_cache
+
+    return step
+
+
+def build_greedy_stream_step(cfg: TransformerConfig,
+                             max_seq: Optional[int] = None) -> Callable:
+    """Pipeline-shaped greedy decode step for the tensor_repo loop:
+    ``step(params, token, cache, pos) -> (next_token, cache, pos+1)`` —
+    the state tuple a repo slot circulates (examples/llm_stream.py, bench
+    config ``decode``)."""
+    decode = build_decode_step(cfg, max_seq)
+
+    def step(params, token, cache, pos):
+        logits, cache2 = decode(params, token.reshape(1).astype(jnp.int32),
+                                cache, pos.reshape(()).astype(jnp.int32))
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return nxt, cache2, pos + 1
+
+    return step
 
 
 def transformer_lm(vocab: int = 32000, d_model: int = 512, n_heads: int = 8,

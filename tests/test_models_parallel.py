@@ -318,3 +318,134 @@ class TestMultihost:
         arr = multihost.host_local_to_global(data, mesh, P("dp"))
         assert isinstance(arr, jax.Array)
         np.testing.assert_array_equal(np.asarray(arr), data)
+
+
+class TestIncrementalDecode:
+    """KV-cached decode must match the full forward (models/transformer
+    build_decode_step) — the LM-streaming correctness contract."""
+
+    def _cfg(self, experts=0):
+        from nnstreamer_tpu.models.transformer import TransformerConfig
+        import jax.numpy as jnp
+
+        return TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                                 n_layers=2, d_ff=64, max_seq=16,
+                                 dtype=jnp.float32, num_experts=experts)
+
+    @pytest.mark.parametrize("experts", [0, 2])
+    def test_matches_full_forward(self, experts):
+        import jax
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.models.transformer import (
+            build_decode_step, build_forward, init_cache, init_params)
+
+        cfg = self._cfg(experts)
+        params = init_params(cfg)
+        full = build_forward(cfg)
+        step = jax.jit(build_decode_step(cfg))
+
+        rng = np.random.default_rng(3)
+        tokens = jnp.asarray(rng.integers(0, cfg.vocab, (2, 9)), jnp.int32)
+        ref_logits = full(params, tokens)               # [b, s, vocab]
+
+        cache = init_cache(cfg, batch=2)
+        for t in range(tokens.shape[1]):
+            logits, cache = step(params, tokens[:, t], cache,
+                                 jnp.int32(t))
+            np.testing.assert_allclose(
+                np.asarray(logits), np.asarray(ref_logits[:, t]),
+                rtol=1e-4, atol=1e-4)
+
+    def test_greedy_generation_streams(self):
+        """Greedy decode loop with the cache as a device-resident carry —
+        the autoregressive peer of the LSTM repo recurrence."""
+        import jax
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.models.transformer import (
+            build_decode_step, init_cache, init_params)
+
+        cfg = self._cfg()
+        params = init_params(cfg)
+        step = jax.jit(build_decode_step(cfg), donate_argnums=(2,))
+        cache = init_cache(cfg, batch=1)
+        tok = jnp.asarray([1], jnp.int32)
+        out = []
+        for t in range(8):
+            logits, cache = step(params, tok, cache, jnp.int32(t))
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            out.append(int(tok[0]))
+        assert len(out) == 8
+        assert all(0 <= t < cfg.vocab for t in out)
+
+    def test_repo_loop_pipeline_matches_direct_loop(self):
+        """The tensor_repo streaming pipeline must produce the exact token
+        sequence of a hand-written decode loop (examples/llm_stream.py
+        topology: device-resident KV cache circulating through the slot)."""
+        import jax
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu import parse_launch
+        from nnstreamer_tpu.elements.repo import GLOBAL_REPO
+        from nnstreamer_tpu.filters.jax_backend import (
+            register_jax_model, unregister_jax_model)
+        from nnstreamer_tpu.models.transformer import (
+            build_decode_step, build_greedy_stream_step, init_cache,
+            init_params)
+        from nnstreamer_tpu.tensors.buffer import TensorBuffer
+
+        cfg = self._cfg()
+        params = init_params(cfg)
+
+        # direct loop
+        step_j = jax.jit(build_decode_step(cfg))
+        cache = init_cache(cfg, batch=1)
+        tok = jnp.asarray([3], jnp.int32)
+        want = []
+        for t in range(6):
+            logits, cache = step_j(params, tok, cache, jnp.int32(t))
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            want.append(int(tok[0]))
+
+        # repo-loop pipeline
+        register_jax_model("lm_loop_test", build_greedy_stream_step(cfg),
+                           params)
+        try:
+            GLOBAL_REPO.set("lm_t", TensorBuffer(
+                [np.asarray([3], np.int32),
+                 np.asarray(init_cache(cfg, batch=1)),
+                 np.asarray(0, np.int32)], pts=0))
+            pipe = parse_launch(
+                "tensor_reposrc slot=lm_t num-buffers=6 timeout=30 ! "
+                "tensor_filter framework=jax model=lm_loop_test ! "
+                "tee name=t  t. ! tensor_reposink slot=lm_t  "
+                "t. ! tensor_sink name=out to-host=false")
+            got = []
+            pipe.get("out").connect(
+                lambda b: got.append(int(np.asarray(b[0]).reshape(-1)[0])))
+            msg = pipe.run(timeout=120)
+            assert msg is not None and msg.kind == "eos", msg
+            assert got == want
+        finally:
+            unregister_jax_model("lm_loop_test")
+            GLOBAL_REPO.remove("lm_t")
+
+    def test_decode_past_cache_length_is_bounded(self):
+        """pos beyond max_seq clamps to the last slot (documented
+        contract): logits stay finite, no unmasked-garbage attention."""
+        import jax
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.models.transformer import (
+            build_decode_step, init_cache, init_params)
+
+        cfg = self._cfg()
+        params = init_params(cfg)
+        step = jax.jit(build_decode_step(cfg, max_seq=4))
+        cache = init_cache(cfg, batch=1, max_seq=4)
+        tok = jnp.asarray([2], jnp.int32)
+        for t in range(7):  # 3 steps past the cache length
+            logits, cache = step(params, tok, cache, jnp.int32(t))
+            assert bool(jnp.all(jnp.isfinite(logits)))
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
