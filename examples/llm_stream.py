@@ -35,10 +35,11 @@ cfg = TransformerConfig(vocab=256, d_model=64, n_heads=4, n_layers=2,
 params = init_params(cfg)
 register_jax_model("lm_decode", build_greedy_stream_step(cfg), params)
 
-# seed the loop: (token, kv-cache, position) as one multi-tensor state
+# seed the loop: (token, kv-cache, position) as one multi-tensor state —
+# the cache stays a device-resident jax.Array from the very first frame
 GLOBAL_REPO.set("lm", TensorBuffer(
     [np.asarray([1], np.int32),
-     np.asarray(init_cache(cfg, batch=1)),
+     init_cache(cfg, batch=1),
      np.asarray(0, np.int32)], pts=0))
 
 pipe = nt.parse_launch(

@@ -242,8 +242,12 @@ def build_decode_step(cfg: TransformerConfig,
             scores = scores / np.sqrt(cfg.head_dim)
             mask = jnp.arange(s_max)[None, None, None, :] <= pos_c
             scores = jnp.where(mask, scores, -1e30)
-            probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
-            a = jnp.einsum("bhqs,bshc->bqhc", probs, cv)
+            # fp32 softmax AND fp32 probs×values, rounding only the final
+            # output — bit-matches attention_reference so decode/forward
+            # greedy parity holds in bfloat16 configs too
+            probs = jax.nn.softmax(scores, axis=-1)
+            a = jnp.einsum("bhqs,bshc->bqhc", probs,
+                           cv.astype(jnp.float32)).astype(dtype)
             x = _block_tail(x, a, lp, cfg)
             return (x,), new_cache
 
@@ -254,6 +258,47 @@ def build_decode_step(cfg: TransformerConfig,
         return logits[:, 0], new_cache
 
     return step
+
+
+def build_prefill(cfg: TransformerConfig,
+                  max_seq: Optional[int] = None) -> Callable:
+    """Prompt ingestion for streaming decode: ``prefill(params,
+    tokens[int32 b,s]) -> (logits[b, vocab], cache)`` — one full-sequence
+    forward that also captures every layer's rope'd k/v into a fresh
+    decode cache, so generation continues from ``pos = s`` with
+    :func:`build_decode_step`. The last position's logits seed the first
+    sampled token."""
+    dtype = cfg.dtype
+    s_max = max_seq or cfg.max_seq
+
+    def prefill(params, tokens):
+        b, s = tokens.shape
+        positions = jnp.arange(s)[None, :].astype(jnp.int32) * jnp.ones(
+            (b, 1), jnp.int32)
+        x = params["embed"].astype(dtype)[tokens]
+        layer_params = {k: v for k, v in params.items()
+                        if k not in ("embed", "ln_f")}
+
+        def layer(carry, lp):
+            x, = carry
+            q, k, v = _block_qkv(x, lp, positions, dtype)
+            from nnstreamer_tpu.parallel.ring import attention_reference
+
+            a = attention_reference(q, k, v, causal=True)
+            x = _block_tail(x, a, lp, cfg)
+            # park this layer's k/v in the first s cache slots
+            lc = jnp.zeros((2, b, s_max, cfg.n_heads, cfg.head_dim), dtype)
+            lc = jax.lax.dynamic_update_slice(
+                lc, jnp.stack([k, v]).astype(dtype), (0, 0, 0, 0, 0))
+            return (x,), lc
+
+        (x,), cache = lax.scan(layer, (x,), layer_params)
+        x = _rmsnorm(x, params["ln_f"])
+        logits = jnp.einsum("bd,vd->bv", x[:, -1].astype(jnp.float32),
+                            params["embed"])
+        return logits, cache
+
+    return prefill
 
 
 def build_greedy_stream_step(cfg: TransformerConfig,

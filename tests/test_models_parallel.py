@@ -449,3 +449,64 @@ class TestIncrementalDecode:
             logits, cache = step(params, tok, cache, jnp.int32(t))
             assert bool(jnp.all(jnp.isfinite(logits)))
             tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def test_prefill_then_decode_matches_full_forward(self):
+        """prefill(prompt) must hand decode a cache indistinguishable from
+        stepping the prompt token by token: the continuation logits equal
+        the full forward's."""
+        import jax
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.models.transformer import (
+            build_decode_step, build_forward, build_prefill, init_params)
+
+        cfg = self._cfg()
+        params = init_params(cfg)
+        rng = np.random.default_rng(7)
+        prompt = jnp.asarray(rng.integers(0, cfg.vocab, (2, 5)), jnp.int32)
+        nxt = jnp.asarray(rng.integers(0, cfg.vocab, (2,)), jnp.int32)
+
+        logits_p, cache = jax.jit(build_prefill(cfg))(params, prompt)
+        step = jax.jit(build_decode_step(cfg))
+        logits_d, _ = step(params, nxt, cache, jnp.int32(prompt.shape[1]))
+
+        full = jax.jit(build_forward(cfg))
+        ref = full(params, jnp.concatenate([prompt, nxt[:, None]], axis=1))
+        np.testing.assert_allclose(np.asarray(logits_p),
+                                   np.asarray(ref[:, 4]),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(logits_d),
+                                   np.asarray(ref[:, 5]),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_bf16_greedy_parity_with_full_forward(self):
+        """In bfloat16 (the shipped decode config's dtype) the cached loop
+        must pick the same greedy tokens as running the full forward on
+        the growing sequence — attention accumulates in fp32 on both
+        paths (code-review regression)."""
+        import jax
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.models.transformer import (
+            TransformerConfig, build_decode_step, build_forward,
+            init_cache, init_params)
+
+        cfg = TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                                n_layers=2, d_ff=64, max_seq=16,
+                                dtype=jnp.bfloat16)
+        params = init_params(cfg)
+        step = jax.jit(build_decode_step(cfg))
+        full = build_forward(cfg)
+
+        seq = [5]
+        cache = init_cache(cfg, batch=1)
+        tok = jnp.asarray([5], jnp.int32)
+        for t in range(6):
+            logits, cache = step(params, tok, cache, jnp.int32(t))
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            seq.append(int(tok[0]))
+        want = [5]
+        for t in range(6):
+            ref = full(params, jnp.asarray([want], jnp.int32))
+            want.append(int(jnp.argmax(ref[0, -1])))
+        assert seq == want
