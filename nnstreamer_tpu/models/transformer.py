@@ -139,11 +139,14 @@ def _moe_ffn(x, router, w_in, w_out, dtype):
 
 
 def make_layer_body(cfg: TransformerConfig,
-                    attention_fn: Optional[Callable] = None) -> Callable:
+                    attention_fn: Optional[Callable] = None,
+                    capture_kv: bool = False) -> Callable:
     """One transformer block as a ``lax.scan`` body over stacked layer
     params: ``layer_body((x, positions), layer_params) -> ((x, positions),
-    None)``. Shared by the plain forward (scan over all L layers) and the
-    pipeline-parallel forward (each stage scans its local L/pp layers)."""
+    ys)``. Shared by the plain forward (scan over all L layers), the
+    pipeline-parallel forward (each stage scans its local L/pp layers),
+    and prefill (``capture_kv=True`` → ys is the layer's rope'd
+    ``stack([k, v])`` for decode-cache seeding)."""
     from nnstreamer_tpu.parallel.ring import attention_reference
 
     attn = attention_fn or attention_reference
@@ -154,7 +157,7 @@ def make_layer_body(cfg: TransformerConfig,
         q, k, v = _block_qkv(x, lp, positions, dtype)
         a = attn(q, k, v)                                # [b,s,h,dh]
         x = _block_tail(x, a, lp, cfg)
-        return (x, positions), None
+        return (x, positions), (jnp.stack([k, v]) if capture_kv else None)
 
     return layer_body
 
@@ -216,31 +219,52 @@ def build_decode_step(cfg: TransformerConfig,
     prefix (bounded degradation, never an unmasked-garbage read). Callers
     streaming longer sequences should size the cache accordingly or reset
     it.
+
+    ``pos`` may be a scalar (all streams in lock-step) or a ``[b]``
+    vector — one position per batch row, the continuous-batching shape:
+    sequences at different depths decode together in one dispatch, each
+    writing its own cache slot and masking its own prefix.
     """
     dtype = cfg.dtype
     s_max = max_seq or cfg.max_seq
 
     def step(params, token, cache, pos):
         b = token.shape[0]
+        pos = jnp.asarray(pos, jnp.int32)
+        per_stream = pos.ndim == 1
         pos_c = jnp.minimum(pos, s_max - 1)  # see cache-length contract
         x = params["embed"].astype(dtype)[token][:, None]       # [b,1,d]
-        positions = jnp.full((b, 1), pos, jnp.int32)
+        positions = pos[:, None] if per_stream \
+            else jnp.full((b, 1), pos, jnp.int32)
         layer_params = {k: v for k, v in params.items()
                         if k not in ("embed", "ln_f")}
+
+        def write_cache(layer_cache, kv):
+            # [2,b,S,h,dh] ← [2,b,1,h,dh] at per-batch (or shared) slot
+            if per_stream:
+                return jax.vmap(
+                    lambda c, u, p: jax.lax.dynamic_update_slice(
+                        c, u, (0, p, 0, 0)),
+                    in_axes=(1, 1, 0), out_axes=1)(layer_cache, kv, pos_c)
+            return jax.lax.dynamic_update_slice(
+                layer_cache, kv, (0, 0, pos_c, 0, 0))
 
         def layer(carry, lp_and_cache):
             x, = carry
             lp, layer_cache = lp_and_cache                # [2,b,S,h,dh]
             q, k, v = _block_qkv(x, lp, positions, dtype)  # [b,1,h,dh]
-            new_cache = jax.lax.dynamic_update_slice(
-                layer_cache, jnp.stack([k, v]).astype(layer_cache.dtype),
-                (0, 0, pos_c, 0, 0))
+            new_cache = write_cache(
+                layer_cache, jnp.stack([k, v]).astype(layer_cache.dtype))
             ck, cv = new_cache[0], new_cache[1]           # [b,S,h,dh]
             scores = jnp.einsum("bqhc,bshc->bhqs",
                                 q.astype(jnp.float32),
                                 ck.astype(jnp.float32))
-            scores = scores / np.sqrt(cfg.head_dim)
-            mask = jnp.arange(s_max)[None, None, None, :] <= pos_c
+            # same scale FORM as attention_reference (flash_attention.py:45)
+            # so the fp32 arithmetic bit-matches the full forward's
+            scores = scores * cfg.head_dim ** -0.5
+            slots = jnp.arange(s_max)
+            mask = slots[None, None, None, :] <= (
+                pos_c[:, None, None, None] if per_stream else pos_c)
             scores = jnp.where(mask, scores, -1e30)
             # fp32 softmax AND fp32 probs×values, rounding only the final
             # output — bit-matches attention_reference so decode/forward
@@ -261,15 +285,18 @@ def build_decode_step(cfg: TransformerConfig,
 
 
 def build_prefill(cfg: TransformerConfig,
-                  max_seq: Optional[int] = None) -> Callable:
+                  max_seq: Optional[int] = None,
+                  attention_fn: Optional[Callable] = None) -> Callable:
     """Prompt ingestion for streaming decode: ``prefill(params,
     tokens[int32 b,s]) -> (logits[b, vocab], cache)`` — one full-sequence
-    forward that also captures every layer's rope'd k/v into a fresh
-    decode cache, so generation continues from ``pos = s`` with
-    :func:`build_decode_step`. The last position's logits seed the first
-    sampled token."""
+    forward (the SAME shared layer body as :func:`build_forward`, with
+    k/v captured) that seeds a fresh decode cache, so generation continues
+    from ``pos = s`` with :func:`build_decode_step`. The last position's
+    logits seed the first sampled token. ``attention_fn`` plugs in a flash
+    kernel for the O(s²) prompt pass exactly as in build_forward."""
     dtype = cfg.dtype
     s_max = max_seq or cfg.max_seq
+    layer_body = make_layer_body(cfg, attention_fn, capture_kv=True)
 
     def prefill(params, tokens):
         b, s = tokens.shape
@@ -278,21 +305,12 @@ def build_prefill(cfg: TransformerConfig,
         x = params["embed"].astype(dtype)[tokens]
         layer_params = {k: v for k, v in params.items()
                         if k not in ("embed", "ln_f")}
-
-        def layer(carry, lp):
-            x, = carry
-            q, k, v = _block_qkv(x, lp, positions, dtype)
-            from nnstreamer_tpu.parallel.ring import attention_reference
-
-            a = attention_reference(q, k, v, causal=True)
-            x = _block_tail(x, a, lp, cfg)
-            # park this layer's k/v in the first s cache slots
-            lc = jnp.zeros((2, b, s_max, cfg.n_heads, cfg.head_dim), dtype)
-            lc = jax.lax.dynamic_update_slice(
-                lc, jnp.stack([k, v]).astype(dtype), (0, 0, 0, 0, 0))
-            return (x,), lc
-
-        (x,), cache = lax.scan(layer, (x,), layer_params)
+        (x, _), kv = lax.scan(layer_body, (x, positions), layer_params)
+        # park each layer's k/v ([L,2,b,s,h,dh]) in the first s cache slots
+        cache = jnp.zeros((cfg.n_layers, 2, b, s_max, cfg.n_heads,
+                           cfg.head_dim), dtype)
+        cache = jax.lax.dynamic_update_slice(
+            cache, kv.astype(dtype), (0, 0, 0, 0, 0, 0))
         x = _rmsnorm(x, params["ln_f"])
         logits = jnp.einsum("bd,vd->bv", x[:, -1].astype(jnp.float32),
                             params["embed"])

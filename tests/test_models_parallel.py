@@ -510,3 +510,41 @@ class TestIncrementalDecode:
             ref = full(params, jnp.asarray([want], jnp.int32))
             want.append(int(jnp.argmax(ref[0, -1])))
         assert seq == want
+
+    def test_per_stream_positions_continuous_batching(self):
+        """pos as a [b] vector: streams at different depths decode in ONE
+        dispatch, each matching its own single-stream run (the
+        continuous-batching shape)."""
+        import jax
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.models.transformer import (
+            build_decode_step, init_cache, init_params)
+
+        cfg = self._cfg()
+        params = init_params(cfg)
+        step = jax.jit(build_decode_step(cfg))
+        rng = np.random.default_rng(9)
+
+        # two independent streams with different prefix depths
+        caches, toks, depths = [], [], (3, 6)
+        for d in depths:
+            cache = init_cache(cfg, batch=1)
+            tok = jnp.asarray([2], jnp.int32)
+            for t in range(d):
+                logits, cache = step(params, tok, cache, jnp.int32(t))
+                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            caches.append(cache)
+            toks.append(tok)
+        ref = [step(params, toks[i], caches[i], jnp.int32(depths[i]))[0]
+               for i in range(2)]
+
+        # same two streams, one batched dispatch with per-stream positions
+        batched_cache = jnp.concatenate(caches, axis=2)   # [L,2,b,S,h,dh]
+        batched_tok = jnp.concatenate(toks)
+        logits_b, _ = step(params, batched_tok, batched_cache,
+                           jnp.asarray(depths, jnp.int32))
+        for i in range(2):
+            np.testing.assert_allclose(np.asarray(logits_b[i]),
+                                       np.asarray(ref[i][0]),
+                                       rtol=1e-4, atol=1e-4)

@@ -488,3 +488,52 @@ class TestServerTransports:
         t.join(timeout=10)
         assert not t.is_alive()
         assert results == [None]
+
+
+class TestServerSoak:
+    def test_sustained_offload_500_frames(self):
+        """Sustained pipelined load through the native transport: every
+        frame accounted for, zero drops, orderly EOS."""
+        from nnstreamer_tpu.filters import register_custom_easy
+        from nnstreamer_tpu.tensors.types import TensorsInfo
+
+        info = TensorsInfo.from_str("16", "float32")
+        register_custom_easy("soak_inc",
+                             lambda ins: [np.asarray(ins[0]) + 1.0],
+                             info, info)
+        server = parse_launch(
+            "tensor_query_serversrc name=ss port=0 id=77 ! "
+            "tensor_filter framework=custom-easy model=soak_inc ! "
+            "tensor_query_serversink id=77")
+        server.start()
+        client = None
+        try:
+            assert server.get("ss").server.native
+            port = server.get("ss").port
+            from nnstreamer_tpu.elements.sink import TensorSink
+            from nnstreamer_tpu.elements.source import AppSrc
+
+            client = parse_launch(
+                f"tensor_query_client name=c dest-host=127.0.0.1 "
+                f"dest-port={port} max-in-flight=8 timeout=30")
+            src, sink = AppSrc(name="src"), TensorSink(name="out")
+            client.add(src, sink)
+            src.link(client.get("c"))
+            client.get("c").link(sink)
+            client.start()
+            n = 500
+            for i in range(n):
+                src.push([np.full(16, float(i), np.float32)], pts=i)
+            src.end_of_stream()
+            msg = client.wait(timeout=120)
+            assert msg is not None and msg.kind == "eos", msg
+            assert len(sink.buffers) == n
+            assert int(client.get("c").get_property("frames_dropped")) == 0
+            # spot-check ordering + content across the run
+            for i in (0, 123, 499):
+                np.testing.assert_allclose(
+                    np.asarray(sink.buffers[i][0]), float(i) + 1.0)
+        finally:
+            if client is not None:
+                client.stop()
+            server.stop()
