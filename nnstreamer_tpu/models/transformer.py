@@ -336,6 +336,37 @@ def build_greedy_stream_step(cfg: TransformerConfig,
     return step
 
 
+def build_sample_stream_step(cfg: TransformerConfig,
+                             max_seq: Optional[int] = None,
+                             temperature: float = 1.0,
+                             top_k: int = 0) -> Callable:
+    """Sampled decode step for the repo loop: ``step(params, token, cache,
+    pos, key[uint32 2]) -> (next_token, cache, pos+1, next_key)`` — the
+    PRNG key rides the state tuple like the cache does, so streaming stays
+    deterministic given the seed. ``temperature<=0`` degrades to greedy;
+    ``top_k>0`` restricts sampling to the k highest logits."""
+    decode = build_decode_step(cfg, max_seq)
+
+    def step(params, token, cache, pos, key):
+        logits, cache2 = decode(params, token.reshape(1).astype(jnp.int32),
+                                cache, pos.reshape(()).astype(jnp.int32))
+        if temperature <= 0.0:
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return nxt, cache2, pos + 1, key
+        scaled = logits / temperature
+        if top_k > 0:
+            k = min(top_k, cfg.vocab)  # over-asking means "no restriction"
+            kth = jax.lax.top_k(scaled, k)[0][:, -1:]
+            scaled = jnp.where(scaled >= kth, scaled, -1e30)
+        key = jnp.asarray(key, jnp.uint32).reshape(2)
+        key, sub = jax.random.split(
+            jax.random.wrap_key_data(key, impl="threefry2x32"))
+        nxt = jax.random.categorical(sub, scaled, axis=-1).astype(jnp.int32)
+        return nxt, cache2, pos + 1, jax.random.key_data(key)
+
+    return step
+
+
 def transformer_lm(vocab: int = 32000, d_model: int = 512, n_heads: int = 8,
                    n_layers: int = 4, d_ff: int = 2048, seq: int = 256,
                    batch: int = 1, dtype=jnp.bfloat16, num_experts: int = 0,

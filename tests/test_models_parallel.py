@@ -548,3 +548,71 @@ class TestIncrementalDecode:
             np.testing.assert_allclose(np.asarray(logits_b[i]),
                                        np.asarray(ref[i][0]),
                                        rtol=1e-4, atol=1e-4)
+
+    def test_sampled_stream_step(self):
+        """Temperature sampling through the repo-loop state tuple:
+        deterministic for a fixed seed, greedy at temperature 0 and at
+        top_k=1, and runnable as a pipeline filter."""
+        import jax
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu import parse_launch
+        from nnstreamer_tpu.elements.repo import GLOBAL_REPO
+        from nnstreamer_tpu.filters.jax_backend import (
+            register_jax_model, unregister_jax_model)
+        from nnstreamer_tpu.models.transformer import (
+            build_greedy_stream_step, build_sample_stream_step, init_cache,
+            init_params)
+        from nnstreamer_tpu.tensors.buffer import TensorBuffer
+
+        cfg = self._cfg()
+        params = init_params(cfg)
+        key0 = jax.random.key_data(jax.random.PRNGKey(0))
+
+        def run(step, with_key):
+            cache = init_cache(cfg, batch=1)
+            tok = jnp.asarray([3], jnp.int32)
+            key = key0
+            out = []
+            sj = jax.jit(step)
+            for t in range(6):
+                if with_key:
+                    tok, cache, _, key = sj(params, tok, cache,
+                                            jnp.int32(t), key)
+                else:
+                    tok, cache, _ = sj(params, tok, cache, jnp.int32(t))
+                out.append(int(tok.reshape(-1)[0]))
+            return out
+
+        sampled = build_sample_stream_step(cfg, temperature=1.0)
+        a = run(sampled, True)
+        b = run(sampled, True)
+        assert a == b  # same seed → same stream
+        greedy = run(build_greedy_stream_step(cfg), False)
+        assert run(build_sample_stream_step(cfg, temperature=0.0),
+                   True) == greedy
+        assert run(build_sample_stream_step(cfg, temperature=0.5,
+                                            top_k=1), True) == greedy
+
+        # as a pipeline filter with the key in the circulating state
+        register_jax_model("lm_sample_test", sampled, params)
+        try:
+            GLOBAL_REPO.set("lm_s", TensorBuffer(
+                [np.asarray([3], np.int32),
+                 init_cache(cfg, batch=1),
+                 np.asarray(0, np.int32),
+                 np.asarray(key0)], pts=0))
+            pipe = parse_launch(
+                "tensor_reposrc slot=lm_s num-buffers=6 timeout=30 ! "
+                "tensor_filter framework=jax model=lm_sample_test ! "
+                "tee name=t  t. ! tensor_reposink slot=lm_s  "
+                "t. ! tensor_sink name=out to-host=false")
+            got = []
+            pipe.get("out").connect(
+                lambda bf: got.append(int(np.asarray(bf[0]).reshape(-1)[0])))
+            msg = pipe.run(timeout=120)
+            assert msg is not None and msg.kind == "eos", msg
+            assert got == a  # pipeline stream equals the direct loop
+        finally:
+            unregister_jax_model("lm_sample_test")
+            GLOBAL_REPO.remove("lm_s")
