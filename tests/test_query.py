@@ -510,17 +510,7 @@ class TestServerSoak:
         try:
             assert server.get("ss").server.native
             port = server.get("ss").port
-            from nnstreamer_tpu.elements.sink import TensorSink
-            from nnstreamer_tpu.elements.source import AppSrc
-
-            client = parse_launch(
-                f"tensor_query_client name=c dest-host=127.0.0.1 "
-                f"dest-port={port} max-in-flight=8 timeout=30")
-            src, sink = AppSrc(name="src"), TensorSink(name="out")
-            client.add(src, sink)
-            src.link(client.get("c"))
-            client.get("c").link(sink)
-            client.start()
+            client, src, sink = self._make_client(port, window=8)
             n = 500
             for i in range(n):
                 src.push([np.full(16, float(i), np.float32)], pts=i)
@@ -536,4 +526,76 @@ class TestServerSoak:
         finally:
             if client is not None:
                 client.stop()
+            server.stop()
+
+    @staticmethod
+    def _make_client(port, window):
+        """appsrc → query client → sink pipeline, started."""
+        from nnstreamer_tpu.elements.sink import TensorSink
+        from nnstreamer_tpu.elements.source import AppSrc
+
+        client = parse_launch(
+            f"tensor_query_client name=c dest-host=127.0.0.1 "
+            f"dest-port={port} max-in-flight={window} timeout=30")
+        src, sink = AppSrc(name="src"), TensorSink(name="out")
+        client.add(src, sink)
+        src.link(client.get("c"))
+        client.get("c").link(sink)
+        client.start()
+        return client, src, sink
+
+    def test_concurrent_clients_native_core(self):
+        """Four clients hammering the native transport from separate
+        threads: per-connection write mutexes and the atomic take keep
+        every stream intact."""
+        import threading
+
+        from nnstreamer_tpu.filters import register_custom_easy
+        from nnstreamer_tpu.tensors.types import TensorsInfo
+
+        info = TensorsInfo.from_str("8", "float32")
+        register_custom_easy("conc_inc",
+                             lambda ins: [np.asarray(ins[0]) + 1.0],
+                             info, info)
+        server = parse_launch(
+            "tensor_query_serversrc name=ss port=0 id=78 ! "
+            "tensor_filter framework=custom-easy model=conc_inc ! "
+            "tensor_query_serversink id=78")
+        server.start()
+        try:
+            assert server.get("ss").server.native
+            port = server.get("ss").port
+            results = {}
+
+            def client_run(tag):
+                c = None
+                try:
+                    c, src, sink = self._make_client(port, window=4)
+                    n = 60
+                    for i in range(n):
+                        src.push([np.full(8, tag * 1000.0 + i, np.float32)],
+                                 pts=i)
+                    src.end_of_stream()
+                    msg = c.wait(timeout=60)
+                    vals = [float(np.asarray(b[0])[0])
+                            for b in sink.buffers]
+                    results[tag] = (msg.kind if msg else None, vals)
+                except Exception as e:  # surface in the main thread
+                    results[tag] = ("exception", repr(e))
+                finally:
+                    if c is not None:
+                        c.stop()
+
+            threads = [threading.Thread(target=client_run, args=(t,))
+                       for t in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert all(not t.is_alive() for t in threads)
+            for tag in range(4):
+                kind, vals = results[tag]
+                assert kind == "eos", (tag, kind)
+                assert vals == [tag * 1000.0 + i + 1.0 for i in range(60)]
+        finally:
             server.stop()
