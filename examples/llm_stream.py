@@ -21,10 +21,12 @@ import numpy as np  # noqa: E402
 import nnstreamer_tpu as nt  # noqa: E402
 from nnstreamer_tpu.elements.repo import GLOBAL_REPO  # noqa: E402
 from nnstreamer_tpu.filters.jax_backend import register_jax_model  # noqa: E402
+import jax  # noqa: E402
+
 from nnstreamer_tpu.models.transformer import (  # noqa: E402
     TransformerConfig,
     build_greedy_stream_step,
-    init_cache,
+    build_prefill,
     init_params,
 )
 from nnstreamer_tpu.tensors.buffer import TensorBuffer  # noqa: E402
@@ -35,12 +37,16 @@ cfg = TransformerConfig(vocab=256, d_model=64, n_heads=4, n_layers=2,
 params = init_params(cfg)
 register_jax_model("lm_decode", build_greedy_stream_step(cfg), params)
 
-# seed the loop: (token, kv-cache, position) as one multi-tensor state —
-# the cache stays a device-resident jax.Array from the very first frame
+# serving flow: prefill the prompt in ONE full-sequence pass, then stream.
+# The warmed cache enters the loop as a device-resident jax.Array — it
+# never leaves HBM.
+prompt = jnp.asarray([[7, 42, 3, 99]], jnp.int32)
+logits, cache = jax.jit(build_prefill(cfg))(params, prompt)
+first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 GLOBAL_REPO.set("lm", TensorBuffer(
-    [np.asarray([1], np.int32),
-     init_cache(cfg, batch=1),
-     np.asarray(0, np.int32)], pts=0))
+    [np.asarray(first),
+     cache,
+     np.asarray(prompt.shape[1], np.int32)], pts=0))
 
 pipe = nt.parse_launch(
     f"tensor_reposrc slot=lm num-buffers={N_TOKENS} timeout=30 ! "
@@ -53,5 +59,6 @@ pipe.get("out").connect(
     lambda b: tokens.append(int(np.asarray(b[0]).reshape(-1)[0])))
 msg = pipe.run(timeout=300)
 assert msg is not None and msg.kind == "eos", msg
+print(f"prompt {prompt.tolist()[0]} → first sampled {int(first[0])}")
 print(f"streamed {len(tokens)} tokens: {tokens}")
 print(f"decode-step latency: {pipe.get('f').get_property('latency')} µs")

@@ -494,22 +494,9 @@ class TestServerSoak:
     def test_sustained_offload_500_frames(self):
         """Sustained pipelined load through the native transport: every
         frame accounted for, zero drops, orderly EOS."""
-        from nnstreamer_tpu.filters import register_custom_easy
-        from nnstreamer_tpu.tensors.types import TensorsInfo
-
-        info = TensorsInfo.from_str("16", "float32")
-        register_custom_easy("soak_inc",
-                             lambda ins: [np.asarray(ins[0]) + 1.0],
-                             info, info)
-        server = parse_launch(
-            "tensor_query_serversrc name=ss port=0 id=77 ! "
-            "tensor_filter framework=custom-easy model=soak_inc ! "
-            "tensor_query_serversink id=77")
-        server.start()
+        server, port = self._make_server("soak_inc", 77, "16")
         client = None
         try:
-            assert server.get("ss").server.native
-            port = server.get("ss").port
             client, src, sink = self._make_client(port, window=8)
             n = 500
             for i in range(n):
@@ -527,6 +514,24 @@ class TestServerSoak:
             if client is not None:
                 client.stop()
             server.stop()
+
+    @staticmethod
+    def _make_server(model, pair_id, dim):
+        """serversrc → custom-easy(+1) filter → serversink, started."""
+        from nnstreamer_tpu.filters import register_custom_easy
+        from nnstreamer_tpu.tensors.types import TensorsInfo
+
+        info = TensorsInfo.from_str(dim, "float32")
+        register_custom_easy(model,
+                             lambda ins: [np.asarray(ins[0]) + 1.0],
+                             info, info)
+        server = parse_launch(
+            f"tensor_query_serversrc name=ss port=0 id={pair_id} ! "
+            f"tensor_filter framework=custom-easy model={model} ! "
+            f"tensor_query_serversink id={pair_id}")
+        server.start()
+        assert server.get("ss").server.native
+        return server, server.get("ss").port
 
     @staticmethod
     def _make_client(port, window):
@@ -550,21 +555,8 @@ class TestServerSoak:
         every stream intact."""
         import threading
 
-        from nnstreamer_tpu.filters import register_custom_easy
-        from nnstreamer_tpu.tensors.types import TensorsInfo
-
-        info = TensorsInfo.from_str("8", "float32")
-        register_custom_easy("conc_inc",
-                             lambda ins: [np.asarray(ins[0]) + 1.0],
-                             info, info)
-        server = parse_launch(
-            "tensor_query_serversrc name=ss port=0 id=78 ! "
-            "tensor_filter framework=custom-easy model=conc_inc ! "
-            "tensor_query_serversink id=78")
-        server.start()
+        server, port = self._make_server("conc_inc", 78, "8")
         try:
-            assert server.get("ss").server.native
-            port = server.get("ss").port
             results = {}
 
             def client_run(tag):
