@@ -1,0 +1,53 @@
+"""Continuous-batching LM serving: N concurrent prompts share one batched
+KV-cached decode program (serving/engine.py).
+
+Run: PYTHONPATH=.. python serve.py   (CPU XLA works; TPU if available)
+
+Contrast with examples/llm_stream.py (one stream through the tensor_repo
+pipeline loop): the engine multiplexes many streams onto the same device
+program — the TPU-native answer to the reference query server's
+one-request-one-invoke loop (tensor_query_server.c).
+"""
+
+from nnstreamer_tpu.utils.platform import ensure_jax_platform
+
+ensure_jax_platform()  # fall back to CPU if the preset backend is unusable
+
+import time  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from nnstreamer_tpu.models.transformer import TransformerConfig, init_params
+from nnstreamer_tpu.serving import ContinuousBatchingEngine
+
+
+def main():
+    cfg = TransformerConfig(vocab=4096, d_model=256, n_heads=8, n_layers=4,
+                            d_ff=1024, max_seq=256, dtype=jnp.bfloat16)
+    engine = ContinuousBatchingEngine(
+        cfg, init_params(cfg, seed=0), max_streams=4,
+        steps_per_dispatch=8, temperature=0.7, top_k=40, seed=42,
+    ).start()
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, cfg.vocab, n).tolist() for n in
+               (5, 12, 30, 9, 21, 7)]
+    t0 = time.monotonic()
+    streams = [engine.submit(p, max_new_tokens=48) for p in prompts]
+    for s in streams:
+        toks = s.result(timeout=600)
+        print(f"stream {s.stream_id}: prompt_len={s.prompt_len} "
+              f"generated={len(toks)} ({s.finish_reason}) "
+              f"first={toks[:6]}")
+    dt = time.monotonic() - t0
+    st = engine.stats
+    util = st["active_slot_steps"] / max(1, st["slot_steps"])
+    print(f"total {st['tokens_generated']} tokens in {dt:.2f}s "
+          f"({st['tokens_generated'] / dt:.1f} tok/s aggregate), "
+          f"{st['dispatches']} dispatches, slot utilization {util:.0%}")
+    engine.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -293,12 +293,21 @@ def build_prefill(cfg: TransformerConfig,
     k/v captured) that seeds a fresh decode cache, so generation continues
     from ``pos = s`` with :func:`build_decode_step`. The last position's
     logits seed the first sampled token. ``attention_fn`` plugs in a flash
-    kernel for the O(s²) prompt pass exactly as in build_forward."""
+    kernel for the O(s²) prompt pass exactly as in build_forward.
+
+    ``prefill(params, tokens, lengths)`` with ``lengths[int32 b]`` supports
+    RIGHT-PADDED prompts (bucketed compile shapes, serving.engine): logits
+    are taken at each row's true last position ``lengths-1``. Trailing-pad
+    kv entries land in cache slots ≥ length; they are garbage but
+    unreachable — decode's ``slots <= pos`` mask only admits slot i once
+    pos reaches i, and the decode step WRITES slot i (overwriting the pad
+    kv) before attending on that same step, so a padded prefill is
+    bit-identical to an exact-length one for all future tokens."""
     dtype = cfg.dtype
     s_max = max_seq or cfg.max_seq
     layer_body = make_layer_body(cfg, attention_fn, capture_kv=True)
 
-    def prefill(params, tokens):
+    def prefill(params, tokens, lengths=None):
         b, s = tokens.shape
         positions = jnp.arange(s)[None, :].astype(jnp.int32) * jnp.ones(
             (b, 1), jnp.int32)
@@ -312,7 +321,14 @@ def build_prefill(cfg: TransformerConfig,
         cache = jax.lax.dynamic_update_slice(
             cache, kv.astype(dtype), (0, 0, 0, 0, 0, 0))
         x = _rmsnorm(x, params["ln_f"])
-        logits = jnp.einsum("bd,vd->bv", x[:, -1].astype(jnp.float32),
+        if lengths is None:
+            last = x[:, -1]
+        else:
+            idx = (jnp.asarray(lengths, jnp.int32) - 1)[:, None, None]
+            last = jnp.take_along_axis(
+                x, jnp.broadcast_to(idx, (b, 1, x.shape[-1])), axis=1
+            )[:, 0]
+        logits = jnp.einsum("bd,vd->bv", last.astype(jnp.float32),
                             params["embed"])
         return logits, cache
 

@@ -1,0 +1,17 @@
+"""Continuous-batching LM serving — TPU-native request scheduling.
+
+New capability beyond the reference (whose closest analog is the
+tensor_query server's one-buffer-per-client request loop,
+/root/reference/gst/nnstreamer/tensor_query/tensor_query_server.c): N
+generation streams share ONE batched, KV-cached decode program. Admission
+happens at dispatch boundaries; each stream owns a batch slot of the
+device-resident cache; the hot loop is a single jitted multi-step decode
+whose shapes never change, so XLA compiles it exactly once.
+"""
+
+from nnstreamer_tpu.serving.engine import (
+    ContinuousBatchingEngine,
+    GenerationStream,
+)
+
+__all__ = ["ContinuousBatchingEngine", "GenerationStream"]

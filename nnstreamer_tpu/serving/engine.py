@@ -1,0 +1,390 @@
+"""Continuous-batching decode engine.
+
+The TPU-first serving design, contrasted with the reference's query server
+(one request = one pipeline invoke,
+/root/reference/gst/nnstreamer/tensor_query/tensor_query_server.c):
+
+- **One static program.** ``max_streams`` batch slots share a single KV
+  cache ``[L, 2, B, S, h, dh]`` in HBM. The hot loop is ONE jitted
+  function whose shapes never change — no recompiles as streams come and
+  go. Empty slots decode garbage that the host ignores; on a systolic
+  array the wasted lanes cost nothing extra because the batched matmul
+  runs anyway (utilization, not correctness, is what admission manages).
+- **Multi-step dispatch.** Each dispatch runs ``steps_per_dispatch``
+  decode steps under ``lax.scan`` and returns a ``[B, K]`` token block —
+  per-call overhead (Python, transfer RPC on a tunneled chip) amortizes
+  over K tokens. Streams hitting EOS mid-block waste at most K-1 slots of
+  compute; the host truncates at the first EOS.
+- **Bucketed prefill.** Prompts are right-padded to power-of-two buckets
+  so prefill compiles once per bucket, not once per prompt length. Logits
+  come from the true last position (``build_prefill`` lengths arg), and
+  pad kv entries are provably unreachable (see models/transformer.py
+  build_prefill docstring).
+- **Slot-local determinism.** Each stream's PRNG key is derived from
+  (engine seed, stream id), so sampled output is reproducible regardless
+  of which other streams share the batch — per-stream results never
+  depend on batch composition (the decode math is row-independent).
+
+Host-side state (positions, last tokens, keys) is a handful of int32s
+uploaded per dispatch; only the cache stays device-resident, donated into
+every dispatch so XLA updates it in place.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from nnstreamer_tpu.log import get_logger
+
+log = get_logger("serving")
+
+
+class GenerationStream:
+    """Handle for one submitted prompt: iterate to receive token ids as
+    they are generated; ``None``-terminated internally."""
+
+    _DONE = object()
+
+    def __init__(self, stream_id: int, prompt_len: int):
+        self.stream_id = stream_id
+        self.prompt_len = prompt_len
+        self.tokens: List[int] = []  # generated so far (post-prompt)
+        self.finished = False
+        self.finish_reason: Optional[str] = None  # "eos" | "length"
+        self._q: _queue.Queue = _queue.Queue()
+
+    def __iter__(self) -> Iterator[int]:
+        while True:
+            item = self._q.get()
+            if item is self._DONE:
+                return
+            yield item
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until the stream finishes; returns all generated ids."""
+        out = []
+        deadline = None
+        if timeout is not None:
+            import time
+
+            deadline = time.monotonic() + timeout
+        while True:
+            import time
+
+            t = None if deadline is None else max(0.0,
+                                                  deadline - time.monotonic())
+            try:
+                item = self._q.get(timeout=t)
+            except _queue.Empty:
+                raise TimeoutError(
+                    f"stream {self.stream_id}: no token within {timeout}s")
+            if item is self._DONE:
+                return out
+            out.append(item)
+
+    # engine-side
+    def _emit(self, tok: int):
+        self.tokens.append(tok)
+        self._q.put(tok)
+
+    def _finish(self, reason: str):
+        self.finished = True
+        self.finish_reason = reason
+        self._q.put(self._DONE)
+
+
+class _PendingRequest:
+    def __init__(self, prompt: np.ndarray, max_new: int,
+                 stream: GenerationStream):
+        self.prompt = prompt
+        self.max_new = max_new
+        self.stream = stream
+
+
+class ContinuousBatchingEngine:
+    """Batched multi-stream generation over one transformer model.
+
+    Parameters
+    ----------
+    cfg, params: a ``models.transformer`` config + param pytree.
+    max_streams: batch slots (B). Static — sizes the cache and programs.
+    max_seq: cache length S (defaults to ``cfg.max_seq``).
+    steps_per_dispatch: decode steps fused into one device dispatch (K).
+    temperature / top_k: sampling config (``temperature<=0`` → greedy).
+    eos_id: generation stops when the model emits this id (None → length
+        -bounded only).
+    seed: engine PRNG seed; per-stream keys fold in the stream id.
+    min_bucket: smallest prefill padding bucket.
+    """
+
+    def __init__(self, cfg, params, max_streams: int = 4,
+                 max_seq: Optional[int] = None,
+                 steps_per_dispatch: int = 8,
+                 temperature: float = 0.0, top_k: int = 0,
+                 eos_id: Optional[int] = None, seed: int = 0,
+                 min_bucket: int = 16):
+        import jax
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.models.transformer import (
+            build_decode_step,
+            build_prefill,
+            init_cache,
+        )
+
+        self.cfg = cfg
+        self.params = params
+        self.B = int(max_streams)
+        self.S = int(max_seq or cfg.max_seq)
+        self.K = int(steps_per_dispatch)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.eos_id = eos_id
+        self.seed = int(seed)
+        self.min_bucket = int(min_bucket)
+
+        self._decode = build_decode_step(cfg, self.S)
+        self._prefill_fn = build_prefill(cfg, self.S)
+
+        # host-side per-slot state
+        self._pos = np.zeros(self.B, np.int32)
+        self._last = np.zeros(self.B, np.int32)
+        self._keys = np.zeros((self.B, 2), np.uint32)
+        self._slots: List[Optional[GenerationStream]] = [None] * self.B
+        self._budget = np.zeros(self.B, np.int64)  # tokens still allowed
+
+        self._init_cache = lambda: init_cache(cfg, self.B, self.S)
+        self._cache = self._init_cache()
+        self._pending: "_queue.Queue[_PendingRequest]" = _queue.Queue()
+        self._next_id = 0
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.stats: Dict[str, Any] = {
+            "tokens_generated": 0, "dispatches": 0, "prefills": 0,
+            "slot_steps": 0, "active_slot_steps": 0,
+        }
+
+        V = cfg.vocab
+        temp, top_k_, K = self.temperature, self.top_k, self.K
+        decode = self._decode
+
+        def sample(logits, key):
+            """[n, V] logits (+ per-row keys [n, 2]) → [n] token ids.
+            Shared by prefill seeding and the dispatch loop so the first
+            token and all later ones use identical sampling math."""
+            if temp <= 0.0:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), key
+            scaled = logits / temp
+            if top_k_ > 0:
+                k = min(top_k_, V)
+                kth = jax.lax.top_k(scaled, k)[0][:, -1:]
+                scaled = jnp.where(scaled >= kth, scaled, -1e30)
+
+            def row(key_row, logit_row):
+                kk = jax.random.wrap_key_data(key_row, impl="threefry2x32")
+                kk, sub = jax.random.split(kk)
+                tok = jax.random.categorical(sub, logit_row)
+                return jax.random.key_data(kk), tok
+
+            new_keys, toks = jax.vmap(row)(key, scaled)
+            return toks.astype(jnp.int32), new_keys
+
+        def dispatch(params, token, cache, pos, keys):
+            """K decode steps in one program: ([B],cache,[B],[B,2]) →
+            ([B,K] tokens, cache, keys)."""
+
+            def body(carry, _):
+                token, cache, pos, keys = carry
+                logits, cache = decode(params, token, cache, pos)
+                nxt, keys = sample(logits, keys)
+                return (nxt, cache, pos + 1, keys), nxt
+
+            (token, cache, pos, keys), toks = jax.lax.scan(
+                body, (token, cache, pos, keys), None, length=K)
+            return jnp.transpose(toks), cache, keys
+
+        self._dispatch = jax.jit(dispatch, donate_argnums=(2,))
+        self._sample_first = jax.jit(sample)
+
+        def insert(cache, cache1, slot):
+            return jax.lax.dynamic_update_slice(
+                cache, cache1, (0, 0, slot, 0, 0, 0))
+
+        self._insert = jax.jit(insert, donate_argnums=(0,))
+
+        # one jitted prefill; XLA caches one executable per bucket shape
+        self._prefill_jitted = jax.jit(self._prefill_fn)
+        self._jnp = jnp
+
+    # -- public API -----------------------------------------------------------
+    def start(self) -> "ContinuousBatchingEngine":
+        if self._thread is None:
+            self._stop_evt.clear()
+            self._thread = threading.Thread(target=self._loop,
+                                            name="cb-engine", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop_evt.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+        # fail any stream still in flight so iterators don't hang
+        for i, st in enumerate(self._slots):
+            if st is not None and not st.finished:
+                st._finish("engine-stopped")
+                self._slots[i] = None
+        while True:
+            try:
+                req = self._pending.get_nowait()
+            except _queue.Empty:
+                break
+            req.stream._finish("engine-stopped")
+
+    def submit(self, prompt, max_new_tokens: int = 64) -> GenerationStream:
+        """Queue a prompt (sequence of int token ids); returns a
+        :class:`GenerationStream` yielding generated ids."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("serving: empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"serving: max_new_tokens must be >= 1, got {max_new_tokens}"
+                " (the prefill always yields the first token)")
+        if prompt.size >= self.S:
+            raise ValueError(
+                f"serving: prompt length {prompt.size} must be < cache "
+                f"length {self.S}")
+        with self._lock:
+            sid = self._next_id
+            self._next_id += 1
+        stream = GenerationStream(sid, prompt.size)
+        self._pending.put(_PendingRequest(prompt, int(max_new_tokens),
+                                          stream))
+        self._wake.set()
+        return stream
+
+    def generate(self, prompt, max_new_tokens: int = 64,
+                 timeout: Optional[float] = None) -> List[int]:
+        """Synchronous helper: submit + wait (engine must be started)."""
+        return self.submit(prompt, max_new_tokens).result(timeout=timeout)
+
+    @property
+    def active_streams(self) -> int:
+        return sum(1 for s in self._slots if s is not None)
+
+    # -- engine internals ------------------------------------------------------
+    def _bucket(self, n: int) -> int:
+        b = self.min_bucket
+        while b < n:
+            b *= 2
+        return min(b, self.S)
+
+    def _admit(self, req: _PendingRequest, slot: int):
+        jnp = self._jnp
+        prompt = req.prompt
+        n = prompt.size
+        bucket = self._bucket(n)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = prompt
+        logits, cache1 = self._prefill_jitted(
+            self.params, jnp.asarray(padded),
+            lengths=jnp.asarray([n], jnp.int32))
+        self.stats["prefills"] += 1
+        key = np.asarray(
+            [self.seed & 0xFFFFFFFF, req.stream.stream_id & 0xFFFFFFFF],
+            np.uint32)[None]
+        first, key = self._sample_first(logits, jnp.asarray(key))
+        first = int(np.asarray(first)[0])
+        self._cache = self._insert(self._cache, cache1.astype(
+            self._cache.dtype), slot)
+        self._slots[slot] = req.stream
+        self._pos[slot] = n
+        self._last[slot] = first
+        self._keys[slot] = np.asarray(key)[0]
+        # cap generation so cache writes stay inside the slot's S window
+        self._budget[slot] = min(req.max_new, self.S - n)
+        req.stream._emit(first)
+        self.stats["tokens_generated"] += 1
+        self._post_emit(slot, first)
+
+    def _post_emit(self, slot: int, tok: int):
+        """Budget/EOS bookkeeping after a token reaches its stream."""
+        st = self._slots[slot]
+        self._budget[slot] -= 1
+        if self.eos_id is not None and tok == self.eos_id:
+            st._finish("eos")
+            self._slots[slot] = None
+        elif self._budget[slot] <= 0:
+            st._finish("length")
+            self._slots[slot] = None
+
+    def _loop(self):
+        jnp = self._jnp
+        while not self._stop_evt.is_set():
+            # admission: fill free slots from the pending queue
+            admitted = False
+            for slot in range(self.B):
+                if self._slots[slot] is not None:
+                    continue
+                try:
+                    req = self._pending.get_nowait()
+                except _queue.Empty:
+                    break
+                try:
+                    self._admit(req, slot)
+                    admitted = True
+                except Exception as e:  # noqa: BLE001 — a bad request
+                    # (or a prefill failure) must not kill the engine loop
+                    log.warning("serving: admit failed: %s", e)
+                    req.stream._finish(f"error: {e}")
+            if self.active_streams == 0:
+                if not admitted:
+                    self._wake.wait(timeout=0.05)
+                    self._wake.clear()
+                continue
+            try:
+                toks, self._cache, keys = self._dispatch(
+                    self.params, jnp.asarray(self._last),
+                    self._cache, jnp.asarray(self._pos),
+                    jnp.asarray(self._keys))
+            except Exception as e:  # noqa: BLE001 — a device failure must
+                # not strand clients blocked on their streams: fail every
+                # in-flight stream, rebuild the (possibly donated-away)
+                # cache, and keep serving new requests
+                log.error("serving: dispatch failed: %s", e)
+                for slot in range(self.B):
+                    st = self._slots[slot]
+                    if st is not None:
+                        st._finish(f"error: {e}")
+                        self._slots[slot] = None
+                self._cache = self._init_cache()
+                continue
+            toks = np.asarray(toks)            # [B, K] — the only D2H
+            # np.array (copy): asarray on a jax array yields a READ-ONLY
+            # view, and _admit writes per-slot keys in place
+            self._keys = np.array(keys)
+            self.stats["dispatches"] += 1
+            self.stats["slot_steps"] += self.B * self.K
+            for slot in range(self.B):
+                st = self._slots[slot]
+                if st is None:
+                    continue  # free slot: state is reset at next admit
+                self._pos[slot] += self.K
+                self._last[slot] = toks[slot, -1]
+                for j in range(self.K):
+                    tok = int(toks[slot, j])
+                    self.stats["tokens_generated"] += 1
+                    self.stats["active_slot_steps"] += 1
+                    st._emit(tok)
+                    self._post_emit(slot, tok)
+                    if self._slots[slot] is None:
+                        break  # EOS/length mid-block: drop the tail
