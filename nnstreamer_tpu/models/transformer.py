@@ -284,6 +284,68 @@ def build_decode_step(cfg: TransformerConfig,
     return step
 
 
+def build_chunk_decode(cfg: TransformerConfig,
+                       max_seq: Optional[int] = None) -> Callable:
+    """KV-cached decode of a WHOLE chunk of c tokens in one pass:
+    ``chunk(params, tokens[int32 b,c], cache, pos0[int32 scalar]) ->
+    (logits[b,c,vocab], new_cache)``.
+
+    Generalizes :func:`build_decode_step` (c=1) to the shape speculative
+    verification needs (models/speculative.py): the target model scores c
+    candidate positions in ONE program — a [c, d_model] matmul per layer
+    instead of c sequential single-row dispatches, which is exactly what
+    the MXU wants. Position ``pos0+i`` writes cache slot ``pos0+i`` and
+    attends under a ``slot <= pos0+i`` mask (write-before-attend, so
+    stale kv beyond an accepted prefix is unreachable — the rewind-free
+    speculative cache contract; see speculative.py docstring).
+
+    ``pos0`` is clamped so the chunk's writes stay inside the cache
+    (same bounded-degradation contract as build_decode_step).
+    """
+    dtype = cfg.dtype
+    s_max = max_seq or cfg.max_seq
+
+    def chunk(params, tokens, cache, pos0):
+        b, c = tokens.shape
+        pos0 = jnp.minimum(jnp.asarray(pos0, jnp.int32), s_max - c)
+        positions = pos0 + jnp.arange(c)[None, :] * jnp.ones(
+            (b, 1), jnp.int32)                                   # [b,c]
+        x = params["embed"].astype(dtype)[tokens]
+        layer_params = {k: v for k, v in params.items()
+                        if k not in ("embed", "ln_f")}
+
+        def layer(carry, lp_and_cache):
+            x, = carry
+            lp, layer_cache = lp_and_cache
+            q, k, v = _block_qkv(x, lp, positions, dtype)  # [b,c,h,dh]
+            new_cache = jax.lax.dynamic_update_slice(
+                layer_cache, jnp.stack([k, v]).astype(layer_cache.dtype),
+                (0, 0, pos0, 0, 0))
+            ck, cv = new_cache[0], new_cache[1]            # [b,S,h,dh]
+            scores = jnp.einsum("bqhc,bshc->bhqs",
+                                q.astype(jnp.float32),
+                                ck.astype(jnp.float32))
+            scores = scores * cfg.head_dim ** -0.5
+            slots = jnp.arange(s_max)
+            # query i (global position pos0+i) sees slots <= pos0+i
+            mask = slots[None, None, None, :] <= (
+                pos0 + jnp.arange(c))[None, None, :, None]
+            scores = jnp.where(mask, scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1)
+            a = jnp.einsum("bhqs,bshc->bqhc", probs,
+                           cv.astype(jnp.float32)).astype(dtype)
+            x = _block_tail(x, a, lp, cfg)
+            return (x,), new_cache
+
+        (x,), new_cache = lax.scan(layer, (x,), (layer_params, cache))
+        x = _rmsnorm(x, params["ln_f"])
+        logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
+                            params["embed"])
+        return logits, new_cache
+
+    return chunk
+
+
 def build_prefill(cfg: TransformerConfig,
                   max_seq: Optional[int] = None,
                   attention_fn: Optional[Callable] = None) -> Callable:
@@ -352,6 +414,38 @@ def build_greedy_stream_step(cfg: TransformerConfig,
     return step
 
 
+def make_sampler(vocab: int, temperature: float = 1.0,
+                 top_k: int = 0) -> Callable:
+    """The ONE sampling function: ``sample(logits[n, vocab],
+    keys[uint32 n, 2]) -> (tokens[int32 n], new_keys[n, 2])`` — rows draw
+    independently with their own threefry key, so results never depend on
+    which other rows share the batch. ``temperature<=0`` degrades to
+    greedy (keys pass through untouched); ``top_k>0`` restricts sampling
+    to the k highest logits. Shared by the repo-loop sampled step and the
+    serving engine so their sampling math can never diverge."""
+
+    def sample(logits, keys):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), keys
+        scaled = logits / temperature
+        if top_k > 0:
+            k = min(top_k, vocab)  # over-asking means "no restriction"
+            kth = jax.lax.top_k(scaled, k)[0][:, -1:]
+            scaled = jnp.where(scaled >= kth, scaled, -1e30)
+
+        def row(key_row, logit_row):
+            kk = jax.random.wrap_key_data(
+                jnp.asarray(key_row, jnp.uint32), impl="threefry2x32")
+            kk, sub = jax.random.split(kk)
+            tok = jax.random.categorical(sub, logit_row)
+            return jax.random.key_data(kk), tok
+
+        new_keys, toks = jax.vmap(row)(keys, scaled)
+        return toks.astype(jnp.int32), new_keys
+
+    return sample
+
+
 def build_sample_stream_step(cfg: TransformerConfig,
                              max_seq: Optional[int] = None,
                              temperature: float = 1.0,
@@ -359,26 +453,17 @@ def build_sample_stream_step(cfg: TransformerConfig,
     """Sampled decode step for the repo loop: ``step(params, token, cache,
     pos, key[uint32 2]) -> (next_token, cache, pos+1, next_key)`` — the
     PRNG key rides the state tuple like the cache does, so streaming stays
-    deterministic given the seed. ``temperature<=0`` degrades to greedy;
-    ``top_k>0`` restricts sampling to the k highest logits."""
+    deterministic given the seed. Sampling math is :func:`make_sampler`
+    with one row."""
     decode = build_decode_step(cfg, max_seq)
+    sample = make_sampler(cfg.vocab, temperature, top_k)
 
     def step(params, token, cache, pos, key):
         logits, cache2 = decode(params, token.reshape(1).astype(jnp.int32),
                                 cache, pos.reshape(()).astype(jnp.int32))
-        if temperature <= 0.0:
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return nxt, cache2, pos + 1, key
-        scaled = logits / temperature
-        if top_k > 0:
-            k = min(top_k, cfg.vocab)  # over-asking means "no restriction"
-            kth = jax.lax.top_k(scaled, k)[0][:, -1:]
-            scaled = jnp.where(scaled >= kth, scaled, -1e30)
-        key = jnp.asarray(key, jnp.uint32).reshape(2)
-        key, sub = jax.random.split(
-            jax.random.wrap_key_data(key, impl="threefry2x32"))
-        nxt = jax.random.categorical(sub, scaled, axis=-1).astype(jnp.int32)
-        return nxt, cache2, pos + 1, jax.random.key_data(key)
+        nxt, keys = sample(logits,
+                           jnp.asarray(key, jnp.uint32).reshape(1, 2))
+        return nxt, cache2, pos + 1, keys.reshape(2)
 
     return step
 

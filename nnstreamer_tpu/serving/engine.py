@@ -170,30 +170,14 @@ class ContinuousBatchingEngine:
             "slot_steps": 0, "active_slot_steps": 0,
         }
 
-        V = cfg.vocab
-        temp, top_k_, K = self.temperature, self.top_k, self.K
+        from nnstreamer_tpu.models.transformer import make_sampler
+
+        K = self.K
         decode = self._decode
-
-        def sample(logits, key):
-            """[n, V] logits (+ per-row keys [n, 2]) → [n] token ids.
-            Shared by prefill seeding and the dispatch loop so the first
-            token and all later ones use identical sampling math."""
-            if temp <= 0.0:
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32), key
-            scaled = logits / temp
-            if top_k_ > 0:
-                k = min(top_k_, V)
-                kth = jax.lax.top_k(scaled, k)[0][:, -1:]
-                scaled = jnp.where(scaled >= kth, scaled, -1e30)
-
-            def row(key_row, logit_row):
-                kk = jax.random.wrap_key_data(key_row, impl="threefry2x32")
-                kk, sub = jax.random.split(kk)
-                tok = jax.random.categorical(sub, logit_row)
-                return jax.random.key_data(kk), tok
-
-            new_keys, toks = jax.vmap(row)(key, scaled)
-            return toks.astype(jnp.int32), new_keys
+        # the ONE sampling function (shared with the repo-loop sampled
+        # step) — seeds the first token and every dispatch-loop draw with
+        # identical math, per-row keys keeping streams batch-independent
+        sample = make_sampler(cfg.vocab, self.temperature, self.top_k)
 
         def dispatch(params, token, cache, pos, keys):
             """K decode steps in one program: ([B],cache,[B],[B,2]) →
@@ -236,6 +220,13 @@ class ContinuousBatchingEngine:
         self._wake.set()
         if self._thread is not None:
             self._thread.join(timeout=30)
+            if self._thread.is_alive():
+                # stuck in a long compile/dispatch: keep the thread ref so
+                # a later start() can't spawn a concurrent second loop,
+                # and leave stream state to the still-running loop
+                log.warning("serving: engine loop still busy at stop(); "
+                            "call stop() again after it settles")
+                return
             self._thread = None
         # fail any stream still in flight so iterators don't hang
         for i, st in enumerate(self._slots):
@@ -252,6 +243,10 @@ class ContinuousBatchingEngine:
     def submit(self, prompt, max_new_tokens: int = 64) -> GenerationStream:
         """Queue a prompt (sequence of int token ids); returns a
         :class:`GenerationStream` yielding generated ids."""
+        if self._thread is None or self._stop_evt.is_set():
+            raise RuntimeError(
+                "serving: engine is not running — call start() first "
+                "(a submit with no loop thread would never complete)")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("serving: empty prompt")
@@ -317,15 +312,17 @@ class ContinuousBatchingEngine:
         self._post_emit(slot, first)
 
     def _post_emit(self, slot: int, tok: int):
-        """Budget/EOS bookkeeping after a token reaches its stream."""
+        """Budget/EOS bookkeeping after a token reaches its stream. The
+        slot is freed BEFORE _finish wakes the client, so a caller that
+        observes its stream done also observes the slot released."""
         st = self._slots[slot]
         self._budget[slot] -= 1
         if self.eos_id is not None and tok == self.eos_id:
+            self._slots[slot] = None
             st._finish("eos")
-            self._slots[slot] = None
         elif self._budget[slot] <= 0:
-            st._finish("length")
             self._slots[slot] = None
+            st._finish("length")
 
     def _loop(self):
         jnp = self._jnp

@@ -150,3 +150,11 @@ def test_stop_finishes_inflight_streams():
     s = eng.submit([1, 2, 3], max_new_tokens=10_000_000)
     eng.stop()
     assert s.finished
+    with pytest.raises(RuntimeError):
+        eng.submit([1, 2], max_new_tokens=4)  # stopped engine
+
+
+def test_submit_before_start_rejected():
+    eng = ContinuousBatchingEngine(CFG, PARAMS, max_streams=1)
+    with pytest.raises(RuntimeError):
+        eng.submit([1, 2], max_new_tokens=4)

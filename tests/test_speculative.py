@@ -1,0 +1,156 @@
+"""Speculative decoding (models/speculative.py) + chunked KV decode.
+
+The hard invariant: speculative greedy output is TOKEN-IDENTICAL to
+target-only greedy decode — speculation may only change the schedule.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from nnstreamer_tpu.models.speculative import (  # noqa: E402
+    SpeculativeDecoder,
+    build_speculative_round,
+    draft_from_target,
+)
+from nnstreamer_tpu.models.transformer import (  # noqa: E402
+    TransformerConfig,
+    build_chunk_decode,
+    build_decode_step,
+    build_prefill,
+    init_params,
+)
+
+TARGET = TransformerConfig(vocab=128, d_model=64, n_heads=4, n_layers=3,
+                           d_ff=128, max_seq=96, dtype=jnp.float32)
+DRAFT = TransformerConfig(vocab=128, d_model=32, n_heads=2, n_layers=1,
+                          d_ff=64, max_seq=96, dtype=jnp.float32)
+T_PARAMS = init_params(TARGET, seed=1)
+D_PARAMS = init_params(DRAFT, seed=2)
+
+
+def target_greedy(prompt, n_tokens):
+    prefill = jax.jit(build_prefill(TARGET))
+    decode = jax.jit(build_decode_step(TARGET))
+    logits, cache = prefill(T_PARAMS,
+                            jnp.asarray(np.asarray(prompt, np.int32)[None]))
+    out = [int(jnp.argmax(logits[0]))]
+    tok = jnp.asarray([out[0]], jnp.int32)
+    pos = jnp.asarray(len(prompt), jnp.int32)
+    for _ in range(n_tokens - 1):
+        logits, cache = decode(T_PARAMS, tok, cache, pos)
+        out.append(int(jnp.argmax(logits[0])))
+        tok = jnp.asarray([out[-1]], jnp.int32)
+        pos = pos + 1
+    return out
+
+
+def test_chunk_decode_matches_sequential_steps():
+    """One c-token chunk pass == c single-token steps (logits + cache)."""
+    prefill = jax.jit(build_prefill(TARGET))
+    decode = jax.jit(build_decode_step(TARGET))
+    chunk = jax.jit(build_chunk_decode(TARGET))
+    prompt = jnp.asarray([[3, 1, 4, 1, 5]], jnp.int32)
+    _, cache_a = prefill(T_PARAMS, prompt)
+    _, cache_b = prefill(T_PARAMS, prompt)
+    toks = jnp.asarray([[9, 2, 6, 5]], jnp.int32)
+    chunk_logits, cache_a = chunk(T_PARAMS, toks, cache_a, 5)
+    seq_logits = []
+    for i in range(4):
+        lg, cache_b = decode(T_PARAMS, toks[:, i], cache_b,
+                             jnp.asarray(5 + i, jnp.int32))
+        seq_logits.append(lg)
+    seq_logits = jnp.stack(seq_logits, axis=1)
+    np.testing.assert_allclose(np.asarray(chunk_logits),
+                               np.asarray(seq_logits), rtol=2e-5,
+                               atol=2e-5)
+    np.testing.assert_allclose(np.asarray(cache_a), np.asarray(cache_b),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("gamma", [1, 3, 5])
+def test_speculative_matches_target_greedy(gamma):
+    prompt = [7, 21, 9, 63, 2]
+    ref = target_greedy(prompt, 24)
+    dec = SpeculativeDecoder(TARGET, T_PARAMS, DRAFT, D_PARAMS,
+                             gamma=gamma)
+    assert dec.generate(prompt, max_new_tokens=24) == ref
+    assert dec.stats["rounds"] >= 1
+
+
+def test_perfect_draft_accepts_everything():
+    """Draft == target: every round must emit γ+1 tokens — exercising the
+    full-acceptance path (incl. the d_γ draft-cache write)."""
+    prompt = [5, 8, 13]
+    ref = target_greedy(prompt, 21)
+    dec = SpeculativeDecoder(TARGET, T_PARAMS, TARGET, T_PARAMS, gamma=4)
+    got = dec.generate(prompt, max_new_tokens=21)
+    assert got == ref
+    assert dec.mean_accepted == pytest.approx(5.0)  # γ+1 per round
+
+
+def test_speculative_respects_cache_window():
+    """Generation stops before a round's writes would spill past S."""
+    prompt = list(range(1, 80))  # 79 of S=96
+    dec = SpeculativeDecoder(TARGET, T_PARAMS, DRAFT, D_PARAMS, gamma=6)
+    got = dec.generate(prompt, max_new_tokens=64)
+    ref = target_greedy(prompt, len(got))
+    assert got == ref
+    assert 1 <= len(got) < 64
+
+
+def test_self_speculative_draft_matches_target_greedy():
+    """Depth-pruned draft (target's first layer + shared embed) must
+    still be exact — and typically accepts more than a random draft."""
+    d_cfg, d_params = draft_from_target(TARGET, T_PARAMS, 1)
+    prompt = [11, 3, 77, 19]
+    ref = target_greedy(prompt, 20)
+    dec = SpeculativeDecoder(TARGET, T_PARAMS, d_cfg, d_params, gamma=3,
+                             rounds_per_dispatch=3)
+    assert dec.generate(prompt, max_new_tokens=20) == ref
+    assert dec.mean_accepted >= 1.0
+
+
+def test_fused_generation_matches_target_greedy():
+    """The single-program while_loop path (fused=True) must be exact too,
+    and report acceptance stats."""
+    prompt = [7, 21, 9, 63, 2]
+    ref = target_greedy(prompt, 24)
+    dec = SpeculativeDecoder(TARGET, T_PARAMS, DRAFT, D_PARAMS, gamma=3)
+    got = dec.generate(prompt, max_new_tokens=24, fused=True)
+    assert got == ref
+    assert dec.stats["dispatches"] == 1
+    assert dec.stats["rounds"] >= 1
+    # window-limited fused run stays exact as well
+    long_prompt = list(range(1, 80))
+    got2 = dec.generate(long_prompt, max_new_tokens=64, fused=True)
+    assert got2 == target_greedy(long_prompt, len(got2))
+    assert 1 <= len(got2) < 64
+
+
+def test_multi_round_dispatch_counts():
+    """R rounds per dispatch: host syncs = ceil(rounds / R)."""
+    prompt = [2, 4, 6]
+    dec = SpeculativeDecoder(TARGET, T_PARAMS, DRAFT, D_PARAMS, gamma=2,
+                             rounds_per_dispatch=4)
+    got = dec.generate(prompt, max_new_tokens=16)
+    assert got == target_greedy(prompt, 16)
+    assert dec.stats["dispatches"] <= dec.stats["rounds"]
+    assert dec.stats["rounds"] <= dec.stats["dispatches"] * 4
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        build_speculative_round(
+            TARGET,
+            TransformerConfig(vocab=64, d_model=32, n_heads=2,
+                              n_layers=1, d_ff=64), gamma=2)
+    with pytest.raises(ValueError):
+        build_speculative_round(TARGET, DRAFT, gamma=0)
+    dec = SpeculativeDecoder(TARGET, T_PARAMS, DRAFT, D_PARAMS, gamma=2)
+    with pytest.raises(ValueError):
+        dec.generate([], max_new_tokens=4)
+    with pytest.raises(ValueError):
+        draft_from_target(TARGET, T_PARAMS, 0)
