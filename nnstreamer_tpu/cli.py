@@ -211,6 +211,9 @@ def main(argv=None) -> int:
     ap.add_argument("--scaffold", nargs=2, metavar=("KIND", "NAME"),
                     help="generate subplugin boilerplate "
                          "(filter|decoder|converter) and exit")
+    ap.add_argument("--dot", metavar="FILE",
+                    help="write the started pipeline graph (fused "
+                         "regions included) as Graphviz dot to FILE")
     args = ap.parse_args(argv)
 
     if args.confchk:
@@ -238,6 +241,11 @@ def main(argv=None) -> int:
 
     print(f"Setting pipeline to PLAYING ({len(pipe.elements)} elements)...")
     try:
+        if args.dot:
+            pipe.start()  # fusion happens at start; dump the real graph
+            with open(args.dot, "w") as f:
+                f.write(pipe.to_dot())
+            print(f"Wrote pipeline graph to {args.dot}")
         msg = pipe.run(timeout=args.timeout)
     except Exception as e:  # noqa: BLE001 — CLI reports any failure
         print(f"nns-launch: ERROR: {e}", file=sys.stderr)

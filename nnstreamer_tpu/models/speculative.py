@@ -59,6 +59,12 @@ def build_speculative_round(target_cfg: TransformerConfig,
     target's correction. Entries past ``n_emit`` are the speculative
     garbage the caller must ignore.
 
+    Batch must be 1 (checked at trace time): the accept decision is a
+    single prefix length, and rows with different acceptance would need
+    per-row positions through the chunk verify. Run independent
+    SpeculativeDecoder instances (or the serving engine) for parallel
+    streams.
+
     Vocabularies must match; the draft is typically 4-10x smaller.
     """
     if target_cfg.vocab != draft_cfg.vocab:
@@ -73,6 +79,11 @@ def build_speculative_round(target_cfg: TransformerConfig,
 
     def spec_round(target_params, draft_params, last_tok, target_cache,
                    draft_cache, pos):
+        if last_tok.shape[0] != 1:
+            raise ValueError(
+                f"speculative: batch must be 1 (got {last_tok.shape[0]}) "
+                "— the accept prefix is a single length; run one decoder "
+                "per stream")
         pos = jnp.asarray(pos, jnp.int32)
 
         def draft_body(carry, _):
@@ -99,8 +110,7 @@ def build_speculative_round(target_cfg: TransformerConfig,
         target_toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
         # longest prefix where every draft matches the target's choice
-        # (batch row 0 decides — speculative rounds run lock-step, and
-        # the engine uses b=1 streams)
+        # (b == 1, enforced above)
         match = drafts[0] == target_toks[0, :gamma]        # [γ]
         n_acc = jnp.argmin(jnp.concatenate(
             [match, jnp.asarray([False])]).astype(jnp.int32))

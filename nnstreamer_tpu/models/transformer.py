@@ -122,6 +122,29 @@ def _block_tail(x, a, lp, cfg):
     return x + _dense_ffn(h2, lp["w_in"], lp["w_out"], dtype)
 
 
+def _attend_cache(q, ck, cv, mask, head_dim, dtype):
+    """The ONE cached-attention numeric core shared by single-token decode
+    and chunk decode: fp32 scores (same scale FORM as attention_reference,
+    flash_attention.py:45), fp32 softmax AND fp32 probs×values, rounding
+    only the final output — bit-matches the full forward so greedy
+    decode/forward parity holds in bfloat16 configs too."""
+    scores = jnp.einsum("bqhc,bshc->bhqs", q.astype(jnp.float32),
+                        ck.astype(jnp.float32))
+    scores = scores * head_dim ** -0.5
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqs,bshc->bqhc", probs,
+                      cv.astype(jnp.float32)).astype(dtype)
+
+
+def _final_logits(x, params):
+    """Final rmsnorm + tied-embedding projection, shared by every forward
+    variant so logit math can never diverge between them."""
+    x = _rmsnorm(x, params["ln_f"])
+    return jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
+                      params["embed"])
+
+
 def _moe_ffn(x, router, w_in, w_out, dtype):
     """Top-1 routed MoE: expert axis shards over mesh axis ``ep`` (the
     one-hot dispatch einsum lets GSPMD all-to-all tokens to experts)."""
@@ -184,10 +207,7 @@ def build_forward(cfg: TransformerConfig,
         layer_params = {k: v for k, v in params.items()
                         if k not in ("embed", "ln_f")}
         (x, _), _ = lax.scan(layer_body, (x, positions), layer_params)
-        x = _rmsnorm(x, params["ln_f"])
-        logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
-                            params["embed"])
-        return logits
+        return _final_logits(x, params)
 
     return apply_fn
 
@@ -255,31 +275,16 @@ def build_decode_step(cfg: TransformerConfig,
             q, k, v = _block_qkv(x, lp, positions, dtype)  # [b,1,h,dh]
             new_cache = write_cache(
                 layer_cache, jnp.stack([k, v]).astype(layer_cache.dtype))
-            ck, cv = new_cache[0], new_cache[1]           # [b,S,h,dh]
-            scores = jnp.einsum("bqhc,bshc->bhqs",
-                                q.astype(jnp.float32),
-                                ck.astype(jnp.float32))
-            # same scale FORM as attention_reference (flash_attention.py:45)
-            # so the fp32 arithmetic bit-matches the full forward's
-            scores = scores * cfg.head_dim ** -0.5
             slots = jnp.arange(s_max)
             mask = slots[None, None, None, :] <= (
                 pos_c[:, None, None, None] if per_stream else pos_c)
-            scores = jnp.where(mask, scores, -1e30)
-            # fp32 softmax AND fp32 probs×values, rounding only the final
-            # output — bit-matches attention_reference so decode/forward
-            # greedy parity holds in bfloat16 configs too
-            probs = jax.nn.softmax(scores, axis=-1)
-            a = jnp.einsum("bhqs,bshc->bqhc", probs,
-                           cv.astype(jnp.float32)).astype(dtype)
+            a = _attend_cache(q, new_cache[0], new_cache[1], mask,
+                              cfg.head_dim, dtype)
             x = _block_tail(x, a, lp, cfg)
             return (x,), new_cache
 
         (x,), new_cache = lax.scan(layer, (x,), (layer_params, cache))
-        x = _rmsnorm(x, params["ln_f"])
-        logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
-                            params["embed"])
-        return logits[:, 0], new_cache
+        return _final_logits(x, params)[:, 0], new_cache
 
     return step
 
@@ -321,27 +326,17 @@ def build_chunk_decode(cfg: TransformerConfig,
             new_cache = jax.lax.dynamic_update_slice(
                 layer_cache, jnp.stack([k, v]).astype(layer_cache.dtype),
                 (0, 0, pos0, 0, 0))
-            ck, cv = new_cache[0], new_cache[1]            # [b,S,h,dh]
-            scores = jnp.einsum("bqhc,bshc->bhqs",
-                                q.astype(jnp.float32),
-                                ck.astype(jnp.float32))
-            scores = scores * cfg.head_dim ** -0.5
             slots = jnp.arange(s_max)
             # query i (global position pos0+i) sees slots <= pos0+i
             mask = slots[None, None, None, :] <= (
                 pos0 + jnp.arange(c))[None, None, :, None]
-            scores = jnp.where(mask, scores, -1e30)
-            probs = jax.nn.softmax(scores, axis=-1)
-            a = jnp.einsum("bhqs,bshc->bqhc", probs,
-                           cv.astype(jnp.float32)).astype(dtype)
+            a = _attend_cache(q, new_cache[0], new_cache[1], mask,
+                              cfg.head_dim, dtype)
             x = _block_tail(x, a, lp, cfg)
             return (x,), new_cache
 
         (x,), new_cache = lax.scan(layer, (x,), (layer_params, cache))
-        x = _rmsnorm(x, params["ln_f"])
-        logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
-                            params["embed"])
-        return logits, new_cache
+        return _final_logits(x, params), new_cache
 
     return chunk
 

@@ -258,6 +258,13 @@ class Pipeline:
     def get(self, name: str) -> Element:
         return self.by_name[name]
 
+    def to_dot(self) -> str:
+        """Graphviz dot text of the current runtime graph (fused regions
+        as clusters) — pipeline/dot.py."""
+        from nnstreamer_tpu.pipeline.dot import pipeline_to_dot
+
+        return pipeline_to_dot(self)
+
     # -- state ----------------------------------------------------------------
     def start(self) -> "Pipeline":
         """NULL→PLAYING: start all elements (non-sources first so queues and
@@ -279,6 +286,11 @@ class Pipeline:
         for el in sources:
             el.start()
         self.state = State.PLAYING
+        # GST_DEBUG_DUMP_DOT_DIR equivalent (pipeline/dot.py) — after
+        # fusion so the dump shows the regions that will actually run
+        from nnstreamer_tpu.pipeline.dot import maybe_dump_dot
+
+        maybe_dump_dot(self)
         self._eos_pending = len(sources)
         for src in sources:
             t = threading.Thread(

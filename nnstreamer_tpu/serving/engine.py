@@ -228,25 +228,24 @@ class ContinuousBatchingEngine:
                             "call stop() again after it settles")
                 return
             self._thread = None
-        # fail any stream still in flight so iterators don't hang
-        for i, st in enumerate(self._slots):
-            if st is not None and not st.finished:
-                st._finish("engine-stopped")
-                self._slots[i] = None
-        while True:
-            try:
-                req = self._pending.get_nowait()
-            except _queue.Empty:
-                break
-            req.stream._finish("engine-stopped")
+        # fail any stream still in flight so iterators don't hang; the
+        # lock serializes with submit()'s running-check + enqueue, so a
+        # request can't slip into _pending after this drain
+        with self._lock:
+            for i, st in enumerate(self._slots):
+                if st is not None and not st.finished:
+                    st._finish("engine-stopped")
+                    self._slots[i] = None
+            while True:
+                try:
+                    req = self._pending.get_nowait()
+                except _queue.Empty:
+                    break
+                req.stream._finish("engine-stopped")
 
     def submit(self, prompt, max_new_tokens: int = 64) -> GenerationStream:
         """Queue a prompt (sequence of int token ids); returns a
         :class:`GenerationStream` yielding generated ids."""
-        if self._thread is None or self._stop_evt.is_set():
-            raise RuntimeError(
-                "serving: engine is not running — call start() first "
-                "(a submit with no loop thread would never complete)")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("serving: empty prompt")
@@ -259,11 +258,18 @@ class ContinuousBatchingEngine:
                 f"serving: prompt length {prompt.size} must be < cache "
                 f"length {self.S}")
         with self._lock:
+            # running-check + enqueue under the same lock stop() drains
+            # under, so a request can't land after the drain (it would
+            # never be admitted or finished)
+            if self._thread is None or self._stop_evt.is_set():
+                raise RuntimeError(
+                    "serving: engine is not running — call start() first "
+                    "(a submit with no loop thread would never complete)")
             sid = self._next_id
             self._next_id += 1
-        stream = GenerationStream(sid, prompt.size)
-        self._pending.put(_PendingRequest(prompt, int(max_new_tokens),
-                                          stream))
+            stream = GenerationStream(sid, prompt.size)
+            self._pending.put(_PendingRequest(prompt, int(max_new_tokens),
+                                              stream))
         self._wake.set()
         return stream
 

@@ -1,0 +1,86 @@
+"""Pipeline dot dumps (pipeline/dot.py) — the GST_DEBUG_DUMP_DOT_DIR
+equivalent, including fused-region clusters."""
+
+import os
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import parse_launch
+from nnstreamer_tpu.cli import main as cli_main
+from nnstreamer_tpu.filters.jax_backend import (
+    register_jax_model,
+    unregister_jax_model,
+)
+from nnstreamer_tpu.tensors.types import TensorInfo, TensorsInfo, TensorType
+
+DESC = ("videotestsrc num-buffers=2 width=8 height=8 ! tensor_converter ! "
+        "tensor_transform mode=typecast option=float32 ! "
+        "tensor_sink name=out")
+
+
+def test_to_dot_lists_elements_and_links():
+    pipe = parse_launch(DESC)
+    dot = pipe.to_dot()
+    for name in ("videotestsrc", "tensor_converter", "tensor_transform",
+                 "out"):
+        assert name in dot
+    assert dot.count("->") >= 3
+    assert dot.strip().startswith("digraph")
+
+
+@pytest.fixture
+def fusible_model():
+    import jax.numpy as jnp
+
+    def fn(params, x):
+        return x * params
+
+    info = TensorsInfo([TensorInfo(dim=(4,), type=TensorType.FLOAT32)])
+    register_jax_model("dot_scale", fn, jnp.asarray(2.0, jnp.float32),
+                       in_info=info, out_info=info)
+    yield "dot_scale"
+    unregister_jax_model("dot_scale")
+
+
+def test_started_dot_shows_fused_region_cluster(fusible_model):
+    pipe = parse_launch(
+        "appsrc name=src ! "
+        "tensor_transform mode=arithmetic option=typecast:float32,add:1 ! "
+        f"tensor_filter framework=jax model={fusible_model} ! "
+        "tensor_sink name=out")
+    pipe.start()
+    try:
+        dot = pipe.to_dot()
+    finally:
+        pipe.get("src").end_of_stream()
+        pipe.stop()
+    assert "subgraph cluster_" in dot
+    assert "fused region" in dot
+
+
+def test_env_dump_writes_file_on_start(tmp_path, monkeypatch):
+    monkeypatch.setenv("NNSTPU_DUMP_DOT_DIR", str(tmp_path))
+    pipe = parse_launch(DESC)
+    msg = pipe.run(timeout=30)
+    assert msg is not None and msg.kind == "eos"
+    dumps = [p for p in os.listdir(tmp_path) if p.endswith(".playing.dot")]
+    assert len(dumps) == 1
+    assert "digraph" in (tmp_path / dumps[0]).read_text()
+
+
+def test_cli_dot_flag(tmp_path):
+    out = tmp_path / "graph.dot"
+    rc = cli_main(["-q", "--dot", str(out), DESC])
+    assert rc == 0
+    text = out.read_text()
+    assert "digraph" in text and "tensor_converter" in text
+
+
+def test_dump_failure_does_not_break_pipeline(monkeypatch, tmp_path):
+    blocker = tmp_path / "not-a-dir"
+    blocker.write_text("")  # a FILE where a dir is needed → makedirs fails
+    monkeypatch.setenv("NNSTPU_DUMP_DOT_DIR", str(blocker))
+    pipe = parse_launch(DESC)
+    msg = pipe.run(timeout=30)
+    assert msg is not None and msg.kind == "eos"
