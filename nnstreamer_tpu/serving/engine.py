@@ -119,6 +119,13 @@ class ContinuousBatchingEngine:
         -bounded only).
     seed: engine PRNG seed; per-stream keys fold in the stream id.
     min_bucket: smallest prefill padding bucket.
+    mesh: optional ``jax.sharding.Mesh`` — multi-chip serving. Params
+        shard per ``parallel.sharded.transformer_param_specs`` (heads/ffn
+        over ``tp``), the KV cache shards batch slots over ``dp`` and
+        heads over ``tp``, and GSPMD propagates through the unchanged
+        decode/prefill programs ("computation follows data") — batched
+        decode collectives ride ICI, never the host. Requires
+        ``max_streams % dp == 0`` and ``n_heads % tp == 0``.
     """
 
     def __init__(self, cfg, params, max_streams: int = 4,
@@ -126,7 +133,7 @@ class ContinuousBatchingEngine:
                  steps_per_dispatch: int = 8,
                  temperature: float = 0.0, top_k: int = 0,
                  eos_id: Optional[int] = None, seed: int = 0,
-                 min_bucket: int = 16):
+                 min_bucket: int = 16, mesh=None):
         import jax
         import jax.numpy as jnp
 
@@ -157,7 +164,43 @@ class ContinuousBatchingEngine:
         self._slots: List[Optional[GenerationStream]] = [None] * self.B
         self._budget = np.zeros(self.B, np.int64)  # tokens still allowed
 
-        self._init_cache = lambda: init_cache(cfg, self.B, self.S)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from nnstreamer_tpu.parallel.sharded import (
+                transformer_param_specs,
+            )
+
+            def axis(name, dim, total):
+                if name not in mesh.axis_names or mesh.shape[name] <= 1:
+                    return None
+                if total % mesh.shape[name]:
+                    raise ValueError(
+                        f"serving: {dim} ({total}) must divide by mesh "
+                        f"axis {name!r} ({mesh.shape[name]})")
+                return name
+
+            dp = axis("dp", "max_streams", self.B)
+            tp = axis("tp", "n_heads", cfg.n_heads)
+
+            def prune(spec):
+                # drop axis names the mesh doesn't have (e.g. a dp-only
+                # serving mesh has no "tp"; a dense model's mesh no "ep")
+                # — absent axis = replicated on that dimension
+                return P(*(a if (a is not None and a in mesh.axis_names)
+                           else None for a in spec))
+
+            specs = transformer_param_specs(cfg)
+            self.params = {
+                k: jax.device_put(v, NamedSharding(mesh, prune(specs[k])))
+                for k, v in params.items()
+            }
+            cache_sh = NamedSharding(
+                mesh, P(None, None, dp, None, tp, None))
+            self._init_cache = lambda: jax.device_put(
+                init_cache(cfg, self.B, self.S), cache_sh)
+        else:
+            self._init_cache = lambda: init_cache(cfg, self.B, self.S)
         self._cache = self._init_cache()
         self._pending: "_queue.Queue[_PendingRequest]" = _queue.Queue()
         self._next_id = 0

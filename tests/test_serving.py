@@ -154,6 +154,50 @@ def test_stop_finishes_inflight_streams():
         eng.submit([1, 2], max_new_tokens=4)  # stopped engine
 
 
+def test_sharded_engine_matches_unsharded():
+    """Multi-chip serving: a dp=2 × tp=2 mesh engine must emit exactly
+    what the single-device engine does (GSPMD may not change results)."""
+    from nnstreamer_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh([("dp", 2), ("tp", 2)])
+    eng = ContinuousBatchingEngine(
+        CFG, PARAMS, max_streams=4, steps_per_dispatch=4,
+        temperature=0.0, mesh=mesh).start()
+    try:
+        prompts = [[4, 8, 15], [16, 23, 9], [7, 7], [1, 2, 3, 4, 5]]
+        streams = [eng.submit(p, max_new_tokens=7) for p in prompts]
+        results = [s.result(timeout=240) for s in streams]
+    finally:
+        eng.stop()
+    for p, got in zip(prompts, results):
+        assert got == reference_greedy(p, 7), f"prompt={p}"
+
+
+def test_dp_only_mesh_serving():
+    """A mesh with no tp axis (pure data-parallel serving) must work —
+    param specs naming absent axes are pruned to replicated."""
+    from nnstreamer_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh([("dp", 2)])
+    eng = ContinuousBatchingEngine(
+        CFG, PARAMS, max_streams=2, steps_per_dispatch=4,
+        temperature=0.0, mesh=mesh).start()
+    try:
+        got = eng.generate([5, 11, 23, 42, 7], max_new_tokens=6,
+                           timeout=240)
+    finally:
+        eng.stop()
+    assert got == reference_greedy([5, 11, 23, 42, 7], 6)
+
+
+def test_sharded_engine_validates_divisibility():
+    from nnstreamer_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh([("dp", 1), ("tp", 8)])  # CFG.n_heads == 4
+    with pytest.raises(ValueError):
+        ContinuousBatchingEngine(CFG, PARAMS, max_streams=4, mesh=mesh)
+
+
 def test_submit_before_start_rejected():
     eng = ContinuousBatchingEngine(CFG, PARAMS, max_streams=1)
     with pytest.raises(RuntimeError):
