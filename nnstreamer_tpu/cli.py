@@ -242,12 +242,15 @@ def main(argv=None) -> int:
     print(f"Setting pipeline to PLAYING ({len(pipe.elements)} elements)...")
     try:
         if args.dot:
-            pipe.start()  # fusion happens at start; dump the real graph
+            # open BEFORE start so a bad path fails with nothing running;
+            # fusion happens at start, so the dump shows the real graph
             with open(args.dot, "w") as f:
+                pipe.start()
                 f.write(pipe.to_dot())
             print(f"Wrote pipeline graph to {args.dot}")
         msg = pipe.run(timeout=args.timeout)
     except Exception as e:  # noqa: BLE001 — CLI reports any failure
+        pipe.stop()  # idempotent; reaps anything --dot start()ed
         print(f"nns-launch: ERROR: {e}", file=sys.stderr)
         return 1
     if msg is None:

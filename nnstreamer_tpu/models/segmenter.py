@@ -1,0 +1,95 @@
+"""Semantic segmentation model — feeds the image_segment decoder.
+
+The reference decodes segmentation model outputs with its image_segment
+subplugin (/root/reference/ext/nnstreamer/tensor_decoder/
+tensordec-imagesegment.c) but ships no in-tree model; pipelines load
+tflite deeplab builds. Here the model family is native flax — an
+FCN/U-Net-style encoder-decoder sized for streaming, with TPU choices
+matching the rest of the zoo (models/mobilenet_v2.py): NHWC, channels in
+multiples of 8 for clean MXU tiling, bf16 activations with fp32 conv
+accumulation, static shapes, per-pixel class logits at input resolution
+(the image_segment decoder's expected layout, [b, H, W, classes]).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from nnstreamer_tpu.tensors.types import TensorsInfo
+
+
+class _ConvBlock(nn.Module):
+    ch: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.ch, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=True, dtype=self.dtype)(x)
+        return nn.relu(x)
+
+
+class Segmenter(nn.Module):
+    """Encoder-decoder FCN with skip connections (U-Net shape, sized for
+    streaming video rather than medical imagery)."""
+
+    num_classes: int = 21  # VOC-style default
+    base: int = 32         # stem width; doubles per stage
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        skips = []
+        ch = self.base
+        for _ in range(3):                     # encoder: /2 per stage
+            x = _ConvBlock(ch, self.dtype)(x)
+            skips.append(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            ch *= 2
+        x = _ConvBlock(ch, self.dtype)(x)      # bottleneck
+        for skip in reversed(skips):           # decoder: ×2 per stage
+            ch //= 2
+            b, h, w, _ = skip.shape
+            x = jax.image.resize(x, (b, h, w, x.shape[-1]), "nearest")
+            x = nn.Conv(ch, (1, 1), use_bias=False, dtype=self.dtype)(x)
+            x = jnp.concatenate([x, skip], axis=-1)
+            x = _ConvBlock(ch, self.dtype)(x)
+        x = nn.Conv(self.num_classes, (1, 1), dtype=self.dtype)(x)
+        return x.astype(jnp.float32)           # [b, H, W, classes]
+
+
+def segmenter(num_classes: int = 21, base: int = 32, image_size: int = 256,
+              batch: int = 1, dtype=jnp.bfloat16, seed: int = 0
+              ) -> Tuple[Callable, Any, TensorsInfo, TensorsInfo]:
+    """Factory: (apply_fn, params, in_info, out_info).
+
+    Input float32 NHWC (preprocessing belongs to tensor_transform, as in
+    the reference pipelines); output per-pixel class logits that
+    ``tensor_decoder mode=image_segment`` argmaxes on device.
+    ``image_size`` must be divisible by 8 (three /2 encoder stages).
+    """
+    if image_size % 8:
+        raise ValueError(
+            f"segmenter: image_size must be divisible by 8, got "
+            f"{image_size}")
+    model = Segmenter(num_classes=num_classes, base=base, dtype=dtype)
+    rng = jax.random.PRNGKey(seed)
+    dummy = jnp.zeros((batch, image_size, image_size, 3), jnp.float32)
+    from nnstreamer_tpu.models._init import fast_init
+
+    variables = fast_init(model.init, rng, dummy, seed=seed)
+
+    def apply_fn(params, x):
+        return model.apply(params, x)
+
+    in_info = TensorsInfo.from_str(
+        f"3:{image_size}:{image_size}:{batch}", "float32")
+    out_info = TensorsInfo.from_str(
+        f"{num_classes}:{image_size}:{image_size}:{batch}", "float32")
+    return apply_fn, variables, in_info, out_info

@@ -273,6 +273,51 @@ def test_yolo_detector_pipeline():
         unregister_jax_model("yolo_t")
 
 
+def test_segmenter_pipeline():
+    """Segmenter model → image_segment decoder end-to-end: per-pixel
+    logits argmax on device, RGBA overlay + label map on host."""
+    import jax.numpy as jnp
+    import pytest
+
+    from nnstreamer_tpu import parse_launch
+    from nnstreamer_tpu.filters.jax_backend import (
+        register_jax_model,
+        unregister_jax_model,
+    )
+    from nnstreamer_tpu.models.segmenter import segmenter
+
+    size, classes = 32, 5
+    apply_fn, params, in_info, out_info = segmenter(
+        num_classes=classes, base=8, image_size=size, batch=1,
+        dtype=jnp.float32)
+    assert tuple(out_info[0].shape) == (1, size, size, classes)
+
+    def net(p, x):
+        return apply_fn(p, (x.astype(jnp.float32) - 127.5) / 127.5)
+
+    register_jax_model("seg_t", net, params)
+    try:
+        pipe = parse_launch(
+            f"videotestsrc num-buffers=2 width={size} height={size} "
+            "pattern=gradient ! tensor_converter ! "
+            "tensor_filter framework=jax model=seg_t ! "
+            "tensor_decoder mode=image_segment ! "
+            "tensor_sink name=out to-host=true")
+        msg = pipe.run(timeout=120)
+        assert msg is not None and msg.kind == "eos", msg
+        outs = pipe.get("out").buffers
+        assert len(outs) == 2
+        rgba = np.asarray(outs[0].tensors[0])
+        assert rgba.shape == (size, size, 4)
+        labels = outs[0].meta["segment_labels"]
+        assert labels.shape == (size, size)
+        assert int(labels.max()) < classes
+    finally:
+        unregister_jax_model("seg_t")
+    with pytest.raises(ValueError):
+        segmenter(image_size=30)  # not divisible by 8
+
+
 class TestMultihost:
     """Single-process behavior of the multi-host bootstrap (the real
     multi-process path reuses jax.distributed; here we pin the no-op and
