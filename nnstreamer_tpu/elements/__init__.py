@@ -27,6 +27,7 @@ from nnstreamer_tpu.elements import repo  # noqa: F401
 from nnstreamer_tpu.elements import sparse  # noqa: F401
 from nnstreamer_tpu.elements import quant  # noqa: F401
 from nnstreamer_tpu.elements import query  # noqa: F401
+from nnstreamer_tpu.elements import lm_serve  # noqa: F401
 from nnstreamer_tpu.elements import pubsub  # noqa: F401
 
 from nnstreamer_tpu.elements import grpc_io  # noqa: F401 (grpcio itself

@@ -1,0 +1,208 @@
+"""tensor_lm_serve — distributed LM serving over the query transport.
+
+Drops the continuous-batching engine (serving/engine.py) into the query
+server topology the reference uses for offload
+(/root/reference/gst/nnstreamer/tensor_query/tensor_query_server.c):
+
+    tensor_query_serversrc ! tensor_lm_serve engine=E ! tensor_query_serversink
+
+Each arriving buffer is a prompt (int32 ids, flattened); the element
+submits it to the shared engine and returns ONE completion buffer (the
+generated ids) when the stream finishes. Unlike the 1-buffer-at-a-time
+filter the reference server runs, submission is asynchronous: every
+in-flight request across ALL clients decodes in the same batched device
+program, and completions flow downstream as they finish —
+
+- ACROSS clients: out of order (serversink routes by ``query_client_id``
+  meta, so a short prompt never waits on a long one);
+- WITHIN a client: strictly FIFO (the framed query protocol matches
+  responses to requests by order, so a per-client drainer pushes that
+  client's completions in submission order).
+
+Per-request overrides: a SECOND int32 tensor in the request buffer caps
+generation for that prompt (the framed wire protocol carries tensors,
+not meta, so the budget travels as payload); in-process pipelines may
+use ``lm_max_new`` buffer meta instead. The completion buffer carries
+``lm_finish_reason`` and ``lm_prompt_len`` meta and preserves everything
+else (client id included) — meta is visible to downstream SERVER-side
+elements; the wire back to the client carries the token tensor only.
+
+Failure contract: the framed protocol matches responses to requests BY
+ORDER, so every request gets exactly one response — a request that fails
+(bad prompt, engine error, result timeout) returns a single ``-1``
+token (ids are never negative) instead of desynchronizing or killing
+the server. Per-client drainers retire after ``idle_timeout`` seconds
+without traffic, so a long-running server doesn't accumulate one thread
+per connection ever made (the query server mints a fresh client id per
+TCP connection).
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from typing import Dict
+
+import numpy as np
+
+from nnstreamer_tpu.pipeline.element import (
+    Element,
+    EosEvent,
+    FlowError,
+    FlowReturn,
+)
+from nnstreamer_tpu.registry import ELEMENT, subplugin
+
+
+@subplugin(ELEMENT, "tensor_lm_serve")
+class TensorLMServe(Element):
+    ELEMENT_NAME = "tensor_lm_serve"
+    PROPERTIES = {
+        **Element.PROPERTIES,
+        "engine": "",            # registered engine name (serving package)
+        "max_new_tokens": 64,    # default generation budget per request
+        "timeout": 600.0,        # seconds a drainer waits on one result
+        "idle_timeout": 60.0,    # seconds before an idle drainer retires
+    }
+
+    #: error response payload — exactly one buffer per request keeps the
+    #: order-matched framed protocol in sync (see module docstring)
+    ERROR_TOKEN = -1
+
+    _EOS = object()
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+        self._engine = None
+        self._fifos: Dict[int, _queue.Queue] = {}
+        self._drainers: Dict[int, threading.Thread] = {}
+        self._state_lock = threading.Lock()
+        self._push_lock = threading.Lock()  # serialize downstream pushes
+        self._inflight = 0
+        self._idle = threading.Condition(self._state_lock)
+
+    def start(self):
+        super().start()
+        from nnstreamer_tpu.serving import get_engine
+
+        name = self.get_property("engine")
+        self._engine = get_engine(name)
+        if self._engine is None:
+            raise FlowError(
+                f"{self.name}: no engine registered as {name!r} "
+                f"(serving.register_engine first)")
+
+    def stop(self):
+        with self._state_lock:
+            fifos = list(self._fifos.values())
+            self._fifos.clear()
+            drainers = list(self._drainers.values())
+            self._drainers.clear()
+        for f in fifos:
+            f.put(self._EOS)
+        for t in drainers:
+            t.join(timeout=5)
+        self._engine = None
+        super().stop()
+
+    # -- request intake -------------------------------------------------------
+    def chain(self, pad, buf):
+        cid = int(buf.meta.get("query_client_id", 0))
+        try:
+            prompt = np.asarray(buf.tensors[0]).reshape(-1).astype(np.int32)
+            max_new = int(self.get_property("max_new_tokens"))
+            if len(buf.tensors) > 1:  # budget as payload (survives wire)
+                max_new = int(np.asarray(buf.tensors[1]).reshape(-1)[0])
+            max_new = int(buf.meta.get("lm_max_new", max_new))
+            stream = self._engine.submit(prompt, max_new_tokens=max_new)
+        except Exception as e:  # noqa: BLE001 — a malformed remote
+            # request must not error the server pipeline (remote DoS);
+            # the client gets its order-keeping error response
+            self.log.warning("client %d request rejected: %s", cid, e)
+            self._push_response(self._error_response(buf, str(e)))
+            return FlowReturn.OK
+        with self._state_lock:
+            fifo = self._fifos.get(cid)
+            if fifo is None:
+                fifo = self._fifos[cid] = _queue.Queue()
+                t = threading.Thread(target=self._drain, args=(cid, fifo),
+                                     name=f"{self.name}-c{cid}",
+                                     daemon=True)
+                self._drainers[cid] = t
+                t.start()
+            self._inflight += 1
+            fifo.put((stream, buf))
+        return FlowReturn.OK
+
+    def _error_response(self, buf, reason: str):
+        return buf.with_tensors(
+            [np.asarray([self.ERROR_TOKEN], np.int32)]).replace(
+                meta={**buf.meta, "lm_finish_reason": f"error: {reason}"})
+
+    def _push_response(self, out):
+        with self._push_lock:
+            self.srcpad.push(out)
+
+    # -- per-client completion drainer ---------------------------------------
+    def _drain(self, cid: int, fifo: _queue.Queue):
+        timeout = float(self.get_property("timeout"))
+        idle = float(self.get_property("idle_timeout"))
+        while True:
+            try:
+                item = fifo.get(timeout=idle)
+            except _queue.Empty:
+                # retire if still empty under the lock (chain() holds the
+                # lock while enqueueing, so no request can slip between
+                # the check and the removal)
+                with self._state_lock:
+                    if fifo.empty() and self._fifos.get(cid) is fifo:
+                        del self._fifos[cid]
+                        del self._drainers[cid]
+                        return
+                continue
+            if item is self._EOS:
+                return
+            stream, buf = item
+            try:
+                toks = stream.result(timeout=timeout)
+                out = buf.with_tensors(
+                    [np.asarray(toks, np.int32)]).replace(meta={
+                        **buf.meta,
+                        "lm_finish_reason": stream.finish_reason,
+                        "lm_prompt_len": stream.prompt_len,
+                    })
+                self._push_response(out)
+            except Exception as e:  # noqa: BLE001 — one failed request
+                # must neither kill the drainer nor skip a response (the
+                # order-matched protocol would attribute every later
+                # completion to the wrong request)
+                self.log.warning("client %d request failed: %s", cid, e)
+                try:
+                    self._push_response(self._error_response(buf, str(e)))
+                except Exception as e2:  # noqa: BLE001 — downstream gone
+                    self.log.warning("client %d error response dropped: "
+                                     "%s", cid, e2)
+            finally:
+                with self._idle:
+                    self._inflight -= 1
+                    self._idle.notify_all()
+
+    # -- EOS: drain everything first -----------------------------------------
+    def sink_event(self, pad, event):
+        if isinstance(event, EosEvent):
+            with self._idle:
+                done = self._idle.wait_for(
+                    lambda: self._inflight == 0,
+                    timeout=float(self.get_property("timeout")))
+            if not done:
+                # late completions will hit an eos'd pad and vanish —
+                # surface WHY those clients never got a response
+                self.post_error(FlowError(
+                    f"{self.name}: EOS with requests still in flight "
+                    f"after {self.get_property('timeout')}s; remaining "
+                    f"completions will be dropped"))
+            super().sink_event(pad, event)
+            return
+        super().sink_event(pad, event)

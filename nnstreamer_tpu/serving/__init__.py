@@ -9,9 +9,34 @@ device-resident cache; the hot loop is a single jitted multi-step decode
 whose shapes never change, so XLA compiles it exactly once.
 """
 
+import threading
+from typing import Dict, Optional
+
 from nnstreamer_tpu.serving.engine import (
     ContinuousBatchingEngine,
     GenerationStream,
 )
 
-__all__ = ["ContinuousBatchingEngine", "GenerationStream"]
+#: name → engine, so pipeline elements (tensor_lm_serve) can reference an
+#: app-constructed engine by property — the register_jax_model pattern
+_ENGINES: Dict[str, ContinuousBatchingEngine] = {}
+_ENGINES_LOCK = threading.Lock()
+
+
+def register_engine(name: str, engine: ContinuousBatchingEngine) -> None:
+    with _ENGINES_LOCK:
+        _ENGINES[name] = engine
+
+
+def get_engine(name: str) -> Optional[ContinuousBatchingEngine]:
+    with _ENGINES_LOCK:
+        return _ENGINES.get(name)
+
+
+def unregister_engine(name: str) -> bool:
+    with _ENGINES_LOCK:
+        return _ENGINES.pop(name, None) is not None
+
+
+__all__ = ["ContinuousBatchingEngine", "GenerationStream",
+           "register_engine", "get_engine", "unregister_engine"]

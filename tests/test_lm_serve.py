@@ -1,0 +1,172 @@
+"""Distributed LM serving: tensor_lm_serve over the query transport
+(elements/lm_serve.py) — prompts in over framed TCP, batched decode in
+the shared engine, completions routed back per client."""
+
+import threading
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from nnstreamer_tpu import parse_launch  # noqa: E402
+from nnstreamer_tpu.models.transformer import (  # noqa: E402
+    TransformerConfig,
+    init_params,
+)
+from nnstreamer_tpu.serving import (  # noqa: E402
+    ContinuousBatchingEngine,
+    register_engine,
+    unregister_engine,
+)
+from tests.test_serving import reference_greedy  # noqa: E402
+
+CFG = TransformerConfig(vocab=97, d_model=64, n_heads=4, n_layers=2,
+                        d_ff=128, max_seq=64, dtype=jnp.float32)
+PARAMS = init_params(CFG, seed=3)
+
+
+@pytest.fixture
+def lm_server():
+    engine = ContinuousBatchingEngine(
+        CFG, PARAMS, max_streams=3, steps_per_dispatch=4,
+        temperature=0.0).start()
+    register_engine("lm_test", engine)
+    server = parse_launch(
+        "tensor_query_serversrc name=ssrc port=0 ! "
+        "tensor_lm_serve engine=lm_test max-new-tokens=6 ! "
+        "tensor_query_serversink")
+    server.start()
+    yield server.get("ssrc").port
+    server.stop()
+    engine.stop()
+    unregister_engine("lm_test")
+
+
+def _client(port, prompts, results, idx, max_in_flight=1):
+    pipe = parse_launch(
+        f"appsrc name=src ! tensor_query_client dest-host=127.0.0.1 "
+        f"dest-port={port} timeout=120 max-in-flight={max_in_flight} ! "
+        "tensor_sink name=out to-host=true")
+    outs = []
+    pipe.get("out").connect(lambda b: outs.append(b))
+    pipe.start()
+    try:
+        src = pipe.get("src")
+        for p in prompts:
+            src.push([np.asarray(p, np.int32)])
+        src.end_of_stream()
+        msg = pipe.wait(timeout=240)
+        assert msg is not None and msg.kind == "eos", msg
+    finally:
+        pipe.stop()
+    results[idx] = [np.asarray(b.tensors[0]).tolist() for b in outs]
+
+
+def test_single_client_completion_matches_greedy(lm_server):
+    results = {}
+    _client(lm_server, [[5, 11, 23]], results, 0)
+    assert results[0] == [reference_greedy([5, 11, 23], 6,
+                                           cfg=CFG, params=PARAMS)]
+
+
+def test_pipelined_requests_keep_fifo_order(lm_server):
+    prompts = [[4, 8, 15], [16, 23], [42, 7, 9, 1]]
+    results = {}
+    _client(lm_server, prompts, results, 0, max_in_flight=3)
+    assert results[0] == [reference_greedy(p, 6, cfg=CFG, params=PARAMS)
+                          for p in prompts]
+
+
+def test_concurrent_clients_share_the_batch(lm_server):
+    prompts = {0: [[9, 9, 9]], 1: [[13, 2]], 2: [[1, 2, 3, 4]]}
+    results = {}
+    threads = [threading.Thread(target=_client,
+                                args=(lm_server, prompts[i], results, i))
+               for i in prompts]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    for i, ps in prompts.items():
+        assert results[i] == [reference_greedy(p, 6, cfg=CFG,
+                                               params=PARAMS)
+                              for p in ps], f"client {i}"
+
+
+def test_per_request_budget_rides_the_wire(lm_server):
+    """A second int32 tensor in the request is that prompt's generation
+    budget — payload, so it survives the framed protocol."""
+    pipe = parse_launch(
+        f"appsrc name=src ! tensor_query_client dest-host=127.0.0.1 "
+        f"dest-port={lm_server} timeout=120 ! "
+        "tensor_sink name=out to-host=true")
+    outs = []
+    pipe.get("out").connect(lambda b: outs.append(b))
+    pipe.start()
+    try:
+        src = pipe.get("src")
+        src.push([np.asarray([5, 11, 23], np.int32),
+                  np.asarray([3], np.int32)])
+        src.end_of_stream()
+        msg = pipe.wait(timeout=240)
+        assert msg is not None and msg.kind == "eos", msg
+    finally:
+        pipe.stop()
+    assert np.asarray(outs[0].tensors[0]).tolist() == \
+        reference_greedy([5, 11, 23], 3, cfg=CFG, params=PARAMS)
+
+
+def test_malformed_request_gets_error_response_server_survives(lm_server):
+    """An invalid prompt must yield the order-keeping -1 response and
+    leave the server serving (a bad request is not a DoS)."""
+    results = {}
+    # over-long prompt (>= engine cache length, engine rejects) then a
+    # valid one, same connection: responses must be [-1] then the real
+    # completion, in order
+    _client(lm_server, [list(range(1, CFG.max_seq + 2)), [5, 11, 23]],
+            results, 0, max_in_flight=2)
+    assert results[0] == [[-1],
+                          reference_greedy([5, 11, 23], 6,
+                                           cfg=CFG, params=PARAMS)]
+    # server still healthy for a fresh connection
+    _client(lm_server, [[13, 2]], results, 1)
+    assert results[1] == [reference_greedy([13, 2], 6,
+                                           cfg=CFG, params=PARAMS)]
+
+
+def test_idle_drainers_retire():
+    engine = ContinuousBatchingEngine(
+        CFG, PARAMS, max_streams=2, steps_per_dispatch=4,
+        temperature=0.0).start()
+    register_engine("lm_idle", engine)
+    server = parse_launch(
+        "tensor_query_serversrc name=ssrc port=0 ! "
+        "tensor_lm_serve engine=lm_idle max-new-tokens=4 "
+        "idle-timeout=0.3 name=serve ! tensor_query_serversink")
+    server.start()
+    try:
+        results = {}
+        _client(server.get("ssrc").port, [[3, 4]], results, 0)
+        assert len(results[0]) == 1
+        serve = server.get("serve")
+        import time
+
+        deadline = time.monotonic() + 10
+        while serve._drainers and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert not serve._drainers and not serve._fifos
+    finally:
+        server.stop()
+        engine.stop()
+        unregister_engine("lm_idle")
+
+
+def test_unregistered_engine_fails_start():
+    pipe = parse_launch(
+        "tensor_query_serversrc name=ssrc port=0 ! "
+        "tensor_lm_serve engine=nope ! tensor_query_serversink")
+    with pytest.raises(Exception):
+        pipe.start()
+    pipe.stop()
