@@ -117,12 +117,17 @@ class TensorLMServe(Element):
                 max_new = int(np.asarray(buf.tensors[1]).reshape(-1)[0])
             max_new = int(buf.meta.get("lm_max_new", max_new))
             stream = self._engine.submit(prompt, max_new_tokens=max_new)
+            self._enqueue(cid, (stream, buf, None))
         except Exception as e:  # noqa: BLE001 — a malformed remote
             # request must not error the server pipeline (remote DoS);
-            # the client gets its order-keeping error response
+            # its error response goes through the SAME per-client fifo so
+            # it cannot overtake earlier in-flight completions (the wire
+            # matches responses to requests by order)
             self.log.warning("client %d request rejected: %s", cid, e)
-            self._push_response(self._error_response(buf, str(e)))
-            return FlowReturn.OK
+            self._enqueue(cid, (None, buf, str(e)))
+        return FlowReturn.OK
+
+    def _enqueue(self, cid: int, item) -> None:
         with self._state_lock:
             fifo = self._fifos.get(cid)
             if fifo is None:
@@ -133,8 +138,7 @@ class TensorLMServe(Element):
                 self._drainers[cid] = t
                 t.start()
             self._inflight += 1
-            fifo.put((stream, buf))
-        return FlowReturn.OK
+            fifo.put(item)
 
     def _error_response(self, buf, reason: str):
         return buf.with_tensors(
@@ -164,13 +168,23 @@ class TensorLMServe(Element):
                 continue
             if item is self._EOS:
                 return
-            stream, buf = item
+            stream, buf, err = item
             try:
+                if stream is None:  # rejected at intake, in FIFO order
+                    self._push_response(self._error_response(buf, err))
+                    continue
                 toks = stream.result(timeout=timeout)
+                reason = stream.finish_reason or ""
+                if reason not in ("eos", "length"):
+                    # engine-side failure (prefill/dispatch error, engine
+                    # stopped): result() returns [] without raising — the
+                    # client still gets the documented -1 error response
+                    self._push_response(self._error_response(buf, reason))
+                    continue
                 out = buf.with_tensors(
                     [np.asarray(toks, np.int32)]).replace(meta={
                         **buf.meta,
-                        "lm_finish_reason": stream.finish_reason,
+                        "lm_finish_reason": reason,
                         "lm_prompt_len": stream.prompt_len,
                     })
                 self._push_response(out)

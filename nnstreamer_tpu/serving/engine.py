@@ -126,6 +126,14 @@ class ContinuousBatchingEngine:
         decode/prefill programs ("computation follows data") — batched
         decode collectives ride ICI, never the host. Requires
         ``max_streams % dp == 0`` and ``n_heads % tp == 0``.
+    prefill_chunk: when set, prompts ingest in fixed chunks of this many
+        tokens, ONE chunk per engine-loop iteration, interleaved with
+        decode dispatches — admitting a long prompt then adds at most
+        one chunk's latency per block to running streams instead of a
+        whole-prompt stall (and prefill compiles exactly once, at shape
+        ``[1, chunk]``, instead of once per length bucket). Padded tail
+        positions are unreachable-before-overwrite exactly like bucket
+        padding. Requires ``prompt length <= max_seq - prefill_chunk``.
     """
 
     def __init__(self, cfg, params, max_streams: int = 4,
@@ -133,11 +141,13 @@ class ContinuousBatchingEngine:
                  steps_per_dispatch: int = 8,
                  temperature: float = 0.0, top_k: int = 0,
                  eos_id: Optional[int] = None, seed: int = 0,
-                 min_bucket: int = 16, mesh=None):
+                 min_bucket: int = 16, mesh=None,
+                 prefill_chunk: Optional[int] = None):
         import jax
         import jax.numpy as jnp
 
         from nnstreamer_tpu.models.transformer import (
+            build_chunk_decode,
             build_decode_step,
             build_prefill,
             init_cache,
@@ -154,8 +164,19 @@ class ContinuousBatchingEngine:
         self.seed = int(seed)
         self.min_bucket = int(min_bucket)
 
+        self.prefill_chunk = None if prefill_chunk is None \
+            else int(prefill_chunk)
+        if self.prefill_chunk is not None and not (
+                0 < self.prefill_chunk < self.S):
+            raise ValueError(
+                f"serving: prefill_chunk must be in (0, {self.S}), got "
+                f"{prefill_chunk}")
         self._decode = build_decode_step(cfg, self.S)
         self._prefill_fn = build_prefill(cfg, self.S)
+        self._chunk_fn = build_chunk_decode(cfg, self.S)
+        #: in-progress chunked admission: (request, slot, cache1, k) with
+        #: k = next chunk index; one at a time, advanced between dispatches
+        self._partial = None
 
         # host-side per-slot state
         self._pos = np.zeros(self.B, np.int32)
@@ -210,7 +231,7 @@ class ContinuousBatchingEngine:
         self._thread: Optional[threading.Thread] = None
         self.stats: Dict[str, Any] = {
             "tokens_generated": 0, "dispatches": 0, "prefills": 0,
-            "slot_steps": 0, "active_slot_steps": 0,
+            "prefill_chunks": 0, "slot_steps": 0, "active_slot_steps": 0,
         }
 
         from nnstreamer_tpu.models.transformer import make_sampler
@@ -247,6 +268,8 @@ class ContinuousBatchingEngine:
 
         # one jitted prefill; XLA caches one executable per bucket shape
         self._prefill_jitted = jax.jit(self._prefill_fn)
+        # chunked-prefill program: ONE executable at shape [1, chunk]
+        self._chunk_jitted = jax.jit(self._chunk_fn, donate_argnums=(2,))
         self._jnp = jnp
 
     # -- public API -----------------------------------------------------------
@@ -275,8 +298,13 @@ class ContinuousBatchingEngine:
         # lock serializes with submit()'s running-check + enqueue, so a
         # request can't slip into _pending after this drain
         with self._lock:
+            if self._partial is not None:
+                self._partial[0].stream._finish("engine-stopped")
+                self._partial = None
             for i, st in enumerate(self._slots):
-                if st is not None and not st.finished:
+                if st is self._RESERVED:
+                    self._slots[i] = None
+                elif st is not None and not st.finished:
                     st._finish("engine-stopped")
                     self._slots[i] = None
             while True:
@@ -296,10 +324,16 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"serving: max_new_tokens must be >= 1, got {max_new_tokens}"
                 " (the prefill always yields the first token)")
-        if prompt.size >= self.S:
+        # chunked mode: the last chunk's writes (ceil(n/C)*C slots) must
+        # fit the cache — equal to the plain n < S bound when C divides S
+        limit = self.S - 1 if self.prefill_chunk is None else min(
+            self.S - 1, (self.S // self.prefill_chunk) * self.prefill_chunk)
+        if prompt.size > limit:
             raise ValueError(
-                f"serving: prompt length {prompt.size} must be < cache "
-                f"length {self.S}")
+                f"serving: prompt length {prompt.size} must be <= {limit} "
+                f"(cache length {self.S}"
+                + (f", prefill chunk {self.prefill_chunk})"
+                   if self.prefill_chunk is not None else ")"))
         with self._lock:
             # running-check + enqueue under the same lock stop() drains
             # under, so a request can't land after the drain (it would
@@ -323,7 +357,8 @@ class ContinuousBatchingEngine:
 
     @property
     def active_streams(self) -> int:
-        return sum(1 for s in self._slots if s is not None)
+        return sum(1 for s in self._slots
+                   if s is not None and s is not self._RESERVED)
 
     # -- engine internals ------------------------------------------------------
     def _bucket(self, n: int) -> int:
@@ -342,6 +377,50 @@ class ContinuousBatchingEngine:
         logits, cache1 = self._prefill_jitted(
             self.params, jnp.asarray(padded),
             lengths=jnp.asarray([n], jnp.int32))
+        self._activate(req, slot, logits, cache1)
+
+    #: reserves a batch slot while its chunked prefill is in flight
+    _RESERVED = object()
+
+    def _begin_partial(self, req: _PendingRequest, slot: int):
+        from nnstreamer_tpu.models.transformer import init_cache
+
+        self._slots[slot] = self._RESERVED
+        self._partial = (req, slot, init_cache(self.cfg, 1, self.S), 0)
+
+    def _advance_partial(self):
+        """Run ONE prefill chunk; on the last chunk, activate the slot."""
+        jnp = self._jnp
+        req, slot, cache1, k = self._partial
+        C = self.prefill_chunk
+        prompt, n = req.prompt, req.prompt.size
+        start = k * C
+        end = min(start + C, n)
+        chunk = np.zeros((1, C), np.int32)
+        chunk[0, :end - start] = prompt[start:end]
+        try:
+            logits, cache1 = self._chunk_jitted(
+                self.params, jnp.asarray(chunk), cache1,
+                jnp.asarray(start, jnp.int32))
+            self.stats["prefill_chunks"] += 1
+            if end < n:
+                self._partial = (req, slot, cache1, k + 1)
+                return
+            # final chunk: logits at the prompt's true last position
+            self._partial = None
+            self._activate(req, slot, logits[:, (n - 1) - start], cache1)
+        except Exception as e:  # noqa: BLE001 — a failed chunk must free
+            # the reserved slot and fail only this request
+            log.warning("serving: chunked prefill failed: %s", e)
+            self._partial = None
+            self._slots[slot] = None
+            req.stream._finish(f"error: {e}")
+
+    def _activate(self, req: _PendingRequest, slot: int, logits, cache1):
+        """Common admission tail: seed the first token, install the
+        stream's cache into its batch slot."""
+        jnp = self._jnp
+        n = req.prompt.size
         self.stats["prefills"] += 1
         key = np.asarray(
             [self.seed & 0xFFFFFFFF, req.stream.stream_id & 0xFFFFFFFF],
@@ -376,24 +455,38 @@ class ContinuousBatchingEngine:
     def _loop(self):
         jnp = self._jnp
         while not self._stop_evt.is_set():
+            # in-flight chunked prefill: ONE chunk per iteration, so the
+            # decode dispatch below keeps running streams moving while a
+            # long prompt ingests
+            progressed = False
+            if self._partial is not None:
+                self._advance_partial()
+                progressed = True
             # admission: fill free slots from the pending queue
-            admitted = False
             for slot in range(self.B):
-                if self._slots[slot] is not None:
+                if self._slots[slot] is not None \
+                        or self._partial is not None:
                     continue
                 try:
                     req = self._pending.get_nowait()
                 except _queue.Empty:
                     break
                 try:
-                    self._admit(req, slot)
-                    admitted = True
+                    if self.prefill_chunk is not None:
+                        self._begin_partial(req, slot)
+                    else:
+                        self._admit(req, slot)
+                    progressed = True
                 except Exception as e:  # noqa: BLE001 — a bad request
-                    # (or a prefill failure) must not kill the engine loop
+                    # (or a prefill/cache-alloc failure) must not kill
+                    # the engine loop
                     log.warning("serving: admit failed: %s", e)
+                    if self._slots[slot] is self._RESERVED:
+                        self._slots[slot] = None
+                    self._partial = None
                     req.stream._finish(f"error: {e}")
             if self.active_streams == 0:
-                if not admitted:
+                if not progressed:
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
                 continue
@@ -404,12 +497,17 @@ class ContinuousBatchingEngine:
                     jnp.asarray(self._keys))
             except Exception as e:  # noqa: BLE001 — a device failure must
                 # not strand clients blocked on their streams: fail every
-                # in-flight stream, rebuild the (possibly donated-away)
-                # cache, and keep serving new requests
+                # in-flight stream (and any half-ingested prompt), rebuild
+                # the (possibly donated-away) cache, keep serving
                 log.error("serving: dispatch failed: %s", e)
+                if self._partial is not None:
+                    self._partial[0].stream._finish(f"error: {e}")
+                    self._partial = None
                 for slot in range(self.B):
                     st = self._slots[slot]
-                    if st is not None:
+                    if st is self._RESERVED:
+                        self._slots[slot] = None
+                    elif st is not None:
                         st._finish(f"error: {e}")
                         self._slots[slot] = None
                 self._cache = self._init_cache()
@@ -422,8 +520,8 @@ class ContinuousBatchingEngine:
             self.stats["slot_steps"] += self.B * self.K
             for slot in range(self.B):
                 st = self._slots[slot]
-                if st is None:
-                    continue  # free slot: state is reset at next admit
+                if st is None or st is self._RESERVED:
+                    continue  # free/reserved slot: set at (next) admit
                 self._pos[slot] += self.K
                 self._last[slot] = toks[slot, -1]
                 for j in range(self.K):

@@ -134,6 +134,13 @@ def test_malformed_request_gets_error_response_server_survives(lm_server):
     _client(lm_server, [[13, 2]], results, 1)
     assert results[1] == [reference_greedy([13, 2], 6,
                                            cfg=CFG, params=PARAMS)]
+    # valid THEN invalid: the error response must not overtake the valid
+    # request's completion (order-matched protocol)
+    _client(lm_server, [[5, 11, 23], list(range(1, CFG.max_seq + 2))],
+            results, 2, max_in_flight=2)
+    assert results[2] == [reference_greedy([5, 11, 23], 6,
+                                           cfg=CFG, params=PARAMS),
+                          [-1]]
 
 
 def test_idle_drainers_retire():

@@ -198,6 +198,65 @@ def test_sharded_engine_validates_divisibility():
         ContinuousBatchingEngine(CFG, PARAMS, max_streams=4, mesh=mesh)
 
 
+def test_chunked_prefill_matches_exact():
+    """Chunked ingestion (C=8) must be bit-identical to whole-prompt
+    prefill for lengths below/at/above chunk boundaries."""
+    eng = ContinuousBatchingEngine(
+        CFG, PARAMS, max_streams=2, steps_per_dispatch=4,
+        temperature=0.0, prefill_chunk=8).start()
+    try:
+        for n in (1, 7, 8, 9, 16, 20, 37):
+            prompt = [(i * 13 + 5) % CFG.vocab for i in range(n)]
+            got = eng.generate(prompt, max_new_tokens=6, timeout=240)
+            assert got == reference_greedy(prompt, 6), f"len={n}"
+    finally:
+        eng.stop()
+
+
+def test_chunked_prefill_interleaves_with_decode():
+    """A long prompt admitted while another stream decodes: both exact
+    (prefill chunks run between decode dispatches, not instead of them)."""
+    eng = ContinuousBatchingEngine(
+        CFG, PARAMS, max_streams=2, steps_per_dispatch=2,
+        temperature=0.0, prefill_chunk=4).start()
+    try:
+        a = eng.submit([5, 11, 23], max_new_tokens=20)
+        long_prompt = [(i * 7 + 2) % CFG.vocab for i in range(30)]
+        b = eng.submit(long_prompt, max_new_tokens=8)
+        ra, rb = a.result(timeout=240), b.result(timeout=240)
+    finally:
+        eng.stop()
+    assert ra == reference_greedy([5, 11, 23], 20)
+    assert rb == reference_greedy(long_prompt, 8)
+    assert eng.stats["prefill_chunks"] >= 8 + 1  # 30/4 → 8 + short prompt
+
+
+def test_chunked_prefill_prompt_limit():
+    """The bound is ceil(n/C)*C <= S: when C divides S it equals the
+    plain n < S rule (no capacity lost); otherwise the last partial
+    chunk must still fit the cache."""
+    # C=8 divides S=64: same capacity as the unchunked engine (63)
+    eng = ContinuousBatchingEngine(
+        CFG, PARAMS, max_streams=1, prefill_chunk=8).start()
+    try:
+        assert len(eng.generate(list(range(1, 64)), max_new_tokens=5,
+                                timeout=240)) == 1  # budget S-63 = 1
+    finally:
+        eng.stop()
+    # C=12 does not divide S=64: limit is (64//12)*12 = 60
+    eng = ContinuousBatchingEngine(
+        CFG, PARAMS, max_streams=1, prefill_chunk=12).start()
+    try:
+        with pytest.raises(ValueError):
+            eng.submit(list(range(61)), max_new_tokens=2)
+        assert len(eng.generate(list(range(60)), max_new_tokens=9,
+                                timeout=240)) == 4  # budget S-60 = 4
+    finally:
+        eng.stop()
+    with pytest.raises(ValueError):
+        ContinuousBatchingEngine(CFG, PARAMS, prefill_chunk=CFG.max_seq)
+
+
 def test_submit_before_start_rejected():
     eng = ContinuousBatchingEngine(CFG, PARAMS, max_streams=1)
     with pytest.raises(RuntimeError):
