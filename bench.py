@@ -385,8 +385,11 @@ def measure_serve() -> dict:
 
     cfg = TransformerConfig(vocab=32000, d_model=512, n_heads=8, n_layers=8,
                             d_ff=2048, max_seq=512, dtype=jnp.bfloat16)
+    # K=32: one host sync serves up to 256 tokens across the batch — on a
+    # tunneled chip the per-dispatch sync is the bottleneck, and these
+    # length-bound greedy streams never waste steps on early EOS
     engine = ContinuousBatchingEngine(
-        cfg, init_params(cfg), max_streams=8, steps_per_dispatch=16,
+        cfg, init_params(cfg), max_streams=8, steps_per_dispatch=32,
         temperature=0.0).start()
     try:
         rng = np.random.default_rng(0)

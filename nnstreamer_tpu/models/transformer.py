@@ -212,16 +212,107 @@ def build_forward(cfg: TransformerConfig,
     return apply_fn
 
 
+def _slot_write(layer_cache, upd, pos, per_stream):
+    """Write ``upd`` into a layer cache leaf at sequence slot(s) ``pos``.
+
+    Leaf layout is ``[2, b, S, ...]`` (slot axis 2, any trailing rank —
+    values have dh, scales don't). ``per_stream`` scatters per batch row
+    with that row's own pos."""
+    if per_stream:
+        return jax.vmap(
+            lambda cch, u, p: jax.lax.dynamic_update_slice(
+                cch, u, (0, p) + (0,) * (cch.ndim - 2)),
+            in_axes=(1, 1, 0), out_axes=1)(layer_cache, upd, pos)
+    return jax.lax.dynamic_update_slice(
+        layer_cache, upd, (0, 0, pos) + (0,) * (layer_cache.ndim - 3))
+
+
+class _RawKVCodec:
+    """Cache = one array [L, 2, b, S, h, dh] in the model dtype."""
+
+    def __init__(self, dtype):
+        self.dtype = dtype
+
+    def init(self, L, b, S, h, dh):
+        return jnp.zeros((L, 2, b, S, h, dh), self.dtype)
+
+    def write(self, layer_cache, kv, pos, per_stream=False):
+        """kv [2, b, c, h, dh] → slots [pos, pos+c) (per-row pos when
+        ``per_stream``)."""
+        return _slot_write(layer_cache, kv.astype(self.dtype), pos,
+                           per_stream)
+
+    def read(self, layer_cache):
+        return layer_cache[0], layer_cache[1]
+
+    def place_prefix(self, cache, kv):
+        """kv [L, 2, b, s, h, dh] → cache slots [0, s)."""
+        return jax.lax.dynamic_update_slice(
+            cache, kv.astype(self.dtype), (0, 0, 0, 0, 0, 0))
+
+
+class _Int8KVCodec:
+    """int8 KV cache: values [L, 2, b, S, h, dh] int8 + per-vector absmax
+    scales [L, 2, b, S, h] fp32 — ~2× context (or batch slots) per HBM
+    byte vs bf16, and the attend path reads half the bytes. Dequantize
+    happens in fp32 right before the score/pv einsums, so the attention
+    numeric core (_attend_cache) is unchanged."""
+
+    def _q(self, kv):
+        kf = kv.astype(jnp.float32)
+        amax = jnp.max(jnp.abs(kf), axis=-1, keepdims=True)
+        scale = jnp.maximum(amax / 127.0, 1e-30)
+        q = jnp.clip(jnp.round(kf / scale), -127, 127).astype(jnp.int8)
+        return q, scale[..., 0]
+
+    def init(self, L, b, S, h, dh):
+        return {"q": jnp.zeros((L, 2, b, S, h, dh), jnp.int8),
+                "scale": jnp.zeros((L, 2, b, S, h), jnp.float32)}
+
+    def write(self, layer_cache, kv, pos, per_stream=False):
+        q, s = self._q(kv)                 # [2,b,c,h,dh], [2,b,c,h]
+        return {"q": _slot_write(layer_cache["q"], q, pos, per_stream),
+                "scale": _slot_write(layer_cache["scale"], s, pos,
+                                     per_stream)}
+
+    def read(self, layer_cache):
+        deq = (layer_cache["q"].astype(jnp.float32)
+               * layer_cache["scale"][..., None])
+        return deq[0], deq[1]
+
+    def place_prefix(self, cache, kv):
+        q, s = self._q(kv)                 # [L,2,b,s,h,dh], [L,2,b,s,h]
+        return {
+            "q": jax.lax.dynamic_update_slice(
+                cache["q"], q, (0, 0, 0, 0, 0, 0)),
+            "scale": jax.lax.dynamic_update_slice(
+                cache["scale"], s, (0, 0, 0, 0, 0)),
+        }
+
+
+def _kv_codec(cfg: TransformerConfig, kv_codec: Optional[str]):
+    if kv_codec in (None, "raw"):
+        return _RawKVCodec(cfg.dtype)
+    if kv_codec == "int8":
+        return _Int8KVCodec()
+    raise ValueError(
+        f"kv_codec must be None/'raw'/'int8', got {kv_codec!r}")
+
+
 def init_cache(cfg: TransformerConfig, batch: int,
-               max_seq: Optional[int] = None):
-    """Device-resident KV cache [L, 2, b, S, h, dh] (k=0, v=1 slots)."""
+               max_seq: Optional[int] = None,
+               kv_codec: Optional[str] = None):
+    """Device-resident KV cache [L, 2, b, S, h, dh] (k=0, v=1 slots).
+    ``kv_codec="int8"`` returns the quantized layout (values + per-vector
+    scales) accepted by the matching ``build_*`` functions."""
     s = max_seq or cfg.max_seq
-    return jnp.zeros((cfg.n_layers, 2, batch, s, cfg.n_heads,
-                      cfg.head_dim), cfg.dtype)
+    return _kv_codec(cfg, kv_codec).init(
+        cfg.n_layers, batch, s, cfg.n_heads, cfg.head_dim)
 
 
 def build_decode_step(cfg: TransformerConfig,
-                      max_seq: Optional[int] = None) -> Callable:
+                      max_seq: Optional[int] = None,
+                      kv_codec: Optional[str] = None) -> Callable:
     """Incremental (KV-cached) single-token decode.
 
     ``step(params, token[int32 b], cache, pos[int32 scalar]) ->
@@ -244,9 +335,13 @@ def build_decode_step(cfg: TransformerConfig,
     vector — one position per batch row, the continuous-batching shape:
     sequences at different depths decode together in one dispatch, each
     writing its own cache slot and masking its own prefix.
+
+    ``kv_codec="int8"`` stores the cache quantized (see _Int8KVCodec);
+    pass the matching ``init_cache(..., kv_codec="int8")`` cache.
     """
     dtype = cfg.dtype
     s_max = max_seq or cfg.max_seq
+    codec = _kv_codec(cfg, kv_codec)
 
     def step(params, token, cache, pos):
         b = token.shape[0]
@@ -259,27 +354,17 @@ def build_decode_step(cfg: TransformerConfig,
         layer_params = {k: v for k, v in params.items()
                         if k not in ("embed", "ln_f")}
 
-        def write_cache(layer_cache, kv):
-            # [2,b,S,h,dh] ← [2,b,1,h,dh] at per-batch (or shared) slot
-            if per_stream:
-                return jax.vmap(
-                    lambda c, u, p: jax.lax.dynamic_update_slice(
-                        c, u, (0, p, 0, 0)),
-                    in_axes=(1, 1, 0), out_axes=1)(layer_cache, kv, pos_c)
-            return jax.lax.dynamic_update_slice(
-                layer_cache, kv, (0, 0, pos_c, 0, 0))
-
         def layer(carry, lp_and_cache):
             x, = carry
             lp, layer_cache = lp_and_cache                # [2,b,S,h,dh]
             q, k, v = _block_qkv(x, lp, positions, dtype)  # [b,1,h,dh]
-            new_cache = write_cache(
-                layer_cache, jnp.stack([k, v]).astype(layer_cache.dtype))
+            new_cache = codec.write(layer_cache, jnp.stack([k, v]),
+                                    pos_c, per_stream)
             slots = jnp.arange(s_max)
             mask = slots[None, None, None, :] <= (
                 pos_c[:, None, None, None] if per_stream else pos_c)
-            a = _attend_cache(q, new_cache[0], new_cache[1], mask,
-                              cfg.head_dim, dtype)
+            ck, cv = codec.read(new_cache)
+            a = _attend_cache(q, ck, cv, mask, cfg.head_dim, dtype)
             x = _block_tail(x, a, lp, cfg)
             return (x,), new_cache
 
@@ -290,7 +375,8 @@ def build_decode_step(cfg: TransformerConfig,
 
 
 def build_chunk_decode(cfg: TransformerConfig,
-                       max_seq: Optional[int] = None) -> Callable:
+                       max_seq: Optional[int] = None,
+                       kv_codec: Optional[str] = None) -> Callable:
     """KV-cached decode of a WHOLE chunk of c tokens in one pass:
     ``chunk(params, tokens[int32 b,c], cache, pos0[int32 scalar]) ->
     (logits[b,c,vocab], new_cache)``.
@@ -309,6 +395,7 @@ def build_chunk_decode(cfg: TransformerConfig,
     """
     dtype = cfg.dtype
     s_max = max_seq or cfg.max_seq
+    codec = _kv_codec(cfg, kv_codec)
 
     def chunk(params, tokens, cache, pos0):
         b, c = tokens.shape
@@ -323,15 +410,13 @@ def build_chunk_decode(cfg: TransformerConfig,
             x, = carry
             lp, layer_cache = lp_and_cache
             q, k, v = _block_qkv(x, lp, positions, dtype)  # [b,c,h,dh]
-            new_cache = jax.lax.dynamic_update_slice(
-                layer_cache, jnp.stack([k, v]).astype(layer_cache.dtype),
-                (0, 0, pos0, 0, 0))
+            new_cache = codec.write(layer_cache, jnp.stack([k, v]), pos0)
             slots = jnp.arange(s_max)
             # query i (global position pos0+i) sees slots <= pos0+i
             mask = slots[None, None, None, :] <= (
                 pos0 + jnp.arange(c))[None, None, :, None]
-            a = _attend_cache(q, new_cache[0], new_cache[1], mask,
-                              cfg.head_dim, dtype)
+            ck, cv = codec.read(new_cache)
+            a = _attend_cache(q, ck, cv, mask, cfg.head_dim, dtype)
             x = _block_tail(x, a, lp, cfg)
             return (x,), new_cache
 
@@ -343,7 +428,8 @@ def build_chunk_decode(cfg: TransformerConfig,
 
 def build_prefill(cfg: TransformerConfig,
                   max_seq: Optional[int] = None,
-                  attention_fn: Optional[Callable] = None) -> Callable:
+                  attention_fn: Optional[Callable] = None,
+                  kv_codec: Optional[str] = None) -> Callable:
     """Prompt ingestion for streaming decode: ``prefill(params,
     tokens[int32 b,s]) -> (logits[b, vocab], cache)`` — one full-sequence
     forward (the SAME shared layer body as :func:`build_forward`, with
@@ -362,6 +448,7 @@ def build_prefill(cfg: TransformerConfig,
     bit-identical to an exact-length one for all future tokens."""
     dtype = cfg.dtype
     s_max = max_seq or cfg.max_seq
+    codec = _kv_codec(cfg, kv_codec)
     layer_body = make_layer_body(cfg, attention_fn, capture_kv=True)
 
     def prefill(params, tokens, lengths=None):
@@ -373,10 +460,9 @@ def build_prefill(cfg: TransformerConfig,
                         if k not in ("embed", "ln_f")}
         (x, _), kv = lax.scan(layer_body, (x, positions), layer_params)
         # park each layer's k/v ([L,2,b,s,h,dh]) in the first s cache slots
-        cache = jnp.zeros((cfg.n_layers, 2, b, s_max, cfg.n_heads,
-                           cfg.head_dim), dtype)
-        cache = jax.lax.dynamic_update_slice(
-            cache, kv.astype(dtype), (0, 0, 0, 0, 0, 0))
+        cache = codec.place_prefix(
+            codec.init(cfg.n_layers, b, s_max, cfg.n_heads, cfg.head_dim),
+            kv)
         x = _rmsnorm(x, params["ln_f"])
         if lengths is None:
             last = x[:, -1]
@@ -393,12 +479,13 @@ def build_prefill(cfg: TransformerConfig,
 
 
 def build_greedy_stream_step(cfg: TransformerConfig,
-                             max_seq: Optional[int] = None) -> Callable:
+                             max_seq: Optional[int] = None,
+                             kv_codec: Optional[str] = None) -> Callable:
     """Pipeline-shaped greedy decode step for the tensor_repo loop:
     ``step(params, token, cache, pos) -> (next_token, cache, pos+1)`` —
     the state tuple a repo slot circulates (examples/llm_stream.py, bench
     config ``decode``)."""
-    decode = build_decode_step(cfg, max_seq)
+    decode = build_decode_step(cfg, max_seq, kv_codec)
 
     def step(params, token, cache, pos):
         logits, cache2 = decode(params, token.reshape(1).astype(jnp.int32),
@@ -444,13 +531,14 @@ def make_sampler(vocab: int, temperature: float = 1.0,
 def build_sample_stream_step(cfg: TransformerConfig,
                              max_seq: Optional[int] = None,
                              temperature: float = 1.0,
-                             top_k: int = 0) -> Callable:
+                             top_k: int = 0,
+                             kv_codec: Optional[str] = None) -> Callable:
     """Sampled decode step for the repo loop: ``step(params, token, cache,
     pos, key[uint32 2]) -> (next_token, cache, pos+1, next_key)`` — the
     PRNG key rides the state tuple like the cache does, so streaming stays
     deterministic given the seed. Sampling math is :func:`make_sampler`
     with one row."""
-    decode = build_decode_step(cfg, max_seq)
+    decode = build_decode_step(cfg, max_seq, kv_codec)
     sample = make_sampler(cfg.vocab, temperature, top_k)
 
     def step(params, token, cache, pos, key):

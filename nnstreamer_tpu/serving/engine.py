@@ -134,6 +134,9 @@ class ContinuousBatchingEngine:
         ``[1, chunk]``, instead of once per length bucket). Padded tail
         positions are unreachable-before-overwrite exactly like bucket
         padding. Requires ``prompt length <= max_seq - prefill_chunk``.
+    kv_quant: ``"int8"`` stores the KV cache quantized (per-vector absmax
+        scales) — ~2× batch slots or context per HBM byte, at a small,
+        bounded numeric cost (models/transformer._Int8KVCodec).
     """
 
     def __init__(self, cfg, params, max_streams: int = 4,
@@ -142,7 +145,8 @@ class ContinuousBatchingEngine:
                  temperature: float = 0.0, top_k: int = 0,
                  eos_id: Optional[int] = None, seed: int = 0,
                  min_bucket: int = 16, mesh=None,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 kv_quant: Optional[str] = None):
         import jax
         import jax.numpy as jnp
 
@@ -171,9 +175,10 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"serving: prefill_chunk must be in (0, {self.S}), got "
                 f"{prefill_chunk}")
-        self._decode = build_decode_step(cfg, self.S)
-        self._prefill_fn = build_prefill(cfg, self.S)
-        self._chunk_fn = build_chunk_decode(cfg, self.S)
+        self.kv_quant = kv_quant
+        self._decode = build_decode_step(cfg, self.S, kv_codec=kv_quant)
+        self._prefill_fn = build_prefill(cfg, self.S, kv_codec=kv_quant)
+        self._chunk_fn = build_chunk_decode(cfg, self.S, kv_codec=kv_quant)
         #: in-progress chunked admission: (request, slot, cache1, k) with
         #: k = next chunk index; one at a time, advanced between dispatches
         self._partial = None
@@ -216,12 +221,21 @@ class ContinuousBatchingEngine:
                 k: jax.device_put(v, NamedSharding(mesh, prune(specs[k])))
                 for k, v in params.items()
             }
-            cache_sh = NamedSharding(
-                mesh, P(None, None, dp, None, tp, None))
-            self._init_cache = lambda: jax.device_put(
-                init_cache(cfg, self.B, self.S), cache_sh)
+
+            def shard_cache(cache):
+                # cache leaves: values [L,2,B,S,h,dh] and (int8 codec)
+                # scales [L,2,B,S,h] — same prefix, so slice the spec to
+                # each leaf's rank
+                full = (None, None, dp, None, tp, None)
+                return jax.tree.map(
+                    lambda a: jax.device_put(
+                        a, NamedSharding(mesh, P(*full[:a.ndim]))), cache)
+
+            self._init_cache = lambda: shard_cache(
+                init_cache(cfg, self.B, self.S, kv_codec=kv_quant))
         else:
-            self._init_cache = lambda: init_cache(cfg, self.B, self.S)
+            self._init_cache = lambda: init_cache(cfg, self.B, self.S,
+                                                  kv_codec=kv_quant)
         self._cache = self._init_cache()
         self._pending: "_queue.Queue[_PendingRequest]" = _queue.Queue()
         self._next_id = 0
@@ -261,8 +275,13 @@ class ContinuousBatchingEngine:
         self._sample_first = jax.jit(sample)
 
         def insert(cache, cache1, slot):
-            return jax.lax.dynamic_update_slice(
-                cache, cache1, (0, 0, slot, 0, 0, 0))
+            # tree-aware: raw caches are one [L,2,B,S,h,dh] array; the
+            # int8 codec adds a rank-5 scales leaf — slot is batch axis 2
+            # in every leaf
+            return jax.tree.map(
+                lambda c, u: jax.lax.dynamic_update_slice(
+                    c, u.astype(c.dtype),
+                    (0, 0, slot) + (0,) * (c.ndim - 3)), cache, cache1)
 
         self._insert = jax.jit(insert, donate_argnums=(0,))
 
@@ -386,7 +405,8 @@ class ContinuousBatchingEngine:
         from nnstreamer_tpu.models.transformer import init_cache
 
         self._slots[slot] = self._RESERVED
-        self._partial = (req, slot, init_cache(self.cfg, 1, self.S), 0)
+        self._partial = (req, slot, init_cache(self.cfg, 1, self.S,
+                                               kv_codec=self.kv_quant), 0)
 
     def _advance_partial(self):
         """Run ONE prefill chunk; on the last chunk, activate the slot."""
@@ -427,8 +447,8 @@ class ContinuousBatchingEngine:
             np.uint32)[None]
         first, key = self._sample_first(logits, jnp.asarray(key))
         first = int(np.asarray(first)[0])
-        self._cache = self._insert(self._cache, cache1.astype(
-            self._cache.dtype), slot)
+        # dtype alignment happens inside the tree-aware _insert
+        self._cache = self._insert(self._cache, cache1, slot)
         self._slots[slot] = req.stream
         self._pos[slot] = n
         self._last[slot] = first
