@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import queue as _queue
 import threading
+import time
 from typing import Dict
 
 import numpy as np
@@ -76,12 +77,22 @@ class TensorLMServe(Element):
         self.add_sink_pad("sink")
         self.add_src_pad("src")
         self._engine = None
+        from nnstreamer_tpu.utils.stats import InvokeStats
+
+        #: submit→completion wall time per request — surfaced as this
+        #: element's ``latency``/``throughput`` properties (the base
+        #: ``stats`` window only times the synchronous chain() hand-off,
+        #: which for an async element is meaningless µs)
+        self.request_stats = InvokeStats()
         self._fifos: Dict[int, _queue.Queue] = {}
         self._drainers: Dict[int, threading.Thread] = {}
         self._state_lock = threading.Lock()
         self._push_lock = threading.Lock()  # serialize downstream pushes
         self._inflight = 0
         self._idle = threading.Condition(self._state_lock)
+
+    def _metrics_stats(self):
+        return self.request_stats
 
     def start(self):
         super().start()
@@ -117,14 +128,14 @@ class TensorLMServe(Element):
                 max_new = int(np.asarray(buf.tensors[1]).reshape(-1)[0])
             max_new = int(buf.meta.get("lm_max_new", max_new))
             stream = self._engine.submit(prompt, max_new_tokens=max_new)
-            self._enqueue(cid, (stream, buf, None))
+            self._enqueue(cid, (stream, buf, None, time.monotonic()))
         except Exception as e:  # noqa: BLE001 — a malformed remote
             # request must not error the server pipeline (remote DoS);
             # its error response goes through the SAME per-client fifo so
             # it cannot overtake earlier in-flight completions (the wire
             # matches responses to requests by order)
             self.log.warning("client %d request rejected: %s", cid, e)
-            self._enqueue(cid, (None, buf, str(e)))
+            self._enqueue(cid, (None, buf, str(e), time.monotonic()))
         return FlowReturn.OK
 
     def _enqueue(self, cid: int, item) -> None:
@@ -168,7 +179,7 @@ class TensorLMServe(Element):
                 continue
             if item is self._EOS:
                 return
-            stream, buf, err = item
+            stream, buf, err, t0 = item
             try:
                 if stream is None:  # rejected at intake, in FIFO order
                     self._push_response(self._error_response(buf, err))
@@ -181,6 +192,10 @@ class TensorLMServe(Element):
                     # client still gets the documented -1 error response
                     self._push_response(self._error_response(buf, reason))
                     continue
+                # the serving analog of the filter's invoke window
+                # (tensor_filter.c:325-423): one sample per SUCCESSFUL
+                # request — failures must not floor the latency window
+                self.request_stats.record(time.monotonic() - t0)
                 out = buf.with_tensors(
                     [np.asarray(toks, np.int32)]).replace(meta={
                         **buf.meta,

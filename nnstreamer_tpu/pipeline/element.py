@@ -212,16 +212,23 @@ class Element:
     def get_property(self, key: str) -> Any:
         key = key.replace("-", "_")
         if key in ("latency", "throughput"):
-            # a fused member doesn't run its own chain; its best-available
-            # number is the region's single-dispatch stat (documented: when
-            # fused, element latency == region dispatch latency)
-            stats = self.stats
-            region = getattr(self, "_fused_region", None)
-            if region is not None and stats.total_invokes == 0:
-                stats = region.stats
+            stats = self._metrics_stats()
             return stats.latency_us if key == "latency" else \
                 stats.throughput_milli
         return self._props[key]
+
+    def _metrics_stats(self):
+        """The InvokeStats behind the ``latency``/``throughput``
+        properties. Default: this element's chain window; a fused member
+        that doesn't run its own chain reads the region's single-dispatch
+        stat (documented: when fused, element latency == region dispatch
+        latency). Async elements override to report the meaningful
+        window (e.g. tensor_lm_serve's submit→completion per request)."""
+        stats = self.stats
+        region = getattr(self, "_fused_region", None)
+        if region is not None and stats.total_invokes == 0:
+            stats = region.stats
+        return stats
 
     def _coerce_property(self, key: str, value: Any) -> Any:
         """Coerce string property values (from parse_launch) to the default's

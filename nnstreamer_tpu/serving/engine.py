@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import queue as _queue
 import threading
+import time as _time
 from typing import Any, Callable, Dict, Iterator, List, Optional
 
 import numpy as np
@@ -247,6 +248,14 @@ class ContinuousBatchingEngine:
             "tokens_generated": 0, "dispatches": 0, "prefills": 0,
             "prefill_chunks": 0, "slot_steps": 0, "active_slot_steps": 0,
         }
+        from nnstreamer_tpu.utils.stats import InvokeStats
+
+        #: reference-style windowed read-outs (latency_us = one [B,K]
+        #: dispatch wall time incl. the token fetch; throughput_milli =
+        #: dispatches/s ×1000) — the SAME instrument every pipeline
+        #: element exposes (utils/stats.py), so engine and element
+        #: metrics read uniformly
+        self.invoke_stats = InvokeStats()
 
         from nnstreamer_tpu.models.transformer import make_sampler
 
@@ -511,10 +520,16 @@ class ContinuousBatchingEngine:
                     self._wake.clear()
                 continue
             try:
+                t0 = _time.monotonic()
                 toks, self._cache, keys = self._dispatch(
                     self.params, jnp.asarray(self._last),
                     self._cache, jnp.asarray(self._pos),
                     jnp.asarray(self._keys))
+                toks = np.asarray(toks)  # [B,K] — the only D2H; timed
+                # so latency_us reflects real completion, not async
+                # hand-off; recorded only on success (a hung-then-failed
+                # dispatch must not dominate the latency window)
+                self.invoke_stats.record(_time.monotonic() - t0)
             except Exception as e:  # noqa: BLE001 — a device failure must
                 # not strand clients blocked on their streams: fail every
                 # in-flight stream (and any half-ingested prompt), rebuild
@@ -532,7 +547,6 @@ class ContinuousBatchingEngine:
                         self._slots[slot] = None
                 self._cache = self._init_cache()
                 continue
-            toks = np.asarray(toks)            # [B, K] — the only D2H
             # np.array (copy): asarray on a jax array yields a READ-ONLY
             # view, and _admit writes per-slot keys in place
             self._keys = np.array(keys)

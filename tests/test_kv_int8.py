@@ -110,10 +110,11 @@ def test_engine_with_q8_cache_generates_deterministically():
     q1, q2 = run(kv_quant="int8"), run(kv_quant="int8")
     assert q1 == q2 and len(q1) == 8
     exact = run()
-    # greedy argmax usually survives the quantization noise on a tiny
-    # model; require agreement on the first tokens (not all — drift
-    # compounds, and exactness is not the int8 contract)
-    assert q1[:2] == exact[:2]
+    # the first token comes from un-quantized prefill activations and is
+    # bit-identical; later tokens read the int8 cache where argmax may
+    # legitimately flip within the drift bound — exactness is not the
+    # int8 contract
+    assert q1[:1] == exact[:1]
 
 
 def test_engine_q8_with_chunked_prefill():

@@ -170,6 +170,28 @@ def test_idle_drainers_retire():
         unregister_engine("lm_idle")
 
 
+def test_serve_element_records_request_latency():
+    engine = ContinuousBatchingEngine(
+        CFG, PARAMS, max_streams=2, steps_per_dispatch=4,
+        temperature=0.0).start()
+    register_engine("lm_stats", engine)
+    server = parse_launch(
+        "tensor_query_serversrc name=ssrc port=0 ! "
+        "tensor_lm_serve engine=lm_stats max-new-tokens=4 name=serve ! "
+        "tensor_query_serversink")
+    server.start()
+    try:
+        results = {}
+        _client(server.get("ssrc").port, [[3, 4]], results, 0)
+        serve = server.get("serve")
+        assert serve.get_property("latency") > 0  # element-standard prop
+        assert serve.request_stats.total_invokes == 1
+    finally:
+        server.stop()
+        engine.stop()
+        unregister_engine("lm_stats")
+
+
 def test_unregistered_engine_fails_start():
     pipe = parse_launch(
         "tensor_query_serversrc name=ssrc port=0 ! "

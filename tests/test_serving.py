@@ -257,6 +257,12 @@ def test_chunked_prefill_prompt_limit():
         ContinuousBatchingEngine(CFG, PARAMS, prefill_chunk=CFG.max_seq)
 
 
+def test_engine_invoke_stats_populated(engine):
+    engine.generate([4, 4, 4], max_new_tokens=6, timeout=240)
+    assert engine.invoke_stats.total_invokes >= 1
+    assert engine.invoke_stats.latency_us > 0
+
+
 def test_submit_before_start_rejected():
     eng = ContinuousBatchingEngine(CFG, PARAMS, max_streams=1)
     with pytest.raises(RuntimeError):
