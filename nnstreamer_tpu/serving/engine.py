@@ -138,6 +138,13 @@ class ContinuousBatchingEngine:
     kv_quant: ``"int8"`` stores the KV cache quantized (per-vector absmax
         scales) — ~2× batch slots or context per HBM byte, at a small,
         bounded numeric cost (models/transformer._Int8KVCodec).
+    prefix_cache: keep the KV of the last N admitted prompts device-
+        resident and, when a new prompt extends a cached one, prefill
+        only the remainder — the multi-turn/system-prompt reuse pattern.
+        Exact by construction: causal kv depends only on the prefix
+        tokens, so reused entries are the same arrays a cold prefill
+        would produce. HBM cost ≈ N × prompt_len × per-token kv bytes
+        (LRU-evicted). 0 (default) disables.
     """
 
     def __init__(self, cfg, params, max_streams: int = 4,
@@ -147,7 +154,8 @@ class ContinuousBatchingEngine:
                  eos_id: Optional[int] = None, seed: int = 0,
                  min_bucket: int = 16, mesh=None,
                  prefill_chunk: Optional[int] = None,
-                 kv_quant: Optional[str] = None):
+                 kv_quant: Optional[str] = None,
+                 prefix_cache: int = 0):
         import jax
         import jax.numpy as jnp
 
@@ -247,7 +255,17 @@ class ContinuousBatchingEngine:
         self.stats: Dict[str, Any] = {
             "tokens_generated": 0, "dispatches": 0, "prefills": 0,
             "prefill_chunks": 0, "slot_steps": 0, "active_slot_steps": 0,
+            "prefix_hits": 0, "prefix_tokens_reused": 0,
         }
+        import collections
+
+        self.prefix_cache = int(prefix_cache)
+        if self.prefix_cache < 0:
+            raise ValueError(
+                f"serving: prefix_cache must be >= 0, got {prefix_cache}")
+        #: tuple(prompt ids) → (kv pytree [L,2,1,n,...], logits[1,V]) —
+        #: LRU, engine-thread only
+        self._prefix: "collections.OrderedDict" = collections.OrderedDict()
         from nnstreamer_tpu.utils.stats import InvokeStats
 
         #: reference-style windowed read-outs (latency_us = one [B,K]
@@ -299,6 +317,7 @@ class ContinuousBatchingEngine:
         # chunked-prefill program: ONE executable at shape [1, chunk]
         self._chunk_jitted = jax.jit(self._chunk_fn, donate_argnums=(2,))
         self._jnp = jnp
+        self._jax = jax
 
     # -- public API -----------------------------------------------------------
     def start(self) -> "ContinuousBatchingEngine":
@@ -395,35 +414,139 @@ class ContinuousBatchingEngine:
             b *= 2
         return min(b, self.S)
 
+    # -- prefix cache (engine thread only) ------------------------------------
+    def _prefix_lookup(self, prompt: np.ndarray):
+        """Longest COMMON prefix between ``prompt`` and any cached entry
+        (two different user prompts sharing a system preamble still
+        reuse the shared part); returns (p, kv sliced to p, logits) —
+        logits only when the whole prompt equals a whole stored key."""
+        best_key, best_lcp = None, 0
+        for key in self._prefix:
+            karr = np.asarray(key, np.int32)
+            m = min(karr.size, prompt.size)
+            neq = np.nonzero(karr[:m] != prompt[:m])[0]
+            lcp = int(neq[0]) if neq.size else m
+            # strict > keeps the first-found on ties EXCEPT an exact
+            # whole-prompt match, which always wins — it alone carries
+            # reusable logits (the zero-prefill repeat path)
+            exact = lcp == prompt.size == len(key)
+            if lcp > best_lcp or (exact and lcp >= best_lcp):
+                best_key, best_lcp = key, lcp
+                if exact:
+                    break
+        if best_key is None:
+            return 0, None, None
+        self._prefix.move_to_end(best_key)
+        kv, logits = self._prefix[best_key]
+        if not (best_lcp == prompt.size == len(best_key)):
+            logits = None
+        if logits is None and best_lcp == prompt.size:
+            # whole prompt covered by a LONGER stored key: we have its kv
+            # but not its last-position logits — recompute one position
+            best_lcp -= 1
+        if best_lcp < len(best_key):
+            kv = self._jax.tree.map(lambda a: a[:, :, :, :best_lcp], kv)
+        if best_lcp <= 0:
+            return 0, None, None
+        return best_lcp, kv, logits
+
+    def _prefix_store(self, prompt: np.ndarray, cache1, logits):
+        if not self.prefix_cache:
+            return
+        key = tuple(int(t) for t in prompt)
+        n = prompt.size
+        # slice slot-S down to the prompt's n positions (axis 3 = S in
+        # every cache leaf, values and int8 scales alike)
+        kv = self._jax.tree.map(lambda a: a[:, :, :, :n], cache1)
+        self._prefix[key] = (kv, logits)
+        self._prefix.move_to_end(key)
+        while len(self._prefix) > self.prefix_cache:
+            self._prefix.popitem(last=False)
+
+    def _place_prefix_kv(self, cache1, kv):
+        """Write a cached kv slice into slots [0, n) of a fresh cache."""
+        jax = self._jax
+        return jax.tree.map(
+            lambda c, u: jax.lax.dynamic_update_slice(
+                c, u.astype(c.dtype), (0,) * c.ndim), cache1, kv)
+
     def _admit(self, req: _PendingRequest, slot: int):
         jnp = self._jnp
         prompt = req.prompt
         n = prompt.size
+        p, kv, cached_logits = (self._prefix_lookup(prompt)
+                                if self.prefix_cache else (0, None, None))
+        if p == n:  # whole prompt cached: zero prefill compute
+            self.stats["prefix_hits"] += 1
+            self.stats["prefix_tokens_reused"] += p
+            cache1 = self._place_prefix_kv(self._init_cache1(), kv)
+            self._activate(req, slot, cached_logits, cache1)
+            return
+        if p > 0 and p + self._bucket(n - p) <= self.S:
+            # prefill only the remainder through the chunk program (the
+            # bound keeps the padded chunk's writes inside the cache —
+            # a near-capacity prompt just takes the cold path)
+            self.stats["prefix_hits"] += 1
+            self.stats["prefix_tokens_reused"] += p
+            cache1 = self._place_prefix_kv(self._init_cache1(), kv)
+            rem = n - p
+            bucket = self._bucket(rem)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :rem] = prompt[p:]
+            logits, cache1 = self._chunk_jitted(
+                self.params, jnp.asarray(padded), cache1,
+                jnp.asarray(p, jnp.int32))
+            logits = logits[:, rem - 1]
+            self._prefix_store(prompt, cache1, logits)
+            self._activate(req, slot, logits, cache1)
+            return
         bucket = self._bucket(n)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :n] = prompt
         logits, cache1 = self._prefill_jitted(
             self.params, jnp.asarray(padded),
             lengths=jnp.asarray([n], jnp.int32))
+        self._prefix_store(prompt, cache1, logits)
         self._activate(req, slot, logits, cache1)
+
+    def _init_cache1(self):
+        from nnstreamer_tpu.models.transformer import init_cache
+
+        return init_cache(self.cfg, 1, self.S, kv_codec=self.kv_quant)
 
     #: reserves a batch slot while its chunked prefill is in flight
     _RESERVED = object()
 
     def _begin_partial(self, req: _PendingRequest, slot: int):
-        from nnstreamer_tpu.models.transformer import init_cache
-
+        base = 0
+        cache1 = self._init_cache1()
+        if self.prefix_cache:
+            p, kv, cached_logits = self._prefix_lookup(req.prompt)
+            if p == req.prompt.size:  # whole prompt cached: no chunks
+                self.stats["prefix_hits"] += 1
+                self.stats["prefix_tokens_reused"] += p
+                cache1 = self._place_prefix_kv(cache1, kv)
+                self._activate(req, slot, cached_logits, cache1)
+                return
+            elif (p // self.prefill_chunk) > 0:
+                # resume at the last chunk boundary <= p: chunk starts
+                # stay multiples of C (the submit-time bound assumes it),
+                # recomputing at most C-1 cached positions. A hit below
+                # one chunk (base would be 0) is a miss — nothing reusable
+                self.stats["prefix_hits"] += 1
+                base = (p // self.prefill_chunk) * self.prefill_chunk
+                self.stats["prefix_tokens_reused"] += base
+                cache1 = self._place_prefix_kv(cache1, kv)
         self._slots[slot] = self._RESERVED
-        self._partial = (req, slot, init_cache(self.cfg, 1, self.S,
-                                               kv_codec=self.kv_quant), 0)
+        self._partial = (req, slot, cache1, 0, base)
 
     def _advance_partial(self):
         """Run ONE prefill chunk; on the last chunk, activate the slot."""
         jnp = self._jnp
-        req, slot, cache1, k = self._partial
+        req, slot, cache1, k, base = self._partial
         C = self.prefill_chunk
         prompt, n = req.prompt, req.prompt.size
-        start = k * C
+        start = base + k * C
         end = min(start + C, n)
         chunk = np.zeros((1, C), np.int32)
         chunk[0, :end - start] = prompt[start:end]
@@ -433,11 +556,13 @@ class ContinuousBatchingEngine:
                 jnp.asarray(start, jnp.int32))
             self.stats["prefill_chunks"] += 1
             if end < n:
-                self._partial = (req, slot, cache1, k + 1)
+                self._partial = (req, slot, cache1, k + 1, base)
                 return
             # final chunk: logits at the prompt's true last position
             self._partial = None
-            self._activate(req, slot, logits[:, (n - 1) - start], cache1)
+            logits_last = logits[:, (n - 1) - start]
+            self._prefix_store(prompt, cache1, logits_last)
+            self._activate(req, slot, logits_last, cache1)
         except Exception as e:  # noqa: BLE001 — a failed chunk must free
             # the reserved slot and fail only this request
             log.warning("serving: chunked prefill failed: %s", e)

@@ -257,6 +257,143 @@ def test_chunked_prefill_prompt_limit():
         ContinuousBatchingEngine(CFG, PARAMS, prefill_chunk=CFG.max_seq)
 
 
+def test_prefix_cache_exact_hit_skips_prefill():
+    eng = ContinuousBatchingEngine(
+        CFG, PARAMS, max_streams=2, steps_per_dispatch=4,
+        temperature=0.0, prefix_cache=4).start()
+    try:
+        prompt = [5, 11, 23, 42]
+        a = eng.generate(prompt, max_new_tokens=7, timeout=240)
+        prefills_before = eng.stats["prefills"]
+        b = eng.generate(prompt, max_new_tokens=7, timeout=240)
+    finally:
+        eng.stop()
+    assert a == b == reference_greedy(prompt, 7)
+    assert eng.stats["prefix_hits"] == 1
+    assert eng.stats["prefix_tokens_reused"] == len(prompt)
+    # the hit still counts as an admission ("prefills") but computed no
+    # new prefill program — verified by exactness + the hit counter
+    assert eng.stats["prefills"] == prefills_before + 1
+
+
+def test_prefix_cache_extension_is_exact():
+    """A ... then A+B: the warm engine's A+B output must equal a cold
+    engine's — reused kv is the same array a cold prefill computes."""
+    base = [7, 3, 11, 30, 2, 9]
+    full = base + [14, 27, 5]
+    cold = reference_greedy(full, 9)
+    eng = ContinuousBatchingEngine(
+        CFG, PARAMS, max_streams=2, steps_per_dispatch=4,
+        temperature=0.0, prefix_cache=4).start()
+    try:
+        eng.generate(base, max_new_tokens=3, timeout=240)
+        got = eng.generate(full, max_new_tokens=9, timeout=240)
+    finally:
+        eng.stop()
+    assert got == cold
+    assert eng.stats["prefix_hits"] == 1
+    assert eng.stats["prefix_tokens_reused"] == len(base)
+
+
+def test_prefix_cache_with_chunked_prefill():
+    base = [(i * 13 + 5) % CFG.vocab for i in range(17)]
+    full = base + [(i * 7 + 1) % CFG.vocab for i in range(9)]
+    cold = reference_greedy(full, 6)
+    eng = ContinuousBatchingEngine(
+        CFG, PARAMS, max_streams=2, steps_per_dispatch=4,
+        temperature=0.0, prefix_cache=4, prefill_chunk=8).start()
+    try:
+        eng.generate(base, max_new_tokens=3, timeout=240)
+        chunks_before = eng.stats["prefill_chunks"]
+        got = eng.generate(full, max_new_tokens=6, timeout=240)
+        chunks_used = eng.stats["prefill_chunks"] - chunks_before
+    finally:
+        eng.stop()
+    assert got == cold
+    # resume at chunk boundary 16 (p=17 → base 16): 26 tokens need
+    # chunks [16,24) and [24,32) — two, not ceil(26/8)=4
+    assert chunks_used == 2
+    assert eng.stats["prefix_tokens_reused"] == 16
+
+
+def test_prefix_cache_shared_system_prompt():
+    """Two DIFFERENT prompts sharing a preamble: the second reuses the
+    common prefix of the first's cached kv (LCP match, not whole-entry
+    match) and stays exact."""
+    system = [9, 21, 33, 45, 2, 17, 8, 30]
+    u1 = system + [50, 51]
+    u2 = system + [60, 61, 62]
+    cold_u2 = reference_greedy(u2, 8)
+    eng = ContinuousBatchingEngine(
+        CFG, PARAMS, max_streams=2, steps_per_dispatch=4,
+        temperature=0.0, prefix_cache=4).start()
+    try:
+        eng.generate(u1, max_new_tokens=3, timeout=240)
+        got = eng.generate(u2, max_new_tokens=8, timeout=240)
+    finally:
+        eng.stop()
+    assert got == cold_u2
+    assert eng.stats["prefix_hits"] == 1
+    assert eng.stats["prefix_tokens_reused"] == len(system)
+
+
+def test_prefix_cache_prompt_inside_longer_entry():
+    """The new prompt is a strict PREFIX of a stored key: kv is reused
+    for n-1 positions and the last position recomputes for its logits."""
+    long_p = [5, 11, 23, 42, 7, 9, 14]
+    short_p = long_p[:4]
+    ref = reference_greedy(short_p, 6)
+    eng = ContinuousBatchingEngine(
+        CFG, PARAMS, max_streams=2, steps_per_dispatch=4,
+        temperature=0.0, prefix_cache=4).start()
+    try:
+        eng.generate(long_p, max_new_tokens=3, timeout=240)
+        got = eng.generate(short_p, max_new_tokens=6, timeout=240)
+    finally:
+        eng.stop()
+    assert got == ref
+    assert eng.stats["prefix_hits"] == 1
+    assert eng.stats["prefix_tokens_reused"] == len(short_p) - 1
+
+
+def test_prefix_cache_exact_repeat_wins_over_longer_tie():
+    """With both [1..5] and [1..3] cached, resubmitting [1..3] must take
+    the zero-prefill exact path (stored logits), not the longer key."""
+    eng = ContinuousBatchingEngine(
+        CFG, PARAMS, max_streams=1, steps_per_dispatch=4,
+        temperature=0.0, prefix_cache=4).start()
+    try:
+        eng.generate([1, 2, 3, 4, 5], max_new_tokens=3, timeout=240)
+        first = eng.generate([1, 2, 3], max_new_tokens=3, timeout=240)
+        reused_before = eng.stats["prefix_tokens_reused"]
+        again = eng.generate([1, 2, 3], max_new_tokens=3, timeout=240)
+        reused = eng.stats["prefix_tokens_reused"] - reused_before
+    finally:
+        eng.stop()
+    assert first == again == reference_greedy([1, 2, 3], 3)
+    assert reused == 3  # whole prompt, not len-1 via the longer key
+
+
+def test_prefix_cache_validation():
+    with pytest.raises(ValueError):
+        ContinuousBatchingEngine(CFG, PARAMS, prefix_cache=-1)
+
+
+def test_prefix_cache_lru_eviction():
+    eng = ContinuousBatchingEngine(
+        CFG, PARAMS, max_streams=1, steps_per_dispatch=4,
+        temperature=0.0, prefix_cache=1).start()
+    try:
+        for p in ([1, 2], [3, 4], [5, 6]):
+            eng.generate(p, max_new_tokens=3, timeout=240)
+        assert len(eng._prefix) == 1
+        # oldest evicted: repeating the first prompt is a miss
+        eng.generate([1, 2], max_new_tokens=3, timeout=240)
+        assert eng.stats["prefix_hits"] == 0
+    finally:
+        eng.stop()
+
+
 def test_engine_invoke_stats_populated(engine):
     engine.generate([4, 4, 4], max_new_tokens=6, timeout=240)
     assert engine.invoke_stats.total_invokes >= 1
