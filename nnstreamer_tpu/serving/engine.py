@@ -482,10 +482,15 @@ class ContinuousBatchingEngine:
             cache1 = self._place_prefix_kv(self._init_cache1(), kv)
             self._activate(req, slot, cached_logits, cache1)
             return
-        if p > 0 and p + self._bucket(n - p) <= self.S:
-            # prefill only the remainder through the chunk program (the
-            # bound keeps the padded chunk's writes inside the cache —
-            # a near-capacity prompt just takes the cold path)
+        if (p >= self.PREFIX_MIN_REUSE
+                and p + self._bucket(n - p) <= self.S):
+            # prefill only the remainder through the chunk program. The
+            # first bound skips near-useless hits (a 1-token overlap
+            # costs a cache copy to save one token of an already-compiled
+            # prefill — the chunked path's sub-chunk-is-a-miss rule,
+            # bucketed flavor); the second keeps the padded chunk's
+            # writes inside the cache (a near-capacity prompt just takes
+            # the cold path)
             self.stats["prefix_hits"] += 1
             self.stats["prefix_tokens_reused"] += p
             cache1 = self._place_prefix_kv(self._init_cache1(), kv)
@@ -516,6 +521,10 @@ class ContinuousBatchingEngine:
 
     #: reserves a batch slot while its chunked prefill is in flight
     _RESERVED = object()
+
+    #: minimum common-prefix length worth a warm (remainder-only)
+    #: admission; exact whole-prompt hits are never thresholded
+    PREFIX_MIN_REUSE = 4
 
     def _begin_partial(self, req: _PendingRequest, slot: int):
         base = 0

@@ -184,3 +184,94 @@ def test_golden_clamp_out_of_range_bounds(tmp_path):
         "tensor_converter ! tensor_transform mode=clamp option=-1:300 ! "
         "filesink location={out}",
         golden)
+
+
+def test_golden_dimchg(tmp_path):
+    """mode=dimchg option=0:2 moves dim 0 to position 2 (reference
+    tensor_transform dimchg semantics) — pure relayout, byte-exact."""
+    frames = _src_frames(3, 8, 6)  # rank-4 (1, H, W, C)
+    # reference dims are innermost-first (C:W:H:N): option=0:2 moves
+    # ref-dim 0 (C, numpy axis -1) to ref-slot 2 (numpy axis 1)
+    golden = b"".join(np.moveaxis(f, 3, 1).tobytes() for f in frames)
+    _run_golden(
+        tmp_path,
+        "videotestsrc num-buffers=3 width=8 height=6 pattern=gradient ! "
+        "tensor_converter ! tensor_transform mode=dimchg option=0:2 ! "
+        "filesink location={out}",
+        golden)
+
+
+def test_golden_split_seg(tmp_path):
+    """tensor_split by size spec: first segment of the channel dim."""
+    frames = _src_frames(3, 8, 8)
+    golden = b"".join(f[..., :1].tobytes() for f in frames)
+    _run_golden(
+        tmp_path,
+        "videotestsrc num-buffers=3 width=8 height=8 pattern=gradient ! "
+        "tensor_converter ! tensor_split name=s tensorseg=1,2 "
+        "dimension=0  s. ! filesink location={out}  "
+        "s. ! fakesink",
+        golden)
+
+
+def test_golden_merge_linear(tmp_path):
+    """tensor_merge mode=linear option=<dim>: two streams concatenated
+    along the channel dim (reference merge SSAT groups)."""
+    frames = _src_frames(3, 8, 8)
+    golden = b"".join(np.concatenate([f, f], axis=-1).tobytes()
+                      for f in frames)
+    _run_golden(
+        tmp_path,
+        "tensor_merge name=m mode=linear option=0 sync-mode=slowest ! "
+        "filesink location={out}  "
+        "videotestsrc num-buffers=3 width=8 height=8 pattern=gradient ! "
+        "tensor_converter ! m.  "
+        "videotestsrc num-buffers=3 width=8 height=8 pattern=gradient ! "
+        "tensor_converter ! m.",
+        golden)
+
+
+def test_golden_tensor_if_skip(tmp_path):
+    """tensor_if TENSOR_AVERAGE_VALUE: gradient frames average ~127, so
+    `lt 200` is TRUE and then=SKIP drops every frame — the dump is empty
+    because the SKIP action ran (not because an unlinked else pad
+    swallowed the data)."""
+    _run_golden(
+        tmp_path,
+        "videotestsrc num-buffers=3 width=8 height=8 pattern=gradient ! "
+        "tensor_converter ! tensor_if compared-value=TENSOR_AVERAGE_VALUE "
+        "compared-value-option=0 operator=lt supplied-value=200 "
+        "then=SKIP else=PASSTHROUGH ! filesink location={out}",
+        b"")
+
+
+def test_golden_tensor_if_passthrough(tmp_path):
+    frames = _src_frames(2, 8, 8)
+    golden = b"".join(f.tobytes() for f in frames)
+    _run_golden(
+        tmp_path,
+        "videotestsrc num-buffers=2 width=8 height=8 pattern=gradient ! "
+        "tensor_converter ! tensor_if compared-value=TENSOR_AVERAGE_VALUE "
+        "compared-value-option=0 operator=lt supplied-value=200 "
+        "then=PASSTHROUGH else=SKIP ! filesink location={out}",
+        golden)
+
+
+def test_golden_quant_roundtrip_exact_on_integers(tmp_path):
+    """tensor_quant_enc ! dec: uint8 sources dequantize byte-exact after
+    typecast back (values 0..255 scale to int8 and back losslessly only
+    when the frame max is representable — gradient's 0..255/127 scale is
+    NOT lossless in general, so compare against the quant math itself)."""
+    frames = _src_frames(2, 8, 8)
+    from nnstreamer_tpu.elements.quant import quant_decode, quant_encode
+
+    golden = b"".join(
+        quant_decode(quant_encode(f.astype(np.float32)))[0].tobytes()
+        for f in frames)
+    _run_golden(
+        tmp_path,
+        "videotestsrc num-buffers=2 width=8 height=8 pattern=gradient ! "
+        "tensor_converter ! tensor_transform mode=typecast "
+        "option=float32 ! tensor_quant_enc ! tensor_quant_dec ! "
+        "filesink location={out}",
+        golden)

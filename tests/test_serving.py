@@ -341,7 +341,7 @@ def test_prefix_cache_prompt_inside_longer_entry():
     """The new prompt is a strict PREFIX of a stored key: kv is reused
     for n-1 positions and the last position recomputes for its logits."""
     long_p = [5, 11, 23, 42, 7, 9, 14]
-    short_p = long_p[:4]
+    short_p = long_p[:6]  # n-1 = 5 reusable, above PREFIX_MIN_REUSE
     ref = reference_greedy(short_p, 6)
     eng = ContinuousBatchingEngine(
         CFG, PARAMS, max_streams=2, steps_per_dispatch=4,
