@@ -208,6 +208,12 @@ class TensorLMServe(Element):
                 # order-matched protocol would attribute every later
                 # completion to the wrong request)
                 self.log.warning("client %d request failed: %s", cid, e)
+                if stream is not None:
+                    # e.g. result() timeout: the client already gets an
+                    # error response, so stop the engine from decoding
+                    # into the abandoned stream (its slot frees at the
+                    # next block boundary); idempotent if already done
+                    stream.cancel()
                 try:
                     self._push_response(self._error_response(buf, str(e)))
                 except Exception as e2:  # noqa: BLE001 — downstream gone

@@ -55,8 +55,17 @@ class GenerationStream:
         self.prompt_len = prompt_len
         self.tokens: List[int] = []  # generated so far (post-prompt)
         self.finished = False
-        self.finish_reason: Optional[str] = None  # "eos" | "length"
+        self.finish_reason: Optional[str] = None  # "eos"|"length"|...
+        self.cancelled = False
         self._q: _queue.Queue = _queue.Queue()
+
+    def cancel(self) -> None:
+        """Request cancellation (client gone, timeout, user abort): the
+        engine frees this stream's batch slot at the next block boundary
+        and finishes it with reason "cancelled". Pending (not yet
+        admitted) streams are dropped without prefilling. Safe from any
+        thread; idempotent; a no-op once finished."""
+        self.cancelled = True
 
     def __iter__(self) -> Iterator[int]:
         while True:
@@ -93,6 +102,8 @@ class GenerationStream:
         self._q.put(tok)
 
     def _finish(self, reason: str):
+        if self.finished:
+            return  # idempotent: cancel/stop/EOS may race benignly
         self.finished = True
         self.finish_reason = reason
         self._q.put(self._DONE)
@@ -618,6 +629,19 @@ class ContinuousBatchingEngine:
     def _loop(self):
         jnp = self._jnp
         while not self._stop_evt.is_set():
+            # honor cancellations first: active slots free at this block
+            # boundary; a half-ingested prompt stops mid-prefill
+            for slot in range(self.B):
+                st = self._slots[slot]
+                if (st is not None and st is not self._RESERVED
+                        and st.cancelled):
+                    self._slots[slot] = None
+                    st._finish("cancelled")
+            if self._partial is not None and self._partial[0].stream.cancelled:
+                _, slot, _, _, _ = self._partial
+                self._slots[slot] = None
+                self._partial[0].stream._finish("cancelled")
+                self._partial = None
             # in-flight chunked prefill: ONE chunk per iteration, so the
             # decode dispatch below keeps running streams moving while a
             # long prompt ingests
@@ -634,6 +658,9 @@ class ContinuousBatchingEngine:
                     req = self._pending.get_nowait()
                 except _queue.Empty:
                     break
+                if req.stream.cancelled:
+                    req.stream._finish("cancelled")
+                    continue
                 try:
                     if self.prefill_chunk is not None:
                         self._begin_partial(req, slot)

@@ -400,6 +400,46 @@ def test_engine_invoke_stats_populated(engine):
     assert engine.invoke_stats.latency_us > 0
 
 
+def test_cancel_active_stream_frees_slot():
+    import dataclasses
+
+    # large cache → budget min(max_new, S-n) ≈ 500: the engine cannot
+    # length-finish in the instants between first token and cancel, so
+    # the "cancelled" outcome is deterministic
+    cfg = dataclasses.replace(CFG, max_seq=512)
+    eng = ContinuousBatchingEngine(
+        cfg, PARAMS, max_streams=1, steps_per_dispatch=2,
+        temperature=0.0).start()
+    try:
+        s = eng.submit([1, 2, 3], max_new_tokens=500)
+        for _ in s:  # first token proves the stream is admitted + live
+            s.cancel()
+            break
+        s.result(timeout=240)
+        assert s.finish_reason == "cancelled"
+        assert len(s.tokens) < 500
+        # the single slot must be free again: a new stream completes
+        got = eng.generate([4, 5], max_new_tokens=4, timeout=240)
+        assert len(got) == 4
+    finally:
+        eng.stop()
+
+
+def test_cancel_pending_stream_never_admits():
+    eng = ContinuousBatchingEngine(
+        CFG, PARAMS, max_streams=1, steps_per_dispatch=2,
+        temperature=0.0).start()
+    try:
+        blocker = eng.submit([1, 2], max_new_tokens=200)  # hogs the slot
+        pending = eng.submit([3, 4], max_new_tokens=5)
+        pending.cancel()
+        assert pending.result(timeout=120) == []
+        assert pending.finish_reason == "cancelled"
+        blocker.cancel()
+    finally:
+        eng.stop()
+
+
 def test_concurrent_submit_stress():
     """Hammer submit() from many threads against few slots while streams
     complete and slots recycle: every stream must finish with the right
