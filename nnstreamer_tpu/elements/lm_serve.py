@@ -25,7 +25,8 @@ not meta, so the budget travels as payload); in-process pipelines may
 use ``lm_max_new`` buffer meta instead. The completion buffer carries
 ``lm_finish_reason`` and ``lm_prompt_len`` meta and preserves everything
 else (client id included) — meta is visible to downstream SERVER-side
-elements; the wire back to the client carries the token tensor only.
+elements; the wire back to the client carries TWO tensors: the generated
+ids (int32) and the model's per-token logprobs (float32).
 
 Failure contract: the framed protocol matches responses to requests BY
 ORDER, so every request gets exactly one response — a request that fails
@@ -85,6 +86,9 @@ class TensorLMServe(Element):
         #: which for an async element is meaningless µs)
         self.request_stats = InvokeStats()
         self._fifos: Dict[int, _queue.Queue] = {}
+        #: cid → stream the drainer is currently waiting on (for
+        #: cancel-on-stop/EOS-timeout coverage of dequeued items)
+        self._current: Dict[int, object] = {}
         self._drainers: Dict[int, threading.Thread] = {}
         self._state_lock = threading.Lock()
         self._push_lock = threading.Lock()  # serialize downstream pushes
@@ -105,12 +109,29 @@ class TensorLMServe(Element):
                 f"{self.name}: no engine registered as {name!r} "
                 f"(serving.register_engine first)")
 
+    def _cancel_all_inflight(self):
+        """Nobody will read these streams anymore — the engine must not
+        keep decoding into them (their slots free at the next block
+        boundary)."""
+        with self._state_lock:
+            fifos = list(self._fifos.values())
+            current = list(self._current.values())
+        for st in current:
+            if st is not None:
+                st.cancel()
+        for f in fifos:
+            for item in list(f.queue):
+                if isinstance(item, tuple) and item[0] is not None:
+                    item[0].cancel()
+
     def stop(self):
+        self._cancel_all_inflight()
         with self._state_lock:
             fifos = list(self._fifos.values())
             self._fifos.clear()
             drainers = list(self._drainers.values())
             self._drainers.clear()
+            self._current.clear()
         for f in fifos:
             f.put(self._EOS)
         for t in drainers:
@@ -180,6 +201,8 @@ class TensorLMServe(Element):
             if item is self._EOS:
                 return
             stream, buf, err, t0 = item
+            with self._state_lock:
+                self._current[cid] = stream
             try:
                 if stream is None:  # rejected at intake, in FIFO order
                     self._push_response(self._error_response(buf, err))
@@ -197,7 +220,12 @@ class TensorLMServe(Element):
                 # request — failures must not floor the latency window
                 self.request_stats.record(time.monotonic() - t0)
                 out = buf.with_tensors(
-                    [np.asarray(toks, np.int32)]).replace(meta={
+                    # tokens + the model's per-token logprobs (second
+                    # tensor — payload, so it crosses the wire like the
+                    # request's budget tensor does)
+                    [np.asarray(toks, np.int32),
+                     np.asarray(stream.logprobs[:len(toks)],
+                                np.float32)]).replace(meta={
                         **buf.meta,
                         "lm_finish_reason": reason,
                         "lm_prompt_len": stream.prompt_len,
@@ -221,6 +249,7 @@ class TensorLMServe(Element):
                                      "%s", cid, e2)
             finally:
                 with self._idle:
+                    self._current.pop(cid, None)
                     self._inflight -= 1
                     self._idle.notify_all()
 
@@ -233,7 +262,9 @@ class TensorLMServe(Element):
                     timeout=float(self.get_property("timeout")))
             if not done:
                 # late completions will hit an eos'd pad and vanish —
-                # surface WHY those clients never got a response
+                # stop the engine from decoding into them, and surface
+                # WHY those clients never got a response
+                self._cancel_all_inflight()
                 self.post_error(FlowError(
                     f"{self.name}: EOS with requests still in flight "
                     f"after {self.get_property('timeout')}s; remaining "

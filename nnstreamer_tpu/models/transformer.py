@@ -497,33 +497,47 @@ def build_greedy_stream_step(cfg: TransformerConfig,
 
 
 def make_sampler(vocab: int, temperature: float = 1.0,
-                 top_k: int = 0) -> Callable:
+                 top_k: int = 0,
+                 with_logprobs: bool = False) -> Callable:
     """The ONE sampling function: ``sample(logits[n, vocab],
     keys[uint32 n, 2]) -> (tokens[int32 n], new_keys[n, 2])`` — rows draw
     independently with their own threefry key, so results never depend on
     which other rows share the batch. ``temperature<=0`` degrades to
     greedy (keys pass through untouched); ``top_k>0`` restricts sampling
     to the k highest logits. Shared by the repo-loop sampled step and the
-    serving engine so their sampling math can never diverge."""
+    serving engine so their sampling math can never diverge.
+
+    ``with_logprobs=True`` appends ``logprobs[float32 n]`` — the chosen
+    token's log-probability under the UNMODIFIED distribution (fp32
+    log_softmax of the raw logits; temperature/top-k shape the draw, the
+    report stays the model's own confidence, the convention LM serving
+    APIs use)."""
 
     def sample(logits, keys):
         if temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32), keys
-        scaled = logits / temperature
-        if top_k > 0:
-            k = min(top_k, vocab)  # over-asking means "no restriction"
-            kth = jax.lax.top_k(scaled, k)[0][:, -1:]
-            scaled = jnp.where(scaled >= kth, scaled, -1e30)
+            toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            new_keys = keys
+        else:
+            scaled = logits / temperature
+            if top_k > 0:
+                k = min(top_k, vocab)  # over-asking = "no restriction"
+                kth = jax.lax.top_k(scaled, k)[0][:, -1:]
+                scaled = jnp.where(scaled >= kth, scaled, -1e30)
 
-        def row(key_row, logit_row):
-            kk = jax.random.wrap_key_data(
-                jnp.asarray(key_row, jnp.uint32), impl="threefry2x32")
-            kk, sub = jax.random.split(kk)
-            tok = jax.random.categorical(sub, logit_row)
-            return jax.random.key_data(kk), tok
+            def row(key_row, logit_row):
+                kk = jax.random.wrap_key_data(
+                    jnp.asarray(key_row, jnp.uint32), impl="threefry2x32")
+                kk, sub = jax.random.split(kk)
+                tok = jax.random.categorical(sub, logit_row)
+                return jax.random.key_data(kk), tok
 
-        new_keys, toks = jax.vmap(row)(keys, scaled)
-        return toks.astype(jnp.int32), new_keys
+            new_keys, toks = jax.vmap(row)(keys, scaled)
+            toks = toks.astype(jnp.int32)
+        if not with_logprobs:
+            return toks, new_keys
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        chosen = jnp.take_along_axis(logp, toks[:, None], axis=1)[:, 0]
+        return toks, new_keys, chosen
 
     return sample
 

@@ -54,6 +54,10 @@ class GenerationStream:
         self.stream_id = stream_id
         self.prompt_len = prompt_len
         self.tokens: List[int] = []  # generated so far (post-prompt)
+        #: chosen-token log-probabilities (model's own fp32 log_softmax,
+        #: independent of temperature/top-k draw shaping), parallel to
+        #: ``tokens``
+        self.logprobs: List[float] = []
         self.finished = False
         self.finish_reason: Optional[str] = None  # "eos"|"length"|...
         self.cancelled = False
@@ -97,8 +101,9 @@ class GenerationStream:
             out.append(item)
 
     # engine-side
-    def _emit(self, tok: int):
+    def _emit(self, tok: int, logprob: float = 0.0):
         self.tokens.append(tok)
+        self.logprobs.append(logprob)
         self._q.put(tok)
 
     def _finish(self, reason: str):
@@ -293,21 +298,22 @@ class ContinuousBatchingEngine:
         # the ONE sampling function (shared with the repo-loop sampled
         # step) — seeds the first token and every dispatch-loop draw with
         # identical math, per-row keys keeping streams batch-independent
-        sample = make_sampler(cfg.vocab, self.temperature, self.top_k)
+        sample = make_sampler(cfg.vocab, self.temperature, self.top_k,
+                              with_logprobs=True)
 
         def dispatch(params, token, cache, pos, keys):
             """K decode steps in one program: ([B],cache,[B],[B,2]) →
-            ([B,K] tokens, cache, keys)."""
+            ([B,K] tokens, [B,K] logprobs, cache, keys)."""
 
             def body(carry, _):
                 token, cache, pos, keys = carry
                 logits, cache = decode(params, token, cache, pos)
-                nxt, keys = sample(logits, keys)
-                return (nxt, cache, pos + 1, keys), nxt
+                nxt, keys, lp = sample(logits, keys)
+                return (nxt, cache, pos + 1, keys), (nxt, lp)
 
-            (token, cache, pos, keys), toks = jax.lax.scan(
+            (token, cache, pos, keys), (toks, lps) = jax.lax.scan(
                 body, (token, cache, pos, keys), None, length=K)
-            return jnp.transpose(toks), cache, keys
+            return jnp.transpose(toks), jnp.transpose(lps), cache, keys
 
         self._dispatch = jax.jit(dispatch, donate_argnums=(2,))
         self._sample_first = jax.jit(sample)
@@ -599,8 +605,9 @@ class ContinuousBatchingEngine:
         key = np.asarray(
             [self.seed & 0xFFFFFFFF, req.stream.stream_id & 0xFFFFFFFF],
             np.uint32)[None]
-        first, key = self._sample_first(logits, jnp.asarray(key))
+        first, key, first_lp = self._sample_first(logits, jnp.asarray(key))
         first = int(np.asarray(first)[0])
+        first_lp = float(np.asarray(first_lp)[0])
         # dtype alignment happens inside the tree-aware _insert
         self._cache = self._insert(self._cache, cache1, slot)
         self._slots[slot] = req.stream
@@ -609,7 +616,7 @@ class ContinuousBatchingEngine:
         self._keys[slot] = np.asarray(key)[0]
         # cap generation so cache writes stay inside the slot's S window
         self._budget[slot] = min(req.max_new, self.S - n)
-        req.stream._emit(first)
+        req.stream._emit(first, first_lp)
         self.stats["tokens_generated"] += 1
         self._post_emit(slot, first)
 
@@ -682,14 +689,15 @@ class ContinuousBatchingEngine:
                 continue
             try:
                 t0 = _time.monotonic()
-                toks, self._cache, keys = self._dispatch(
+                toks, lps, self._cache, keys = self._dispatch(
                     self.params, jnp.asarray(self._last),
                     self._cache, jnp.asarray(self._pos),
                     jnp.asarray(self._keys))
-                toks = np.asarray(toks)  # [B,K] — the only D2H; timed
-                # so latency_us reflects real completion, not async
-                # hand-off; recorded only on success (a hung-then-failed
-                # dispatch must not dominate the latency window)
+                toks = np.asarray(toks)  # [B,K] — the D2H sync; timed
+                lps = np.asarray(lps)
+                # latency reflects real completion, not async hand-off;
+                # recorded only on success (a hung-then-failed dispatch
+                # must not dominate the latency window)
                 self.invoke_stats.record(_time.monotonic() - t0)
             except Exception as e:  # noqa: BLE001 — a device failure must
                 # not strand clients blocked on their streams: fail every
@@ -723,7 +731,7 @@ class ContinuousBatchingEngine:
                     tok = int(toks[slot, j])
                     self.stats["tokens_generated"] += 1
                     self.stats["active_slot_steps"] += 1
-                    st._emit(tok)
+                    st._emit(tok, float(lps[slot, j]))
                     self._post_emit(slot, tok)
                     if self._slots[slot] is None:
                         break  # EOS/length mid-block: drop the tail

@@ -170,6 +170,60 @@ def test_idle_drainers_retire():
         unregister_engine("lm_idle")
 
 
+def test_server_stop_cancels_inflight_engine_streams():
+    """Stopping the server pipeline must cancel abandoned engine work —
+    the shared engine's slots free instead of decoding to dead streams."""
+    engine = ContinuousBatchingEngine(
+        CFG, PARAMS, max_streams=1, steps_per_dispatch=2,
+        temperature=0.0).start()
+    register_engine("lm_stop", engine)
+    server = parse_launch(
+        "tensor_query_serversrc name=ssrc port=0 ! "
+        "tensor_lm_serve engine=lm_stop max-new-tokens=60 name=serve ! "
+        "tensor_query_serversink")
+    server.start()
+    try:
+        # direct submit through the element intake (no client needed):
+        # queue a long request then stop mid-flight
+        serve = server.get("serve")
+        from nnstreamer_tpu.tensors.buffer import TensorBuffer
+
+        serve._chain_entry(serve.sinkpads[0], TensorBuffer(
+            [np.asarray([1, 2, 3], np.int32)], pts=0,
+            meta={"query_client_id": 0}))
+    finally:
+        server.stop()
+    import time
+
+    deadline = time.monotonic() + 30
+    while engine.active_streams and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert engine.active_streams == 0  # slot freed by cancellation
+    engine.stop()
+    unregister_engine("lm_stop")
+
+
+def test_completion_carries_logprobs_tensor(lm_server):
+    pipe = parse_launch(
+        f"appsrc name=src ! tensor_query_client dest-host=127.0.0.1 "
+        f"dest-port={lm_server} timeout=120 ! "
+        "tensor_sink name=out to-host=true")
+    outs = []
+    pipe.get("out").connect(lambda b: outs.append(b))
+    pipe.start()
+    try:
+        pipe.get("src").push([np.asarray([5, 11, 23], np.int32)])
+        pipe.get("src").end_of_stream()
+        msg = pipe.wait(timeout=240)
+        assert msg is not None and msg.kind == "eos", msg
+    finally:
+        pipe.stop()
+    toks = np.asarray(outs[0].tensors[0])
+    lps = np.asarray(outs[0].tensors[1])
+    assert lps.dtype == np.float32 and lps.shape == toks.shape
+    assert np.all(lps <= 0.0)
+
+
 def test_serve_element_records_request_latency():
     engine = ContinuousBatchingEngine(
         CFG, PARAMS, max_streams=2, steps_per_dispatch=4,
