@@ -459,6 +459,35 @@ def test_cancel_pending_stream_never_admits():
         eng.stop()
 
 
+def test_dispatch_failure_fails_streams_and_recovers():
+    """A device failure mid-dispatch must fail in-flight streams fast
+    (no hang), rebuild the donated-away cache, and keep serving new
+    requests — the engine's failure-detection contract."""
+    eng = ContinuousBatchingEngine(
+        CFG, PARAMS, max_streams=2, steps_per_dispatch=4,
+        temperature=0.0).start()
+    try:
+        real = eng._dispatch
+        state = {"raised": False}
+
+        def flaky(*args):
+            if not state["raised"]:
+                state["raised"] = True
+                raise RuntimeError("injected device failure")
+            return real(*args)
+
+        eng._dispatch = flaky
+        s = eng.submit([5, 11, 23], max_new_tokens=8)
+        out = s.result(timeout=240)
+        assert s.finish_reason == "error: injected device failure"
+        assert out == s.tokens  # whatever was emitted pre-failure
+        # engine recovered: fresh request completes correctly
+        got = eng.generate([4, 8, 15], max_new_tokens=5, timeout=240)
+        assert got == reference_greedy([4, 8, 15], 5)
+    finally:
+        eng.stop()
+
+
 def test_concurrent_submit_stress():
     """Hammer submit() from many threads against few slots while streams
     complete and slots recycle: every stream must finish with the right
