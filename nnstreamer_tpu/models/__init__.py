@@ -20,3 +20,4 @@ from nnstreamer_tpu.models.lstm import lstm_cell  # noqa: F401
 from nnstreamer_tpu.models.transformer import transformer_lm  # noqa: F401
 from nnstreamer_tpu.models.yolo import yolo_detector  # noqa: F401
 from nnstreamer_tpu.models.segmenter import segmenter  # noqa: F401
+from nnstreamer_tpu.models.beam import BeamSearcher  # noqa: F401

@@ -1,0 +1,94 @@
+"""Beam search (models/beam.py).
+
+The load-bearing check is teacher-forced re-scoring: every returned
+hypothesis's score must equal the sum of its tokens' log-probabilities
+under an independent full-forward pass — that catches parent-gather and
+cache-reorder bugs that shape checks cannot.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from nnstreamer_tpu.models.beam import BeamSearcher  # noqa: E402
+from nnstreamer_tpu.models.transformer import build_forward  # noqa: E402
+from tests.test_serving import (  # noqa: E402 — SAME model as the greedy
+    # reference, so width-1 comparison can't silently diverge
+    CFG,
+    PARAMS,
+    reference_greedy,
+)
+
+FWD = jax.jit(build_forward(CFG))  # hoisted: one compile for all rescores
+
+
+def rescore(prompt, seq):
+    """Teacher-forced sum of the emitted tokens' log-probs."""
+    fwd = FWD
+    toks = jnp.asarray(np.concatenate(
+        [np.asarray(prompt, np.int32), np.asarray(seq, np.int32)])[None])
+    logp = jax.nn.log_softmax(
+        fwd(PARAMS, toks)[0].astype(jnp.float32), axis=-1)
+    n = len(prompt)
+    return float(sum(logp[n + j - 1, seq[j]] for j in range(len(seq))))
+
+
+def test_width_one_is_greedy():
+    prompt = [5, 11, 23, 42]
+    bs = BeamSearcher(CFG, PARAMS, beam_width=1, max_new=10)
+    seqs, scores = bs.search(prompt)
+    assert seqs.shape == (1, 10)
+    assert seqs[0].tolist() == reference_greedy(prompt, 10)
+
+
+def test_scores_match_teacher_forced_rescoring():
+    prompt = [7, 3, 11, 30]
+    bs = BeamSearcher(CFG, PARAMS, beam_width=4, max_new=8)
+    seqs, scores = bs.search(prompt)
+    assert list(scores) == sorted(scores, reverse=True)
+    for seq, score in zip(seqs, scores):
+        assert score == pytest.approx(rescore(prompt, seq.tolist()),
+                                      abs=2e-3), seq
+    # the best beam must score at least as well as pure greedy
+    greedy = reference_greedy(prompt, 8)
+    assert scores[0] >= rescore(prompt, greedy) - 2e-3
+
+
+def test_beams_are_distinct_hypotheses():
+    bs = BeamSearcher(CFG, PARAMS, beam_width=4, max_new=6)
+    seqs, _ = bs.search([9, 21, 33])
+    assert len({tuple(s) for s in seqs.tolist()}) == len(seqs)
+
+
+def test_eos_freezes_beam():
+    prompt = [5, 11, 23, 42]
+    greedy = reference_greedy(prompt, 8)
+    eos = greedy[2]  # a token the search will actually emit
+    bs = BeamSearcher(CFG, PARAMS, beam_width=3, max_new=8, eos_id=eos)
+    seqs, scores = bs.search(prompt)
+    for seq in seqs.tolist():
+        if eos in seq:
+            first = seq.index(eos)
+            assert all(t == eos for t in seq[first:]), seq
+    # frozen score == rescore of the pre-EOS prefix plus the EOS itself
+    best = seqs[0].tolist()
+    if eos in best:
+        upto = best.index(eos) + 1
+        assert scores[0] == pytest.approx(
+            rescore(prompt, best[:upto]), abs=2e-3)
+
+
+def test_validation():
+    # capacity boundary: n = S - max_new + 1 is EXACTLY admissible
+    with pytest.raises(ValueError):
+        BeamSearcher(CFG, PARAMS, beam_width=0)
+    with pytest.raises(ValueError):
+        BeamSearcher(CFG, PARAMS, beam_width=CFG.vocab + 1)
+    bs = BeamSearcher(CFG, PARAMS, beam_width=2, max_new=10)
+    with pytest.raises(ValueError):
+        bs.search(list(range(1, CFG.max_seq)))  # no room for max_new
+    n_edge = CFG.max_seq - 10 + 1  # last decode write lands on slot S-1
+    seqs, _ = bs.search(list(range(1, n_edge + 1)))
+    assert seqs.shape == (2, 10)
