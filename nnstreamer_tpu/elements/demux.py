@@ -41,10 +41,13 @@ class TensorDemux(Element):
         while len(self.srcpads) < n:
             self.add_src_pad(f"src_{len(self.srcpads)}")
 
+    def request_src_pad(self):
+        return self.add_src_pad(f"src_{len(self.srcpads)}")
+
     def link(self, downstream):
         # src pads are request-style: allocate one per link if all are taken
         if all(p.peer is not None for p in self.srcpads):
-            self.add_src_pad(f"src_{len(self.srcpads)}")
+            self.request_src_pad()
         return super().link(downstream)
 
     def chain(self, pad, buf):

@@ -19,9 +19,12 @@ class Tee(Element):
         super().__init__(name, **props)
         self.add_sink_pad("sink")
 
+    def request_src_pad(self):
+        return self.add_src_pad(f"src_{len(self.srcpads)}")
+
     def link(self, downstream):
         # allocate a new src pad per link
-        src = self.add_src_pad(f"src_{len(self.srcpads)}")
+        src = self.request_src_pad()
         sink = next((p for p in downstream.sinkpads if p.peer is None), None)
         if sink is None:
             sink = downstream.request_sink_pad()

@@ -264,6 +264,11 @@ class Element:
         Default: error — override in request-pad elements."""
         raise NotImplementedError(f"{self.ELEMENT_NAME} has fixed pads")
 
+    def request_src_pad(self) -> Pad:
+        """For N-output elements (tee/split/demux): allocate a new src
+        pad. Default: error — override in request-pad elements."""
+        raise NotImplementedError(f"{self.ELEMENT_NAME} has fixed src pads")
+
     @property
     def sinkpad(self) -> Pad:
         return self.sinkpads[0]

@@ -20,6 +20,8 @@ grammar for the same language):
 - ``a ! b ! c``               — linking.
 - ``name=foo`` then ``foo.``  — named-element branch points (tee/demux):
   ``t. ! queue ! sink`` continues from element ``foo``'s next free src pad.
+- ``foo.src_1`` / ``foo.sink_0`` — named-PAD references select an exact
+  pad; request pads (src_N/sink_N) are created in order on demand.
 - caps filter strings (``other/tensors,num_tensors=1,...``) between ``!``
   become :class:`CapsFilter` elements.
 """
@@ -196,6 +198,12 @@ def parse_launch(description: str, pipeline: Optional[Pipeline] = None
         linked = False
         if tok.endswith(".") and len(tok) > 1 and "=" not in tok:
             chains[-1].append(("ref", tok[:-1]))
+        elif ("." in tok and "=" not in tok and not _is_caps_token(tok)
+                and not tok.startswith(".")):
+            # gst-launch named-pad reference: ``name.pad`` selects that
+            # exact pad (``s.src_1 ! ...`` / ``... ! m.sink_0``)
+            name, pad = tok.split(".", 1)
+            chains[-1].append(("refpad", name, pad))
         elif _is_caps_token(tok):
             current = CapsFilter()
             current.set_property("caps", parse_caps_string(tok))
@@ -205,14 +213,69 @@ def parse_launch(description: str, pipeline: Optional[Pipeline] = None
 
     # -- pass 2: resolve links ----------------------------------------------
     def resolve(node) -> Element:
-        kind, val = node
+        kind, val = node[0], node[1]
         if kind == "el":
             return val
         if val not in pipe.by_name:
             raise ValueError(f"unknown element reference {val!r}")
         return pipe.by_name[val]
 
+    implied_sinks: List = []
+
+    def named_pad(el: Element, pname: str, direction: str):
+        pads = el.srcpads if direction == "src" else el.sinkpads
+        for p in pads:
+            if p.name == pname:
+                return p
+        m = None
+        if pname.startswith(f"{direction}_"):
+            suffix = pname[len(direction) + 1:]
+            m = int(suffix) if suffix.isdigit() else None
+        if m is None:
+            raise ValueError(
+                f"element {el.name!r} has no {direction} pad {pname!r} "
+                f"(has: {[p.name for p in pads]})")
+        # request-pad convention (src_N/sink_N): pads are POSITIONAL in
+        # the elements that use them (split segment i → i-th pad, mux
+        # pad index → tensor slot), so create every index up to the one
+        # requested — a description may reference them in any order.
+        # Implied-but-unlinked SINK pads are validated after all links
+        # resolve (an input a sync policy would wait on forever must be
+        # a parse error, not a hang); unlinked src pads just drop.
+        while len(pads) <= m:
+            if direction == "sink":
+                implied_sinks.append(el.request_sink_pad())
+            else:
+                el.request_src_pad()
+        return pads[m]
+
     for chain in chains:
         for a, b in zip(chain, chain[1:]):
-            resolve(a).link(resolve(b))
+            ea, eb = resolve(a), resolve(b)
+            a_pad = a[2] if a[0] == "refpad" else None
+            b_pad = b[2] if b[0] == "refpad" else None
+            if a_pad is None and b_pad is None:
+                ea.link(eb)
+                continue
+            if a_pad is not None:
+                src = named_pad(ea, a_pad, "src")
+            else:
+                src = next((p for p in ea.srcpads if p.peer is None), None)
+                if src is None:
+                    # tee/split/demux grow src pads on demand
+                    src = ea.request_src_pad()
+            if b_pad is not None:
+                sink = named_pad(eb, b_pad, "sink")
+            else:
+                sink = next((p for p in eb.sinkpads if p.peer is None),
+                            None)
+                if sink is None:
+                    sink = eb.request_sink_pad()
+            src.link(sink)
+    for pad in implied_sinks:
+        if pad.peer is None:
+            raise ValueError(
+                f"sink pad {pad.element.name}.{pad.name} was implied by a "
+                f"higher-numbered reference but never linked — a sync "
+                f"policy would wait on it forever")
     return pipe

@@ -275,3 +275,67 @@ def test_golden_quant_roundtrip_exact_on_integers(tmp_path):
         "option=float32 ! tensor_quant_enc ! tensor_quant_dec ! "
         "filesink location={out}",
         golden)
+
+
+def test_golden_named_pad_references(tmp_path):
+    """gst-launch `name.pad` syntax: split's src_0/src_1 picked by NAME
+    (order-independent in the description), so segment routing follows
+    the pad INDEX, not mention order."""
+    frames = _src_frames(3, 8, 8)
+    golden = b"".join(f[..., 1:].tobytes() for f in frames)  # 2nd seg
+    _run_golden(
+        tmp_path,
+        "videotestsrc num-buffers=3 width=8 height=8 pattern=gradient ! "
+        "tensor_converter ! tensor_split name=s tensorseg=1,2 "
+        "dimension=0  s.src_1 ! filesink location={out}  "
+        "s.src_0 ! fakesink",  # referenced AFTER src_1 — still segment 0
+        golden)
+
+
+def test_golden_named_sink_pads_fix_mux_order(tmp_path):
+    """mux sink_N references pin which input lands in which tensor slot
+    regardless of description order."""
+    a = _src_frames(3, 8, 8, "gradient")
+    b = _src_frames(3, 8, 8, "black")
+    golden = b"".join(x.tobytes() + y.tobytes() for x, y in zip(a, b))
+    _run_golden(
+        tmp_path,
+        "tensor_mux name=m sync-mode=nosync ! filesink location={out} "
+        # black listed FIRST but pinned to slot 1; gradient to slot 0
+        "videotestsrc num-buffers=3 width=8 height=8 pattern=black ! "
+        "tensor_converter ! m.sink_1 "
+        "videotestsrc num-buffers=3 width=8 height=8 pattern=gradient ! "
+        "tensor_converter ! m.sink_0",
+        golden)
+
+
+def test_named_pad_reference_errors():
+    from nnstreamer_tpu import parse_launch
+
+    with pytest.raises(ValueError, match="no src pad"):
+        parse_launch(
+            "videotestsrc num-buffers=1 ! tensor_converter ! "
+            "tensor_sink name=k  k.bogus ! fakesink")
+    with pytest.raises(ValueError, match="no src pad"):
+        parse_launch(  # negative index is malformed, not pads[-1]
+            "videotestsrc num-buffers=1 ! tensor_converter ! "
+            "tensor_split name=s tensorseg=1,2 dimension=0 "
+            "s.src_-1 ! fakesink")
+    with pytest.raises(ValueError, match="never linked"):
+        parse_launch(  # sink_0 implied by sink_1 but nothing feeds it
+            "tensor_mux name=m sync-mode=nosync ! fakesink "
+            "videotestsrc num-buffers=1 ! tensor_converter ! m.sink_1")
+
+
+def test_named_sink_with_growing_src_side(tmp_path):
+    """tee branch ending in a NAMED mux pad: the src side must use the
+    element's request-pad growth, not fail on 'no free src pad'."""
+    frames = _src_frames(2, 8, 8)
+    golden = b"".join(f.tobytes() + f.tobytes() for f in frames)
+    _run_golden(
+        tmp_path,
+        "videotestsrc num-buffers=2 width=8 height=8 pattern=gradient ! "
+        "tensor_converter ! tee name=t  "
+        "t. ! m.sink_0  t. ! m.sink_1  "
+        "tensor_mux name=m sync-mode=nosync ! filesink location={out}",
+        golden)
