@@ -28,10 +28,12 @@ def main():
     engine = ContinuousBatchingEngine(
         cfg, init_params(cfg, seed=0), max_streams=4,
         steps_per_dispatch=8, temperature=0.7, top_k=40, seed=42,
+        prefix_cache=4,  # multi-turn/system-prompt KV reuse
     ).start()
 
     rng = np.random.default_rng(0)
-    prompts = [rng.integers(1, cfg.vocab, n).tolist() for n in
+    system = rng.integers(1, cfg.vocab, 16).tolist()  # shared preamble
+    prompts = [system + rng.integers(1, cfg.vocab, n).tolist() for n in
                (5, 12, 30, 9, 21, 7)]
     t0 = time.monotonic()
     streams = [engine.submit(p, max_new_tokens=48) for p in prompts]
@@ -45,7 +47,9 @@ def main():
     util = st["active_slot_steps"] / max(1, st["slot_steps"])
     print(f"total {st['tokens_generated']} tokens in {dt:.2f}s "
           f"({st['tokens_generated'] / dt:.1f} tok/s aggregate), "
-          f"{st['dispatches']} dispatches, slot utilization {util:.0%}")
+          f"{st['dispatches']} dispatches, slot utilization {util:.0%}, "
+          f"prefix hits {st['prefix_hits']} "
+          f"({st['prefix_tokens_reused']} prompt tokens reused)")
     engine.stop()
 
 

@@ -242,11 +242,16 @@ def parse_launch(description: str, pipeline: Optional[Pipeline] = None
         # Implied-but-unlinked SINK pads are validated after all links
         # resolve (an input a sync policy would wait on forever must be
         # a parse error, not a hang); unlinked src pads just drop.
-        while len(pads) <= m:
-            if direction == "sink":
-                implied_sinks.append(el.request_sink_pad())
-            else:
-                el.request_src_pad()
+        try:
+            while len(pads) <= m:
+                if direction == "sink":
+                    implied_sinks.append(el.request_sink_pad())
+                else:
+                    el.request_src_pad()
+        except NotImplementedError as e:
+            raise ValueError(
+                f"element {el.name!r} has no {direction} pad {pname!r} "
+                f"and cannot grow one ({e})") from e
         return pads[m]
 
     for chain in chains:
@@ -262,8 +267,12 @@ def parse_launch(description: str, pipeline: Optional[Pipeline] = None
             else:
                 src = next((p for p in ea.srcpads if p.peer is None), None)
                 if src is None:
-                    # tee/split/demux grow src pads on demand
-                    src = ea.request_src_pad()
+                    try:
+                        # tee/split/demux grow src pads on demand
+                        src = ea.request_src_pad()
+                    except NotImplementedError:
+                        raise ValueError(
+                            f"{ea.name}: no free src pad") from None
             if b_pad is not None:
                 sink = named_pad(eb, b_pad, "sink")
             else:

@@ -325,6 +325,11 @@ def test_named_pad_reference_errors():
         parse_launch(  # sink_0 implied by sink_1 but nothing feeds it
             "tensor_mux name=m sync-mode=nosync ! fakesink "
             "videotestsrc num-buffers=1 ! tensor_converter ! m.sink_1")
+    with pytest.raises(ValueError, match="cannot grow"):
+        parse_launch(  # fixed-pad element: ValueError, like every other
+            # parse failure, not a leaked NotImplementedError
+            "videotestsrc num-buffers=1 ! tensor_converter ! "
+            "tensor_sink name=k  k.src_3 ! fakesink")
 
 
 def test_named_sink_with_growing_src_side(tmp_path):
