@@ -497,21 +497,29 @@ def build_greedy_stream_step(cfg: TransformerConfig,
 
 
 def make_sampler(vocab: int, temperature: float = 1.0,
-                 top_k: int = 0,
+                 top_k: int = 0, min_p: float = 0.0,
                  with_logprobs: bool = False) -> Callable:
     """The ONE sampling function: ``sample(logits[n, vocab],
     keys[uint32 n, 2]) -> (tokens[int32 n], new_keys[n, 2])`` — rows draw
     independently with their own threefry key, so results never depend on
     which other rows share the batch. ``temperature<=0`` degrades to
     greedy (keys pass through untouched); ``top_k>0`` restricts sampling
-    to the k highest logits. Shared by the repo-loop sampled step and the
-    serving engine so their sampling math can never diverge.
+    to the k highest logits; ``min_p>0`` drops tokens whose probability
+    is below ``min_p`` × the top token's (the modern min-p truncation —
+    adaptive where top-k is fixed; both may combine). Shared by the
+    repo-loop sampled step and the serving engine so their sampling math
+    can never diverge.
 
     ``with_logprobs=True`` appends ``logprobs[float32 n]`` — the chosen
     token's log-probability under the UNMODIFIED distribution (fp32
     log_softmax of the raw logits; temperature/top-k shape the draw, the
     report stays the model's own confidence, the convention LM serving
     APIs use)."""
+    if not 0.0 <= min_p <= 1.0:
+        raise ValueError(
+            f"make_sampler: min_p must be in [0, 1], got {min_p} "
+            f"(it is a probability RATIO vs the top token, not a count "
+            f"or percentage)")
 
     def sample(logits, keys):
         if temperature <= 0.0:
@@ -523,6 +531,12 @@ def make_sampler(vocab: int, temperature: float = 1.0,
                 k = min(top_k, vocab)  # over-asking = "no restriction"
                 kth = jax.lax.top_k(scaled, k)[0][:, -1:]
                 scaled = jnp.where(scaled >= kth, scaled, -1e30)
+            if min_p > 0.0:
+                # p_i >= min_p * p_max  ⟺  s_i >= s_max + log(min_p)
+                # (on the temperature-scaled logits, after top-k)
+                smax = jnp.max(scaled, axis=-1, keepdims=True)
+                scaled = jnp.where(
+                    scaled >= smax + np.log(min_p), scaled, -1e30)
 
             def row(key_row, logit_row):
                 kk = jax.random.wrap_key_data(
@@ -545,7 +559,7 @@ def make_sampler(vocab: int, temperature: float = 1.0,
 def build_sample_stream_step(cfg: TransformerConfig,
                              max_seq: Optional[int] = None,
                              temperature: float = 1.0,
-                             top_k: int = 0,
+                             top_k: int = 0, min_p: float = 0.0,
                              kv_codec: Optional[str] = None) -> Callable:
     """Sampled decode step for the repo loop: ``step(params, token, cache,
     pos, key[uint32 2]) -> (next_token, cache, pos+1, next_key)`` — the
@@ -553,7 +567,7 @@ def build_sample_stream_step(cfg: TransformerConfig,
     deterministic given the seed. Sampling math is :func:`make_sampler`
     with one row."""
     decode = build_decode_step(cfg, max_seq, kv_codec)
-    sample = make_sampler(cfg.vocab, temperature, top_k)
+    sample = make_sampler(cfg.vocab, temperature, top_k, min_p)
 
     def step(params, token, cache, pos, key):
         logits, cache2 = decode(params, token.reshape(1).astype(jnp.int32),

@@ -131,7 +131,8 @@ class ContinuousBatchingEngine:
     max_streams: batch slots (B). Static — sizes the cache and programs.
     max_seq: cache length S (defaults to ``cfg.max_seq``).
     steps_per_dispatch: decode steps fused into one device dispatch (K).
-    temperature / top_k: sampling config (``temperature<=0`` → greedy).
+    temperature / top_k / min_p: sampling config (``temperature<=0`` →
+        greedy; see ``models.transformer.make_sampler``).
     eos_id: generation stops when the model emits this id (None → length
         -bounded only).
     seed: engine PRNG seed; per-stream keys fold in the stream id.
@@ -167,6 +168,7 @@ class ContinuousBatchingEngine:
                  max_seq: Optional[int] = None,
                  steps_per_dispatch: int = 8,
                  temperature: float = 0.0, top_k: int = 0,
+                 min_p: float = 0.0,
                  eos_id: Optional[int] = None, seed: int = 0,
                  min_bucket: int = 16, mesh=None,
                  prefill_chunk: Optional[int] = None,
@@ -189,6 +191,7 @@ class ContinuousBatchingEngine:
         self.K = int(steps_per_dispatch)
         self.temperature = float(temperature)
         self.top_k = int(top_k)
+        self.min_p = float(min_p)
         self.eos_id = eos_id
         self.seed = int(seed)
         self.min_bucket = int(min_bucket)
@@ -299,7 +302,7 @@ class ContinuousBatchingEngine:
         # step) — seeds the first token and every dispatch-loop draw with
         # identical math, per-row keys keeping streams batch-independent
         sample = make_sampler(cfg.vocab, self.temperature, self.top_k,
-                              with_logprobs=True)
+                              self.min_p, with_logprobs=True)
 
         def dispatch(params, token, cache, pos, keys):
             """K decode steps in one program: ([B],cache,[B],[B,2]) →

@@ -400,6 +400,35 @@ def test_engine_invoke_stats_populated(engine):
     assert engine.invoke_stats.latency_us > 0
 
 
+def test_min_p_sampling():
+    """min_p truncation: drawn tokens always satisfy p >= min_p * p_max;
+    min_p=1.0 with temperature degenerates to greedy."""
+    import jax
+
+    from nnstreamer_tpu.models.transformer import make_sampler
+
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(0, 2, (1, CFG.vocab)), jnp.float32)
+    probs = np.asarray(jax.nn.softmax(logits[0]))
+    sample = make_sampler(CFG.vocab, temperature=1.0, min_p=0.5)
+    keys = np.asarray([[1, 2]], np.uint32)
+    drawn = set()
+    for _ in range(64):
+        tok, keys = sample(logits, jnp.asarray(keys))
+        drawn.add(int(tok[0]))
+        keys = np.asarray(keys)
+    assert all(probs[t] >= 0.5 * probs.max() - 1e-9 for t in drawn), drawn
+    # engine-level: min_p=1.0 ≡ greedy even at temperature 1
+    eng = ContinuousBatchingEngine(
+        CFG, PARAMS, max_streams=1, steps_per_dispatch=4,
+        temperature=1.0, min_p=1.0).start()
+    try:
+        got = eng.generate([5, 11, 23], max_new_tokens=6, timeout=240)
+    finally:
+        eng.stop()
+    assert got == reference_greedy([5, 11, 23], 6)
+
+
 def test_logprobs_parallel_and_correct(engine):
     prompt = [5, 11, 23]
     s = engine.submit(prompt, max_new_tokens=6)
