@@ -17,6 +17,7 @@ from nnstreamer_tpu.models.transformer import (  # noqa: E402
     build_decode_step,
     build_prefill,
     init_params,
+    make_sampler,
 )
 from nnstreamer_tpu.serving import ContinuousBatchingEngine  # noqa: E402
 
@@ -400,13 +401,30 @@ def test_engine_invoke_stats_populated(engine):
     assert engine.invoke_stats.latency_us > 0
 
 
+def test_moe_model_serves_exactly():
+    """A mixture-of-experts config through the whole engine path
+    (prefill capture, batched decode, chunked prefill) must match the
+    isolated greedy decode — MoE routing rides _block_tail everywhere."""
+    moe_cfg = TransformerConfig(vocab=97, d_model=64, n_heads=4,
+                                n_layers=2, d_ff=64, max_seq=64,
+                                dtype=jnp.float32, num_experts=4)
+    moe_params = init_params(moe_cfg, seed=6)
+    prompt = [5, 11, 23, 42, 9, 1]
+    ref = reference_greedy(prompt, 8, cfg=moe_cfg, params=moe_params)
+    for kw in ({}, {"prefill_chunk": 4}):
+        eng = ContinuousBatchingEngine(
+            moe_cfg, moe_params, max_streams=2, steps_per_dispatch=4,
+            temperature=0.0, **kw).start()
+        try:
+            got = eng.generate(prompt, max_new_tokens=8, timeout=240)
+        finally:
+            eng.stop()
+        assert got == ref, kw
+
+
 def test_min_p_sampling():
     """min_p truncation: drawn tokens always satisfy p >= min_p * p_max;
     min_p=1.0 with temperature degenerates to greedy."""
-    import jax
-
-    from nnstreamer_tpu.models.transformer import make_sampler
-
     rng = np.random.default_rng(0)
     logits = jnp.asarray(rng.normal(0, 2, (1, CFG.vocab)), jnp.float32)
     probs = np.asarray(jax.nn.softmax(logits[0]))

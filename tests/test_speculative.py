@@ -31,16 +31,16 @@ T_PARAMS = init_params(TARGET, seed=1)
 D_PARAMS = init_params(DRAFT, seed=2)
 
 
-def target_greedy(prompt, n_tokens):
-    prefill = jax.jit(build_prefill(TARGET))
-    decode = jax.jit(build_decode_step(TARGET))
-    logits, cache = prefill(T_PARAMS,
+def target_greedy(prompt, n_tokens, cfg=TARGET, params=T_PARAMS):
+    prefill = jax.jit(build_prefill(cfg))
+    decode = jax.jit(build_decode_step(cfg))
+    logits, cache = prefill(params,
                             jnp.asarray(np.asarray(prompt, np.int32)[None]))
     out = [int(jnp.argmax(logits[0]))]
     tok = jnp.asarray([out[0]], jnp.int32)
     pos = jnp.asarray(len(prompt), jnp.int32)
     for _ in range(n_tokens - 1):
-        logits, cache = decode(T_PARAMS, tok, cache, pos)
+        logits, cache = decode(params, tok, cache, pos)
         out.append(int(jnp.argmax(logits[0])))
         tok = jnp.asarray([out[-1]], jnp.int32)
         pos = pos + 1
@@ -139,6 +139,20 @@ def test_multi_round_dispatch_counts():
     assert got == target_greedy(prompt, 16)
     assert dec.stats["dispatches"] <= dec.stats["rounds"]
     assert dec.stats["rounds"] <= dec.stats["dispatches"] * 4
+
+
+def test_moe_target_speculative_exact():
+    """MoE target + depth-pruned MoE draft: chunk verify must route
+    experts identically to sequential decode (exactness holds)."""
+    moe = TransformerConfig(vocab=128, d_model=64, n_heads=4, n_layers=2,
+                            d_ff=64, max_seq=96, dtype=jnp.float32,
+                            num_experts=4)
+    moe_params = init_params(moe, seed=9)
+    d_cfg, d_params = draft_from_target(moe, moe_params, 1)
+    dec = SpeculativeDecoder(moe, moe_params, d_cfg, d_params, gamma=3)
+    prompt = [7, 21, 9]
+    assert dec.generate(prompt, max_new_tokens=15) == target_greedy(
+        prompt, 15, cfg=moe, params=moe_params)
 
 
 def test_config_validation():
