@@ -214,12 +214,47 @@ def main(argv=None) -> int:
     ap.add_argument("--dot", metavar="FILE",
                     help="write the started pipeline graph (fused "
                          "regions included) as Graphviz dot to FILE")
+    ap.add_argument("--export", nargs=2, metavar=("MODEL", "OUT"),
+                    help="export a model (.py with get_model() / "
+                         ".msgpack) as a compiled StableHLO artifact "
+                         "and exit; see docs/model-artifacts.md")
+    ap.add_argument("--platforms", default=None,
+                    help="target platforms for --export (default tpu,cpu)")
+    ap.add_argument("--custom", default=None,
+                    help="custom options for --export (.msgpack factory)")
+    ap.add_argument("--input", default=None,
+                    help="input dims for --export (caps grammar, e.g. "
+                         "3:224:224:1); overrides the model's declared "
+                         "input info")
+    ap.add_argument("--inputtype", default=None,
+                    help="input types for --export (e.g. float32)")
     args = ap.parse_args(argv)
 
     if args.confchk:
         return confchk()
     if args.scaffold:
         return scaffold(*args.scaffold)
+    if not args.export and (args.custom or args.input or args.inputtype
+                            or args.platforms):
+        ap.error("--platforms/--custom/--input/--inputtype only apply "
+                 "with --export (in a pipeline description, set them as "
+                 "element properties instead)")
+    if args.export:
+        from nnstreamer_tpu.filters.artifact import export_model
+
+        model, out = args.export
+        try:
+            out_info = export_model(
+                model, out, custom=args.custom,
+                platforms=[p.strip() for p in
+                           (args.platforms or "tpu,cpu").split(",")
+                           if p.strip()],
+                input_dims=args.input, input_types=args.inputtype)
+        except Exception as e:  # noqa: BLE001 — CLI reports any failure
+            print(f"nns-launch: export failed: {e}", file=sys.stderr)
+            return 1
+        print(f"Exported {model} -> {out} (outputs: {out_info})")
+        return 0
     if not args.description:
         ap.error("pipeline description required (or --confchk)")
 

@@ -31,14 +31,18 @@ DEFAULT_CONF_PATHS = (
     "/etc/nnstreamer_tpu.ini",
 )
 
+#: Compiled-model artifact extensions (filters/artifact.py loads these);
+#: single source for both framework auto-detect and the jax backend's
+#: artifact dispatch, so the two can never skew.
+ARTIFACT_EXTS = (".jaxexp", ".stablehlo", ".mlir", ".mlirbc")
+
 #: Default model-extension → framework priority (reference nnstreamer.ini.in
 #: [filter] framework priorities). First loadable wins.
 DEFAULT_EXT_PRIORITY: Dict[str, List[str]] = {
     ".msgpack": ["jax"],
     ".jax": ["jax"],
     ".orbax": ["jax"],
-    ".stablehlo": ["jax"],
-    ".mlir": ["jax"],
+    **{ext: ["jax"] for ext in ARTIFACT_EXTS},
     ".pt": ["torch"],
     ".pth": ["torch"],
     ".pt2": ["torch"],

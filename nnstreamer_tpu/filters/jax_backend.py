@@ -26,7 +26,13 @@ Model forms accepted (``model`` property):
 - a name registered via :func:`register_jax_model` (apps, tests);
 - ``<file>.py`` exporting ``get_model()`` → ``fn`` or ``(fn, params)``;
 - ``<file>.msgpack`` flax-serialized params, with ``custom=module:<name>``
-  naming a model factory from ``nnstreamer_tpu.models``.
+  naming a model factory from ``nnstreamer_tpu.models``;
+- **compiled-model artifacts** (``.jaxexp``/``.stablehlo``/``.mlir``/
+  ``.mlirbc``): serialized ``jax.export.Exported`` or raw StableHLO
+  modules, weights baked in as constants — the opaque-file load the
+  reference's vendor subplugins provide
+  (tensor_filter_tensorflow_lite.cc:154-238); see ``filters/artifact.py``
+  and docs/model-artifacts.md.
 """
 
 from __future__ import annotations
@@ -45,6 +51,7 @@ from nnstreamer_tpu.filters.api import (
     shared_model_get,
     shared_model_insert,
 )
+from nnstreamer_tpu.config import ARTIFACT_EXTS
 from nnstreamer_tpu.registry import FILTER, subplugin
 from nnstreamer_tpu.tensors.types import TensorInfo, TensorsInfo, TensorType
 
@@ -130,6 +137,23 @@ def _load_msgpack_model(path: str, custom: Optional[str]) -> dict:
     return dict(fn=fn, params=params, in_info=in_info, out_info=out_info)
 
 
+def resolve_python_model(model: str, custom: Optional[str]) -> Optional[dict]:
+    """Resolve the Python-authored model forms (registered name, ``.py``
+    with ``get_model()``, ``.msgpack`` + factory) to an entry dict, or
+    None if ``model`` is none of them. Shared by the filter and the
+    artifact exporter so ``--export`` accepts exactly what the filter
+    loads."""
+    name = model.split(":", 1)[1] if model.startswith("registered:") else model
+    with _reg_lock:
+        if name in _registered:
+            return dict(_registered[name])
+    if model.endswith(".py") and os.path.isfile(model):
+        return _load_py_model(model)
+    if model.endswith(".msgpack") and os.path.isfile(model):
+        return _load_msgpack_model(model, custom)
+    return None
+
+
 @subplugin(FILTER, "jax")
 class JaxFilter(FilterFramework):
     NAME = "jax"
@@ -196,17 +220,28 @@ class JaxFilter(FilterFramework):
         self._jitted = None  # (re)built lazily per dtype/shape set
 
     def _load(self, model: str, props: FilterProperties) -> dict:
-        name = model.split(":", 1)[1] if model.startswith("registered:") else model
-        with _reg_lock:
-            if name in _registered:
-                return dict(_registered[name])
-        if model.endswith(".py") and os.path.isfile(model):
-            return _load_py_model(model)
-        if model.endswith(".msgpack") and os.path.isfile(model):
-            return _load_msgpack_model(model, props.custom)
+        entry = resolve_python_model(model, props.custom)
+        if entry is not None:
+            return entry
+        if model.endswith(ARTIFACT_EXTS) and os.path.isfile(model):
+            from nnstreamer_tpu.filters.artifact import artifact_entry
+
+            return artifact_entry(model, platform=self._device.platform)
+        if (model.endswith(".pb") and os.path.isfile(model)) or (
+                os.path.isdir(model)
+                and os.path.isfile(os.path.join(model, "saved_model.pb"))):
+            # the reference runs these via libtensorflow
+            # (tensor_filter_tensorflow.cc:785); the TPU-native route is a
+            # one-time offline export to StableHLO
+            raise ValueError(
+                f"jax: {model!r} is a TensorFlow GraphDef/SavedModel; "
+                "export it to a StableHLO artifact first (see "
+                "docs/model-artifacts.md, 'TensorFlow models') and load "
+                "the .stablehlo file instead"
+            )
         raise ValueError(
-            f"jax: cannot load model {model!r} (not registered, not a .py "
-            f"or .msgpack file)"
+            f"jax: cannot load model {model!r} (not registered, not a .py/"
+            f".msgpack file, not a {'/'.join(ARTIFACT_EXTS)} artifact)"
         )
 
     def close(self) -> None:
