@@ -19,4 +19,6 @@ class ProtobufConverter:
     def convert(self, buf: TensorBuffer, in_caps) -> TensorBuffer:
         blob = np.ascontiguousarray(buf.to_host()[0]).tobytes()
         out = decode_protobuf(blob)
-        return out.replace(pts=buf.pts, meta=dict(buf.meta))
+        # keep the decoded wire meta (framerate/format/tensor_names) and
+        # overlay the incoming buffer's own meta on top
+        return out.replace(pts=buf.pts, meta={**out.meta, **buf.meta})

@@ -1,27 +1,58 @@
 """protobuf decoder — tensors → serialized protobuf messages.
 
-Reference: ``ext/nnstreamer/tensor_decoder/tensordec-protobuf.c`` (117 LoC)
-with the ``Tensors`` message from ``nnstreamer.proto``:43-49. We build the
-equivalent message dynamically with ``google.protobuf`` (descriptor_pb2) so
-no generated code is shipped; the schema mirrors the reference's:
+Reference: ``ext/nnstreamer/extra/nnstreamer_protobuf.cc`` with the
+``Tensors`` message from ``ext/nnstreamer/include/nnstreamer.proto:26-41``.
+The message classes are built dynamically with ``google.protobuf``
+(descriptor_pb2) so no generated code is shipped, but the schema is
+**byte-for-byte wire compatible** with the reference's::
 
-    message Tensor { string name=1; int32 type=2; repeated uint32
-                     dimension=3; bytes data=4; }
-    message Tensors { uint32 num_tensor=1; repeated Tensor tensor=2; }
+    message Tensor  { string name=1; Tensor_type type=2;
+                      repeated uint32 dimension=3; bytes data=4; }
+    message Tensors { uint32 num_tensor=1; frame_rate fr=2
+                      {int32 rate_n=1; int32 rate_d=2};
+                      repeated Tensor tensor=3; Tensor_format format=4; }
+
+(enums ride as varints, so declaring them int32 here is wire-identical;
+``tests/test_codecs.py`` proves both directions against pb2 code protoc
+generates from the reference's own .proto.)
+
+Wire-format constraints inherited from the reference:
+
+- **rank-4 normalizing**: the reference writes exactly
+  ``NNS_TENSOR_RANK_LIMIT == 4`` dimension entries, 1-padded
+  (nnstreamer_protobuf.cc:95-97, tensor_common.c:1294-1295), and its
+  parser reads exactly 4 back — so decode yields rank-4 shapes (leading
+  1-axes), and rank>4 tensors are refused (a reference peer would
+  silently mis-size them; use flexbuf for rank>4).
+- the reference ``Tensor_type`` enum has no fp16/bf16 — those are
+  refused with a pointed error (typecast first).
 """
 
 from __future__ import annotations
 
 import threading
+from typing import Optional
 
 import numpy as np
 
 from nnstreamer_tpu.pipeline.caps import Caps
 from nnstreamer_tpu.registry import DECODER, subplugin
 from nnstreamer_tpu.tensors.buffer import TensorBuffer
-from nnstreamer_tpu.tensors.types import TensorInfo, TensorType
+from nnstreamer_tpu.tensors.types import (
+    Fraction,
+    TensorFormat,
+    TensorInfo,
+    TensorType,
+)
 
+#: reference Tensor_type enum order (nnstreamer.proto:8-19): NNS_INT32=0 …
+#: NNS_UINT64=9. The first 10 TensorType members match it exactly;
+#: FLOAT16/BFLOAT16 beyond have no reference value.
 _TYPE_ORDER = list(TensorType)
+_REF_TYPE_COUNT = 10
+_FORMAT_ORDER = list(TensorFormat)  # STATIC=0, FLEXIBLE=1, SPARSE=2 (:36-40)
+_REF_RANK = 4  # NNS_TENSOR_RANK_LIMIT in the reference proto era
+
 _lock = threading.Lock()
 _msgs = None
 
@@ -37,7 +68,8 @@ def _get_messages():
 
         fdp = descriptor_pb2.FileDescriptorProto()
         fdp.name = "nnstreamer_tpu_tensors.proto"
-        fdp.package = "nnstreamer_tpu"
+        fdp.package = "nnstreamer.protobuf"
+        fdp.syntax = "proto3"
         t = fdp.message_type.add()
         t.name = "Tensor"
         f = t.field.add(); f.name = "name"; f.number = 1; \
@@ -50,11 +82,22 @@ def _get_messages():
             f.type = f.TYPE_BYTES; f.label = f.LABEL_OPTIONAL
         ts = fdp.message_type.add()
         ts.name = "Tensors"
+        fr = ts.nested_type.add()
+        fr.name = "frame_rate"
+        f = fr.field.add(); f.name = "rate_n"; f.number = 1; \
+            f.type = f.TYPE_INT32; f.label = f.LABEL_OPTIONAL
+        f = fr.field.add(); f.name = "rate_d"; f.number = 2; \
+            f.type = f.TYPE_INT32; f.label = f.LABEL_OPTIONAL
         f = ts.field.add(); f.name = "num_tensor"; f.number = 1; \
             f.type = f.TYPE_UINT32; f.label = f.LABEL_OPTIONAL
-        f = ts.field.add(); f.name = "tensor"; f.number = 2; \
+        f = ts.field.add(); f.name = "fr"; f.number = 2; \
+            f.type = f.TYPE_MESSAGE; f.label = f.LABEL_OPTIONAL; \
+            f.type_name = ".nnstreamer.protobuf.Tensors.frame_rate"
+        f = ts.field.add(); f.name = "tensor"; f.number = 3; \
             f.type = f.TYPE_MESSAGE; f.label = f.LABEL_REPEATED; \
-            f.type_name = ".nnstreamer_tpu.Tensor"
+            f.type_name = ".nnstreamer.protobuf.Tensor"
+        f = ts.field.add(); f.name = "format"; f.number = 4; \
+            f.type = f.TYPE_INT32; f.label = f.LABEL_OPTIONAL
         pool = descriptor_pool.DescriptorPool()
         fd = pool.Add(fdp)
         tensor_cls = message_factory.GetMessageClass(
@@ -65,31 +108,76 @@ def _get_messages():
         return _msgs
 
 
-def encode_protobuf(buf: TensorBuffer) -> bytes:
+def encode_protobuf(buf: TensorBuffer, rate: Optional[Fraction] = None,
+                    fmt: TensorFormat = TensorFormat.STATIC) -> bytes:
+    """Serialize a frame the way nnstreamer_protobuf.cc:44-130 does:
+    ``fr`` always present (rate 0/1 when unknown), exactly 4 dimension
+    entries per tensor, 1-padded."""
     Tensor, Tensors = _get_messages()
     msg = Tensors()
     host = buf.to_host()
     msg.num_tensor = host.num_tensors
-    for t in host.tensors:
+    if rate is not None:  # accepts our Fraction or fractions.Fraction
+        msg.fr.rate_n = int(getattr(rate, "num",
+                                    getattr(rate, "numerator", 0)))
+        msg.fr.rate_d = int(getattr(rate, "den",
+                                    getattr(rate, "denominator", 1))) or 1
+    else:
+        msg.fr.rate_n = 0
+        msg.fr.rate_d = 1
+    msg.format = _FORMAT_ORDER.index(TensorFormat.from_any(fmt))
+    names = buf.meta.get("tensor_names") or []
+    for i, t in enumerate(host.tensors):
         info = TensorInfo.from_array(t)
+        type_idx = _TYPE_ORDER.index(info.type)
+        if type_idx >= _REF_TYPE_COUNT:
+            raise ValueError(
+                f"protobuf codec: {info.type.value} has no value in the "
+                "reference Tensor_type enum (nnstreamer.proto:8-19); "
+                "typecast to float32 first")
+        if len(info.dim) > _REF_RANK:
+            raise ValueError(
+                f"protobuf codec: rank {len(info.dim)} exceeds the "
+                f"reference wire rank {_REF_RANK}; use flexbuf for "
+                "higher-rank tensors")
         tm = msg.tensor.add()
-        tm.type = _TYPE_ORDER.index(info.type)
-        tm.dimension.extend(info.dim)
+        tm.name = str(names[i]) if i < len(names) and names[i] else ""
+        tm.type = type_idx
+        tm.dimension.extend(
+            tuple(info.dim) + (1,) * (_REF_RANK - len(info.dim)))
         tm.data = np.ascontiguousarray(t).tobytes()
     return msg.SerializeToString()
 
 
 def decode_protobuf(blob: bytes) -> TensorBuffer:
+    """Parse a reference-format ``Tensors`` payload. Shapes keep the
+    rank-4 wire dims (like the reference's parser,
+    nnstreamer_protobuf.cc:160-176); framerate / format / tensor names
+    land in ``buf.meta``."""
     Tensor, Tensors = _get_messages()
     msg = Tensors()
     msg.ParseFromString(bytes(blob))
     tensors = []
+    names = []
     for tm in msg.tensor:
+        if not 0 <= tm.type < _REF_TYPE_COUNT:
+            raise ValueError(
+                f"protobuf codec: unknown Tensor_type value {tm.type}")
         ttype = _TYPE_ORDER[tm.type]
         shape = tuple(reversed(list(tm.dimension)))
         tensors.append(np.frombuffer(tm.data,
                                      ttype.np_dtype).reshape(shape))
-    return TensorBuffer(tensors)
+        names.append(tm.name or None)
+    meta = {}
+    if msg.fr.rate_n:
+        meta["framerate"] = Fraction(msg.fr.rate_n, msg.fr.rate_d or 1)
+    if not 0 <= msg.format < len(_FORMAT_ORDER):
+        raise ValueError(
+            f"protobuf codec: unknown Tensor_format value {msg.format}")
+    meta["format"] = _FORMAT_ORDER[msg.format].value
+    if any(names):
+        meta["tensor_names"] = names
+    return TensorBuffer(tensors, meta=meta)
 
 
 @subplugin(DECODER, "protobuf")
@@ -98,5 +186,7 @@ class ProtobufDecoder:
         return Caps("application/octet-stream", {"encoding": "protobuf"})
 
     def decode(self, buf: TensorBuffer, config, options) -> TensorBuffer:
-        blob = encode_protobuf(buf)
+        rate = config.rate if config is not None and config.rate.num else None
+        fmt = config.format if config is not None else TensorFormat.STATIC
+        blob = encode_protobuf(buf, rate=rate, fmt=fmt)
         return buf.with_tensors([np.frombuffer(blob, np.uint8)])
