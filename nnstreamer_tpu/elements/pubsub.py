@@ -2,15 +2,36 @@
 
 Reference: ``gst/mqtt/mqttsink.c`` / ``mqttsrc.c``: publish any stream's
 buffers to a broker topic / subscribe and push them into a pipeline, with
-sender-epoch timestamp rebasing (mqttcommon.h header + ntputil). Element
+cross-device timestamp rebasing (mqttcommon.h header + ntputil). Element
 names ``mqttsink``/``mqttsrc`` are registered as aliases so reference
 pipeline descriptions parse unchanged.
+
+Two transports, selected by the ``broker`` property:
+
+- ``shim`` (default) — the in-process framed-TCP broker
+  (``query/pubsub.py``); payloads are the compact native envelope.
+- ``mqtt://[host[:port]]`` — real MQTT 3.1.1 (``query/mqtt.py``);
+  payloads carry the reference's 1024-byte ``GstMQTTMessageHdr``
+  (caps string, num_mems/size_mems, base/sent epochs, pts/dts/duration,
+  mqttcommon.h:49-63) + raw tensor memories, so streams interop with
+  reference mqttsink/mqttsrc peers over any conformant broker.
+
+Timestamp rebasing follows the reference's base-epoch math
+(mqttsrc.c:1381-1404): each side stamps ``base_time_epoch`` = wall epoch
+at stream start, and the receiver shifts pts by the *difference of base
+epochs* — message latency never enters the offset. With ``ntp-server``
+set, both sides' epochs are SNTP-corrected (``query/ntp.py``,
+reference ntputil.c), so the rebasing holds across hosts whose clocks
+disagree.
 """
 
 from __future__ import annotations
 
 import queue as _queue
-from typing import Optional
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
 
 from nnstreamer_tpu.pipeline.element import Element, FlowReturn
 from nnstreamer_tpu.pipeline.pipeline import SourceElement
@@ -21,11 +42,80 @@ from nnstreamer_tpu.query.pubsub import (
     parse_buffer_envelope,
 )
 from nnstreamer_tpu.registry import ELEMENT, register_subplugin, subplugin
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
 from nnstreamer_tpu.tensors.types import TensorFormat, TensorsConfig
 
 
+def _parse_broker(spec: Optional[str], host: str,
+                  port: int) -> Tuple[str, str, int]:
+    """``broker`` property → (kind, host, port). ``mqtt://h:p`` overrides
+    the host/port properties; bare ``mqtt`` uses them."""
+    s = (spec or "shim").strip()
+    if s in ("", "shim", "native"):
+        return "shim", host, port
+    if s == "mqtt":
+        return "mqtt", host, port
+    if s.startswith("mqtt://"):
+        rest = s[len("mqtt://"):]
+        if rest:
+            h, _, p = rest.partition(":")
+            return "mqtt", h or host, int(p) if p else port
+        return "mqtt", host, port
+    raise ValueError(f"pubsub: unknown broker {spec!r} (shim|mqtt[://h:p])")
+
+
+def _ntp_servers(spec: Optional[str]):
+    if not spec:
+        return None
+    out = []
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        h, _, p = part.partition(":")
+        out.append((h, int(p) if p else 123))
+    return out or None
+
+
+def _epoch(ntp_servers) -> int:
+    if ntp_servers is not None:
+        from nnstreamer_tpu.query.ntp import corrected_epoch_ns
+
+        return corrected_epoch_ns(ntp_servers)
+    return time.time_ns()
+
+
+def _caps_to_string(caps) -> str:
+    if caps is None:
+        return ""
+    parts = [caps.name]
+    parts += [f"{k}={v}" for k, v in caps.fields.items()]
+    return ",".join(parts)
+
+
+class _PubSubBase:
+    """Shared transport plumbing for both elements."""
+
+    def _connect(self):
+        kind, host, port = _parse_broker(
+            self.get_property("broker"),
+            self.get_property("host"), int(self.get_property("port")))
+        self._transport = kind
+        # parsed once per start — the hot path must not re-split property
+        # strings per buffer
+        self._ntp_list = _ntp_servers(self.get_property("ntp_server"))
+        if kind == "mqtt":
+            from nnstreamer_tpu.query.mqtt import MqttClient
+
+            return MqttClient(host, port)
+        return Client(host, port)
+
+    def _epoch_now(self) -> int:
+        return _epoch(self._ntp_list)
+
+
 @subplugin(ELEMENT, "tensor_pubsub_sink")
-class TensorPubSubSink(Element):
+class TensorPubSubSink(Element, _PubSubBase):
     ELEMENT_NAME = "tensor_pubsub_sink"
     PROPERTIES = {
         **Element.PROPERTIES,
@@ -33,17 +123,22 @@ class TensorPubSubSink(Element):
         "port": 1883,
         "pub_topic": "nns/stream",
         "retain": False,
+        "broker": "shim",
+        "ntp_server": None,   # "host[:port][,host2...]" → SNTP-corrected
     }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self.add_sink_pad("sink")
-        self._client: Optional[Client] = None
+        self._client = None
+        self._base_epoch: Optional[int] = None
 
     def start(self):
         super().start()
-        self._client = Client(self.get_property("host"),
-                              int(self.get_property("port")))
+        self._client = self._connect()
+        # stream base epoch: wall clock at start (NTP-corrected when
+        # configured) — the mqttsink base_time_epoch role
+        self._base_epoch = self._epoch_now()
 
     def stop(self):
         if self._client:
@@ -52,14 +147,31 @@ class TensorPubSubSink(Element):
         super().stop()
 
     def chain(self, pad, buf):
-        payload = make_buffer_envelope(P.pack_buffer(buf), buf.pts)
+        if self._transport == "mqtt":
+            from nnstreamer_tpu.query.mqtt import pack_gst_mqtt_message
+
+            host = buf.to_host()
+            caps = pad.caps
+            if caps is None:
+                caps = TensorsConfig.from_arrays(host.tensors).to_caps()
+            payload = pack_gst_mqtt_message(
+                [np.ascontiguousarray(t).tobytes() for t in host.tensors],
+                _caps_to_string(caps),
+                base_time_epoch=self._base_epoch,
+                sent_time_epoch=self._epoch_now(),
+                pts=buf.pts, dts=buf.dts, duration=buf.duration)
+        else:
+            payload = make_buffer_envelope(
+                P.pack_buffer(buf), buf.pts,
+                base_epoch=self._base_epoch,
+                sent_epoch=self._epoch_now())
         self._client.publish(self.get_property("pub_topic"), payload,
                              retain=bool(self.get_property("retain")))
         return FlowReturn.OK
 
 
 @subplugin(ELEMENT, "tensor_pubsub_src")
-class TensorPubSubSrc(SourceElement):
+class TensorPubSubSrc(SourceElement, _PubSubBase):
     ELEMENT_NAME = "tensor_pubsub_src"
     PROPERTIES = {
         **SourceElement.PROPERTIES,
@@ -68,19 +180,21 @@ class TensorPubSubSrc(SourceElement):
         "sub_topic": "nns/stream",
         "num_buffers": -1,
         "rebase_timestamps": True,
+        "broker": "shim",
+        "ntp_server": None,
     }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
-        self._client: Optional[Client] = None
+        self._client = None
         self._q: _queue.Queue = _queue.Queue(maxsize=256)
         self.i = 0
-        self._epoch_offset: Optional[int] = None
+        self._base_epoch: Optional[int] = None
 
     def start(self):
         super().start()
-        self._client = Client(self.get_property("host"),
-                              int(self.get_property("port")))
+        self._client = self._connect()
+        self._base_epoch = self._epoch_now()
         self._client.subscribe(self.get_property("sub_topic"), self._on_msg)
 
     def stop(self):
@@ -100,6 +214,35 @@ class TensorPubSubSrc(SourceElement):
             TensorsConfig(format=TensorFormat.FLEXIBLE).to_caps()
         )
 
+    def _decode(self, body: bytes) -> Tuple[TensorBuffer, int,
+                                            Optional[int]]:
+        """wire payload → (buffer, sender base epoch, pts)."""
+        if self._transport == "mqtt":
+            from nnstreamer_tpu.pipeline.parse import parse_caps_string
+            from nnstreamer_tpu.query.mqtt import parse_gst_mqtt_message
+
+            msg = parse_gst_mqtt_message(body)
+            tensors: List[np.ndarray] = []
+            try:
+                config = TensorsConfig.from_caps(
+                    parse_caps_string(msg["caps_str"]))
+                infos = list(config.info)
+            except (ValueError, KeyError, IndexError):
+                infos = []
+            for i, mem in enumerate(msg["mems"]):
+                if i < len(infos) and infos[i].size == len(mem):
+                    tensors.append(np.frombuffer(
+                        mem, infos[i].type.np_dtype
+                    ).reshape(infos[i].shape))
+                else:  # unknown caps: deliver raw bytes, lossless
+                    tensors.append(np.frombuffer(mem, np.uint8))
+            buf = TensorBuffer(tensors, dts=msg["dts"],
+                               duration=msg["duration"],
+                               meta={"caps_str": msg["caps_str"]})
+            return buf, msg["base_time_epoch"], msg["pts"]
+        base_epoch, _sent, pts, payload = parse_buffer_envelope(body)
+        return P.unpack_buffer(payload), base_epoch, pts
+
     def create(self):
         n = int(self.get_property("num_buffers"))
         if 0 <= n <= self.i:
@@ -115,17 +258,14 @@ class TensorPubSubSrc(SourceElement):
                 body = self._q.get(timeout=0.1)
             except _queue.Empty:
                 continue
-            sent_epoch, pts, payload = parse_buffer_envelope(body)
-            buf = P.unpack_buffer(payload)
+            buf, sender_base, pts = self._decode(body)
             if self.get_property("rebase_timestamps") and pts is not None:
-                # rebase sender pts into this host's clock using the
-                # sender-epoch delta (the reference's NTP-adjusted
-                # base-time, synchronization-in-mqtt-elements.md)
-                from nnstreamer_tpu.query.pubsub import epoch_ns
-
-                if self._epoch_offset is None:
-                    self._epoch_offset = epoch_ns() - sent_epoch
-                buf = buf.replace(pts=pts + self._epoch_offset)
+                # reference _put_timestamp_on_gst_buf: shift pts AND dts by
+                # the difference of base epochs — no message latency involved
+                diff = sender_base - self._base_epoch
+                buf = buf.replace(
+                    pts=pts + diff,
+                    dts=None if buf.dts is None else buf.dts + diff)
             else:
                 buf = buf.replace(pts=pts)
             self.i += 1

@@ -1,0 +1,522 @@
+"""MQTT 3.1.1 — real protocol framing for the pubsub elements.
+
+Reference: ``gst/mqtt/mqttsink.c`` / ``mqttsrc.c`` speak MQTT through
+paho; their payloads prepend the fixed 1024-byte ``GstMQTTMessageHdr``
+(``gst/mqtt/mqttcommon.h:49-63``) so any subscriber can reconstruct the
+buffer. This module provides the same capability without paho:
+
+- **packet codec** — CONNECT/CONNACK/SUBSCRIBE/SUBACK/PUBLISH(QoS0,
+  retain)/PING*/DISCONNECT encode+decode per the MQTT 3.1.1 spec
+  (unit-tested always; any conformant broker understands them);
+- :class:`MqttClient` — a minimal client (same surface as the in-process
+  shim's ``Client``) usable against any broker reachable at
+  ``mqtt://host:port``;
+- :class:`MqttBroker` — an in-process broker speaking real MQTT, for
+  loopback tests and brokerless deployments;
+- ``pack_gst_mqtt_message`` / ``parse_gst_mqtt_message`` — the reference
+  header layout, byte-exact (num_mems, size_mems[16], base/sent epochs,
+  duration/dts/pts, 512-byte caps string, 1024 bytes total), so streams
+  interop with reference mqttsink/mqttsrc peers.
+
+QoS0-only by design: tensor streams are latest-wins; the reference's
+default QoS for streams is 0 as well, and retransmit logic belongs to
+the query protocol (which has in-flight windows), not here.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import uuid
+from typing import Callable, Dict, List, Optional, Tuple
+
+from nnstreamer_tpu.log import get_logger
+
+log = get_logger("mqtt")
+
+# MQTT 3.1.1 control packet types (spec table 2.1)
+CONNECT = 1
+CONNACK = 2
+PUBLISH = 3
+SUBSCRIBE = 8
+SUBACK = 9
+UNSUBSCRIBE = 10
+UNSUBACK = 11
+PINGREQ = 12
+PINGRESP = 13
+DISCONNECT = 14
+
+PROTOCOL_NAME = b"\x00\x04MQTT"
+PROTOCOL_LEVEL = 4  # 3.1.1
+
+
+# ---------------------------------------------------------------------------
+# Packet codec
+# ---------------------------------------------------------------------------
+
+def encode_varlen(n: int) -> bytes:
+    """Remaining-length varint (spec 2.2.3), 1-4 bytes."""
+    if not 0 <= n <= 268_435_455:
+        raise ValueError(f"mqtt: remaining length {n} out of range")
+    out = bytearray()
+    while True:
+        n, digit = divmod(n, 128)
+        out.append(digit | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def decode_varlen(data: bytes, offset: int = 0) -> Tuple[int, int]:
+    """→ (value, bytes consumed); raises on malformed/truncated input."""
+    value = 0
+    for i in range(4):
+        if offset + i >= len(data):
+            raise ValueError("mqtt: truncated remaining length")
+        byte = data[offset + i]
+        value |= (byte & 0x7F) << (7 * i)
+        if not byte & 0x80:
+            return value, i + 1
+    raise ValueError("mqtt: malformed remaining length")
+
+
+def _utf8(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack(">H", len(b)) + b
+
+
+def _packet(ptype: int, flags: int, body: bytes) -> bytes:
+    return bytes([(ptype << 4) | flags]) + encode_varlen(len(body)) + body
+
+
+def connect_packet(client_id: str, keepalive: int = 60,
+                   clean_session: bool = True) -> bytes:
+    flags = 0x02 if clean_session else 0x00
+    body = (PROTOCOL_NAME + bytes([PROTOCOL_LEVEL, flags]) +
+            struct.pack(">H", keepalive) + _utf8(client_id))
+    return _packet(CONNECT, 0, body)
+
+
+def connack_packet(return_code: int = 0,
+                   session_present: bool = False) -> bytes:
+    return _packet(CONNACK, 0,
+                   bytes([1 if session_present else 0, return_code]))
+
+
+def publish_packet(topic: str, payload: bytes, retain: bool = False) -> bytes:
+    """QoS0 PUBLISH (no packet id in QoS0, spec 3.3.2.2)."""
+    return _packet(PUBLISH, 0x01 if retain else 0x00,
+                   _utf8(topic) + payload)
+
+
+def subscribe_packet(packet_id: int, topic_filter: str,
+                     qos: int = 0) -> bytes:
+    body = struct.pack(">H", packet_id) + _utf8(topic_filter) + bytes([qos])
+    return _packet(SUBSCRIBE, 0x02, body)  # reserved flags 0010 (3.8.1)
+
+
+def suback_packet(packet_id: int, return_codes: List[int]) -> bytes:
+    return _packet(SUBACK, 0,
+                   struct.pack(">H", packet_id) + bytes(return_codes))
+
+
+def unsubscribe_packet(packet_id: int, topic_filter: str) -> bytes:
+    return _packet(UNSUBSCRIBE, 0x02,
+                   struct.pack(">H", packet_id) + _utf8(topic_filter))
+
+
+def unsuback_packet(packet_id: int) -> bytes:
+    return _packet(UNSUBACK, 0, struct.pack(">H", packet_id))
+
+
+def pingreq_packet() -> bytes:
+    return _packet(PINGREQ, 0, b"")
+
+
+def pingresp_packet() -> bytes:
+    return _packet(PINGRESP, 0, b"")
+
+
+def disconnect_packet() -> bytes:
+    return _packet(DISCONNECT, 0, b"")
+
+
+def read_packet(sock: socket.socket) -> Optional[Tuple[int, int, bytes]]:
+    """Blocking read of one packet → (type, flags, body) or None on EOF."""
+    first = _read_exact(sock, 1)
+    if first is None:
+        return None
+    ptype, flags = first[0] >> 4, first[0] & 0x0F
+    length = 0
+    for i in range(4):
+        b = _read_exact(sock, 1)
+        if b is None:
+            return None
+        length |= (b[0] & 0x7F) << (7 * i)
+        if not b[0] & 0x80:
+            break
+    else:
+        raise ValueError("mqtt: malformed remaining length")
+    body = _read_exact(sock, length) if length else b""
+    if body is None:
+        return None
+    return ptype, flags, body
+
+
+def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except OSError:
+            return None
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def parse_publish(flags: int, body: bytes) -> Tuple[str, bytes, bool]:
+    """→ (topic, payload, retain). QoS>0 carries a packet id we skip."""
+    (tlen,) = struct.unpack_from(">H", body)
+    topic = body[2:2 + tlen].decode()
+    off = 2 + tlen
+    qos = (flags >> 1) & 0x03
+    if qos:
+        off += 2
+    return topic, body[off:], bool(flags & 0x01)
+
+
+def topic_matches(pattern: str, topic: str) -> bool:
+    """MQTT topic-filter matching: ``+`` one level, ``#`` rest (4.7.1)."""
+    p_parts = pattern.split("/")
+    t_parts = topic.split("/")
+    for i, p in enumerate(p_parts):
+        if p == "#":
+            return True
+        if i >= len(t_parts):
+            return False
+        if p != "+" and p != t_parts[i]:
+            return False
+    return len(p_parts) == len(t_parts)
+
+
+# ---------------------------------------------------------------------------
+# GstMQTTMessageHdr — reference wire layout (mqttcommon.h:49-63)
+# ---------------------------------------------------------------------------
+
+GST_MQTT_MAX_NUM_MEMS = 16
+GST_MQTT_MAX_LEN_GST_CAPS_STR = 512
+GST_MQTT_LEN_MSG_HDR = 1024
+GST_CLOCK_TIME_NONE = 0xFFFFFFFFFFFFFFFF
+
+#: guint num_mems; (4-pad to align gsize); gsize size_mems[16];
+#: gint64 base/sent epochs; GstClockTime duration, dts, pts;
+#: gchar gst_caps_str[512] — then reserved up to 1024.
+_HDR = struct.Struct("<I4x16QqqQQQ512s")
+
+
+def pack_gst_mqtt_message(mems: List[bytes], caps_str: str,
+                          base_time_epoch: int, sent_time_epoch: int,
+                          pts: Optional[int] = None,
+                          dts: Optional[int] = None,
+                          duration: Optional[int] = None) -> bytes:
+    """Reference-format message: 1024-byte header + raw memory blocks
+    (mqttsink.c's publish payload)."""
+    if len(mems) > GST_MQTT_MAX_NUM_MEMS:
+        raise ValueError(
+            f"mqtt: {len(mems)} memories exceed "
+            f"GST_MQTT_MAX_NUM_MEMS={GST_MQTT_MAX_NUM_MEMS}")
+    caps_b = caps_str.encode()
+    if len(caps_b) >= GST_MQTT_MAX_LEN_GST_CAPS_STR:
+        raise ValueError(
+            f"mqtt: caps string {len(caps_b)}B exceeds "
+            f"{GST_MQTT_MAX_LEN_GST_CAPS_STR - 1}")
+    sizes = [len(m) for m in mems] + [0] * (GST_MQTT_MAX_NUM_MEMS - len(mems))
+
+    def ct(v):
+        return GST_CLOCK_TIME_NONE if v is None else int(v)
+
+    hdr = _HDR.pack(len(mems), *sizes, int(base_time_epoch),
+                    int(sent_time_epoch), ct(duration), ct(dts), ct(pts),
+                    caps_b)
+    hdr += b"\x00" * (GST_MQTT_LEN_MSG_HDR - len(hdr))
+    return hdr + b"".join(mems)
+
+
+def parse_gst_mqtt_message(data: bytes) -> dict:
+    """→ dict(mems, caps_str, base_time_epoch, sent_time_epoch, pts, dts,
+    duration); inverse of :func:`pack_gst_mqtt_message`."""
+    if len(data) < GST_MQTT_LEN_MSG_HDR:
+        raise ValueError(
+            f"mqtt: message {len(data)}B shorter than the "
+            f"{GST_MQTT_LEN_MSG_HDR}B GstMQTTMessageHdr")
+    fields = _HDR.unpack_from(data)
+    num_mems = fields[0]
+    if num_mems > GST_MQTT_MAX_NUM_MEMS:
+        raise ValueError(f"mqtt: num_mems {num_mems} out of range")
+    sizes = fields[1:1 + GST_MQTT_MAX_NUM_MEMS][:num_mems]
+    base_epoch, sent_epoch, duration, dts, pts = fields[17:22]
+    caps_str = fields[22].split(b"\x00", 1)[0].decode(errors="replace")
+    mems = []
+    off = GST_MQTT_LEN_MSG_HDR
+    for s in sizes:
+        if off + s > len(data):
+            raise ValueError("mqtt: memory sizes exceed message length")
+        mems.append(data[off:off + s])
+        off += s
+
+    def ct(v):
+        return None if v == GST_CLOCK_TIME_NONE else v
+
+    return dict(mems=mems, caps_str=caps_str, base_time_epoch=base_epoch,
+                sent_time_epoch=sent_epoch, pts=ct(pts), dts=ct(dts),
+                duration=ct(duration))
+
+
+# ---------------------------------------------------------------------------
+# Client
+# ---------------------------------------------------------------------------
+
+class MqttClient:
+    """Minimal MQTT 3.1.1 client (QoS0 pub/sub, retain) with the same
+    surface as the shim's ``Client`` so the pubsub elements can swap
+    transports via ``broker=mqtt://host:port``."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 1883,
+                 client_id: Optional[str] = None, keepalive: int = 60,
+                 timeout: float = 10.0):
+        self.failed = threading.Event()
+        self._subs: List[Tuple[str, Callable[[str, bytes], None]]] = []
+        self._lock = threading.Lock()
+        self._pid = 0
+        self._suback = threading.Event()
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._sock.settimeout(timeout)
+        cid = client_id or f"nnstpu-{uuid.uuid4().hex[:12]}"
+        self._sock.sendall(connect_packet(cid, keepalive))
+        pkt = read_packet(self._sock)
+        if pkt is None or pkt[0] != CONNACK or pkt[2][1] != 0:
+            self._sock.close()
+            raise ConnectionError(
+                f"mqtt: CONNECT to {host}:{port} refused "
+                f"(code {pkt[2][1] if pkt else 'EOF'})")
+        self._sock.settimeout(None)
+        self._alive = True
+        self._reader = threading.Thread(target=self._read_loop, daemon=True,
+                                        name="mqtt-client-read")
+        self._reader.start()
+        # keepalive: a conformant broker drops clients silent for
+        # 1.5x the advertised interval [MQTT-3.1.2-24]
+        self._stop_evt = threading.Event()
+        self._pinger = threading.Thread(
+            target=self._ping_loop, args=(max(1.0, keepalive / 2),),
+            daemon=True, name="mqtt-client-ping")
+        self._pinger.start()
+
+    def _ping_loop(self, interval: float):
+        while not self._stop_evt.wait(interval):
+            if not self._alive:
+                return
+            try:
+                self.ping()
+            except OSError:
+                return
+
+    def publish(self, topic: str, payload: bytes,
+                retain: bool = False) -> None:
+        with self._lock:
+            self._sock.sendall(publish_packet(topic, payload, retain))
+
+    def subscribe(self, topic_filter: str,
+                  cb: Callable[[str, bytes], None],
+                  timeout: float = 10.0) -> None:
+        with self._lock:
+            self._pid = self._pid % 0xFFFF + 1
+            self._subs.append((topic_filter, cb))
+            self._suback.clear()
+            self._sock.sendall(subscribe_packet(self._pid, topic_filter))
+        if not self._suback.wait(timeout):
+            raise TimeoutError(f"mqtt: no SUBACK for {topic_filter!r}")
+
+    def _read_loop(self):
+        while self._alive:
+            try:
+                pkt = read_packet(self._sock)
+            except Exception:
+                pkt = None
+            if pkt is None:
+                if self._alive:
+                    self.failed.set()
+                return
+            ptype, flags, body = pkt
+            try:
+                if ptype == PUBLISH:
+                    topic, payload, _retain = parse_publish(flags, body)
+                    for pattern, cb in list(self._subs):
+                        if topic_matches(pattern, topic):
+                            try:
+                                cb(topic, payload)
+                            except Exception as e:  # noqa: BLE001
+                                log.warning("mqtt subscriber callback: %s", e)
+                elif ptype == SUBACK:
+                    self._suback.set()
+                elif ptype == PINGREQ:
+                    with self._lock:
+                        self._sock.sendall(pingresp_packet())
+            except Exception as e:  # noqa: BLE001 — malformed peer bytes
+                # framing state is unreliable past a parse error: fail the
+                # connection so pollers of `failed` see it, don't hang
+                log.warning("mqtt: malformed packet type %d: %s", ptype, e)
+                if self._alive:
+                    self.failed.set()
+                return
+
+    def ping(self) -> None:
+        with self._lock:
+            self._sock.sendall(pingreq_packet())
+
+    def close(self) -> None:
+        self._alive = False
+        self._stop_evt.set()
+        try:
+            with self._lock:
+                self._sock.sendall(disconnect_packet())
+        except OSError:
+            pass
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+
+# ---------------------------------------------------------------------------
+# Broker
+# ---------------------------------------------------------------------------
+
+class MqttBroker:
+    """In-process broker speaking real MQTT 3.1.1 (QoS0 + retain).
+
+    Gives loopback tests and brokerless edge deployments a conformant
+    peer; production fleets point ``broker=mqtt://`` at their own."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(32)
+        self.port = self._srv.getsockname()[1]
+        self._lock = threading.Lock()
+        #: sock → list of topic filters
+        self._clients: Dict[socket.socket, List[str]] = {}
+        self._retained: Dict[str, bytes] = {}
+        self._alive = True
+        self._acceptor = threading.Thread(target=self._accept_loop,
+                                          daemon=True, name="mqtt-accept")
+        self._acceptor.start()
+
+    def _accept_loop(self):
+        while self._alive:
+            try:
+                sock, _addr = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(sock,), daemon=True,
+                             name="mqtt-serve").start()
+
+    def _serve(self, sock: socket.socket):
+        try:
+            pkt = read_packet(sock)
+            if pkt is None or pkt[0] != CONNECT:
+                sock.close()
+                return
+            body = pkt[2]
+            if body[:6] != PROTOCOL_NAME or body[6] != PROTOCOL_LEVEL:
+                sock.sendall(connack_packet(return_code=1))  # bad version
+                sock.close()
+                return
+            sock.sendall(connack_packet(0))
+            with self._lock:
+                self._clients[sock] = []
+            while self._alive:
+                pkt = read_packet(sock)
+                if pkt is None:
+                    break
+                ptype, flags, body = pkt
+                if ptype == PUBLISH:
+                    topic, payload, retain = parse_publish(flags, body)
+                    self._route(topic, payload, retain)
+                elif ptype == SUBSCRIBE:
+                    (pid,) = struct.unpack_from(">H", body)
+                    off, codes = 2, []
+                    with self._lock:
+                        filters = self._clients.get(sock)
+                    while off < len(body):
+                        (tlen,) = struct.unpack_from(">H", body, off)
+                        filt = body[off + 2:off + 2 + tlen].decode()
+                        off += 2 + tlen + 1  # + requested QoS byte
+                        codes.append(0)  # granted QoS0
+                        if filters is not None:
+                            filters.append(filt)
+                        self._send_retained(sock, filt)
+                    sock.sendall(suback_packet(pid, codes))
+                elif ptype == UNSUBSCRIBE:
+                    (pid,) = struct.unpack_from(">H", body)
+                    (tlen,) = struct.unpack_from(">H", body, 2)
+                    filt = body[4:4 + tlen].decode()
+                    with self._lock:
+                        if filt in self._clients.get(sock, []):
+                            self._clients[sock].remove(filt)
+                    sock.sendall(unsuback_packet(pid))
+                elif ptype == PINGREQ:
+                    sock.sendall(pingresp_packet())
+                elif ptype == DISCONNECT:
+                    break
+        except OSError:
+            pass
+        finally:
+            with self._lock:
+                self._clients.pop(sock, None)
+            sock.close()
+
+    def _send_retained(self, sock: socket.socket, filt: str):
+        with self._lock:
+            hits = [(t, p) for t, p in self._retained.items()
+                    if topic_matches(filt, t)]
+        for topic, payload in hits:
+            try:
+                sock.sendall(publish_packet(topic, payload, retain=True))
+            except OSError:
+                pass
+
+    def _route(self, topic: str, payload: bytes, retain: bool):
+        with self._lock:
+            if retain:
+                if payload:
+                    self._retained[topic] = payload
+                else:
+                    self._retained.pop(topic, None)  # spec 3.3.1.3
+            targets = [s for s, filters in self._clients.items()
+                       if any(topic_matches(f, topic) for f in filters)]
+        pkt = publish_packet(topic, payload)
+        for s in targets:
+            try:
+                s.sendall(pkt)
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._alive = False
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._lock:
+            socks = list(self._clients)
+            self._clients.clear()
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass

@@ -264,12 +264,31 @@ def epoch_ns() -> int:
     return time.time_ns()
 
 
-def make_buffer_envelope(buf_payload: bytes, pts: Optional[int]) -> bytes:
-    """Prefix sender epoch + pts so receivers can rebase timestamps."""
-    return struct.pack("<qq", epoch_ns(), -1 if pts is None else pts) + \
-        buf_payload
+#: envelope magic+version: peers with a different envelope layout fail
+#: loudly instead of misparsing timestamps as payload
+_ENVELOPE_MAGIC = b"NPE2"
 
 
-def parse_buffer_envelope(data: bytes) -> Tuple[int, Optional[int], bytes]:
-    sent_epoch, pts = struct.unpack_from("<qq", data)
-    return sent_epoch, (None if pts < 0 else pts), data[16:]
+def make_buffer_envelope(buf_payload: bytes, pts: Optional[int],
+                         base_epoch: Optional[int] = None,
+                         sent_epoch: Optional[int] = None) -> bytes:
+    """Prefix sender base-epoch + send-epoch + pts so receivers can rebase
+    timestamps by base-epoch difference (the reference's
+    _put_timestamp_on_gst_buf math, mqttsrc.c:1381-1404 — latency-free,
+    unlike a first-message arrival delta)."""
+    return _ENVELOPE_MAGIC + struct.pack(
+        "<qqq",
+        epoch_ns() if base_epoch is None else base_epoch,
+        epoch_ns() if sent_epoch is None else sent_epoch,
+        -1 if pts is None else pts,
+    ) + buf_payload
+
+
+def parse_buffer_envelope(data: bytes) -> Tuple[int, int, Optional[int],
+                                                bytes]:
+    if data[:4] != _ENVELOPE_MAGIC:
+        raise ValueError(
+            "pubsub: buffer envelope magic/version mismatch (peer runs an "
+            "incompatible framework version)")
+    base_epoch, sent_epoch, pts = struct.unpack_from("<qqq", data, 4)
+    return base_epoch, sent_epoch, (None if pts < 0 else pts), data[28:]

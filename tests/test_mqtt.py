@@ -1,0 +1,336 @@
+"""Real MQTT 3.1.1 framing, the reference GstMQTTMessageHdr wire layout,
+SNTP clock correction, and the pubsub elements over the mqtt transport.
+
+Reference parity: gst/mqtt/mqttsink.c + mqttsrc.c (paho MQTT transport),
+mqttcommon.h:49-63 (1024-byte message header), ntputil.c (SNTP epoch),
+Documentation/synchronization-in-mqtt-elements.md (base-epoch rebasing).
+Protocol-level packet tests run always; the loopback tests use the
+in-tree MqttBroker, which speaks the same conformant MQTT any external
+broker does.
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import parse_launch
+from nnstreamer_tpu.query import mqtt as M
+
+
+class TestVarlen:
+    @pytest.mark.parametrize("n,encoded", [
+        (0, b"\x00"),
+        (127, b"\x7f"),
+        (128, b"\x80\x01"),
+        (16383, b"\xff\x7f"),
+        (16384, b"\x80\x80\x01"),
+        (268_435_455, b"\xff\xff\xff\x7f"),
+    ])
+    def test_spec_vectors(self, n, encoded):
+        # the exact example table from MQTT 3.1.1 spec section 2.2.3
+        assert M.encode_varlen(n) == encoded
+        assert M.decode_varlen(encoded) == (n, len(encoded))
+
+    def test_out_of_range(self):
+        with pytest.raises(ValueError):
+            M.encode_varlen(268_435_456)
+        with pytest.raises(ValueError):
+            M.decode_varlen(b"\xff\xff\xff\xff\x01")
+
+    def test_truncated(self):
+        with pytest.raises(ValueError):
+            M.decode_varlen(b"\x80")
+
+
+class TestPackets:
+    def test_connect_layout(self):
+        pkt = M.connect_packet("cid", keepalive=30)
+        assert pkt[0] == M.CONNECT << 4
+        body = pkt[2:]
+        assert body[:6] == b"\x00\x04MQTT"
+        assert body[6] == 4                      # protocol level 3.1.1
+        assert body[7] == 0x02                   # clean session
+        assert struct.unpack_from(">H", body, 8) == (30,)
+        assert body[10:] == b"\x00\x03cid"
+
+    def test_publish_parse(self):
+        pkt = M.publish_packet("t/x", b"payload", retain=True)
+        assert pkt[0] == (M.PUBLISH << 4) | 0x01
+        _, used = M.decode_varlen(pkt, 1)
+        topic, payload, retain = M.parse_publish(pkt[0] & 0x0F,
+                                                 pkt[1 + used:])
+        assert (topic, payload, retain) == ("t/x", b"payload", True)
+
+    def test_subscribe_flags(self):
+        pkt = M.subscribe_packet(7, "a/+/b")
+        assert pkt[0] == (M.SUBSCRIBE << 4) | 0x02  # mandatory flags
+        body = pkt[2:]
+        assert struct.unpack_from(">H", body) == (7,)
+        assert body[2:].endswith(b"\x00")  # requested QoS0
+
+    def test_connack(self):
+        assert M.connack_packet(0)[-2:] == b"\x00\x00"
+        assert M.connack_packet(5)[-1] == 5
+
+
+class TestTopicMatching:
+    @pytest.mark.parametrize("pattern,topic,match", [
+        ("a/b", "a/b", True),
+        ("a/b", "a/c", False),
+        ("a/+", "a/b", True),
+        ("a/+", "a/b/c", False),
+        ("a/#", "a/b/c", True),
+        ("#", "anything/at/all", True),
+        ("a/+/c", "a/b/c", True),
+        ("a/+/c", "a/b/d", False),
+    ])
+    def test_cases(self, pattern, topic, match):
+        assert M.topic_matches(pattern, topic) is match
+
+
+@pytest.fixture
+def mqtt_broker():
+    b = M.MqttBroker()
+    yield b
+    b.close()
+
+
+class TestBrokerClientLoopback:
+    """The skip-gated 'real broker' test of the reference plan — the
+    in-tree broker IS a real MQTT broker on loopback."""
+
+    def test_pub_sub(self, mqtt_broker):
+        got = []
+        sub = M.MqttClient(port=mqtt_broker.port)
+        sub.subscribe("s/t", lambda t, p: got.append((t, p)))
+        pub = M.MqttClient(port=mqtt_broker.port)
+        pub.publish("s/t", b"data")
+        deadline = time.time() + 5
+        while not got and time.time() < deadline:
+            time.sleep(0.01)
+        assert got == [("s/t", b"data")]
+        sub.close()
+        pub.close()
+
+    def test_retain_for_late_subscriber(self, mqtt_broker):
+        pub = M.MqttClient(port=mqtt_broker.port)
+        pub.publish("cfg/one", b"v1", retain=True)
+        time.sleep(0.1)
+        got = []
+        sub = M.MqttClient(port=mqtt_broker.port)
+        sub.subscribe("cfg/#", lambda t, p: got.append((t, p)))
+        deadline = time.time() + 5
+        while not got and time.time() < deadline:
+            time.sleep(0.01)
+        assert got == [("cfg/one", b"v1")]
+        sub.close()
+        pub.close()
+
+    def test_external_port_env(self, mqtt_broker, monkeypatch):
+        """Loopback against 'an external broker' address (env-pointed),
+        per the skip-gate plan: NNSTPU_TEST_MQTT_BROKER=host:port."""
+        monkeypatch.setenv("NNSTPU_TEST_MQTT_BROKER",
+                           f"127.0.0.1:{mqtt_broker.port}")
+        import os
+
+        host, port = os.environ["NNSTPU_TEST_MQTT_BROKER"].split(":")
+        c = M.MqttClient(host, int(port))
+        c.publish("env/x", b"ok")
+        c.close()
+
+
+class TestGstMqttHeader:
+    def test_layout_byte_exact(self):
+        """Offsets match the C struct (mqttcommon.h:49-63): num_mems@0,
+        size_mems@8, base@136, sent@144, duration@152, dts@160, pts@168,
+        caps@176; header is exactly 1024 bytes."""
+        msg = M.pack_gst_mqtt_message(
+            [b"abcd", b"xy"], "other/tensors,num_tensors=2",
+            base_time_epoch=111, sent_time_epoch=222,
+            pts=333, dts=444, duration=555)
+        hdr = msg[:M.GST_MQTT_LEN_MSG_HDR]
+        assert len(msg) == 1024 + 6
+        assert struct.unpack_from("<I", hdr, 0) == (2,)
+        assert struct.unpack_from("<QQ", hdr, 8) == (4, 2)
+        assert struct.unpack_from("<q", hdr, 136) == (111,)
+        assert struct.unpack_from("<q", hdr, 144) == (222,)
+        assert struct.unpack_from("<Q", hdr, 152) == (555,)
+        assert struct.unpack_from("<Q", hdr, 160) == (444,)
+        assert struct.unpack_from("<Q", hdr, 168) == (333,)
+        assert hdr[176:176 + 28] == b"other/tensors,num_tensors=2\x00"
+        assert msg[1024:] == b"abcdxy"
+
+    def test_roundtrip_and_none_times(self):
+        msg = M.pack_gst_mqtt_message([b"\x01\x02"], "caps", 1, 2)
+        out = M.parse_gst_mqtt_message(msg)
+        assert out["mems"] == [b"\x01\x02"]
+        assert out["caps_str"] == "caps"
+        assert out["pts"] is None and out["dts"] is None
+        assert out["duration"] is None
+        assert out["base_time_epoch"] == 1
+
+    def test_limits(self):
+        with pytest.raises(ValueError, match="NUM_MEMS"):
+            M.pack_gst_mqtt_message([b"x"] * 17, "", 0, 0)
+        with pytest.raises(ValueError, match="caps"):
+            M.pack_gst_mqtt_message([b"x"], "c" * 512, 0, 0)
+        with pytest.raises(ValueError, match="Hdr"):
+            M.parse_gst_mqtt_message(b"short")
+
+
+class TestElementsOverMqtt:
+    def test_pipeline_loopback(self, mqtt_broker):
+        """sink publishes reference-format messages over real MQTT; src
+        reconstructs dtype/shape from the header caps string."""
+        recv = parse_launch(
+            f"tensor_pubsub_src name=src broker=mqtt://127.0.0.1:"
+            f"{mqtt_broker.port} sub_topic=nns/t num_buffers=3 ! "
+            "tensor_sink name=out"
+        )
+        outs = []
+        recv.get("out").connect(lambda b: outs.append(b))
+        recv.start()
+        time.sleep(0.3)  # let SUBSCRIBE land before publishing
+
+        send = parse_launch(
+            "appsrc name=in ! tensor_pubsub_sink name=snk "
+            f"broker=mqtt://127.0.0.1:{mqtt_broker.port} pub_topic=nns/t"
+        )
+        send.start()
+        for k in range(3):
+            send.get("in").push(
+                [np.full((2, 3), k, np.float32),
+                 np.arange(4, dtype=np.int32)])
+        send.get("in").end_of_stream()
+        assert recv.wait(timeout=60).kind == "eos"
+        send.stop()
+        recv.stop()
+        assert len(outs) == 3
+        a0 = np.asarray(outs[0].tensors[0])
+        assert a0.dtype == np.float32 and a0.shape == (2, 3)
+        np.testing.assert_array_equal(
+            np.asarray(outs[2].tensors[0]), np.full((2, 3), 2, np.float32))
+        np.testing.assert_array_equal(
+            np.asarray(outs[0].tensors[1]), np.arange(4, dtype=np.int32))
+
+    def test_reference_peer_can_parse(self, mqtt_broker):
+        """A raw MQTT subscriber (≙ reference mqttsrc) decodes our sink's
+        payload with nothing but mqttcommon.h layout knowledge."""
+        got = []
+        raw = M.MqttClient(port=mqtt_broker.port)
+        raw.subscribe("ref/t", lambda t, p: got.append(p))
+
+        send = parse_launch(
+            "appsrc name=in ! tensor_pubsub_sink "
+            f"broker=mqtt://127.0.0.1:{mqtt_broker.port} pub_topic=ref/t"
+        )
+        send.start()
+        send.get("in").push([np.arange(6, dtype=np.float32).reshape(2, 3)])
+        deadline = time.time() + 10
+        while not got and time.time() < deadline:
+            time.sleep(0.02)
+        send.get("in").end_of_stream()
+        send.wait(timeout=30)
+        send.stop()
+        raw.close()
+        assert got
+        msg = M.parse_gst_mqtt_message(got[0])
+        assert len(msg["mems"]) == 1
+        np.testing.assert_array_equal(
+            np.frombuffer(msg["mems"][0], np.float32), np.arange(6))
+        assert "other/tensor" in msg["caps_str"]
+        assert msg["base_time_epoch"] > 0
+
+
+class TestBaseEpochRebasing:
+    def test_offset_excludes_delivery_latency(self, mqtt_broker):
+        """pts shifts by the base-epoch difference only: delaying
+        delivery must not change the rebased timestamps."""
+        from nnstreamer_tpu.elements.pubsub import TensorPubSubSrc
+
+        recv = parse_launch(
+            f"tensor_pubsub_src name=src broker=mqtt://127.0.0.1:"
+            f"{mqtt_broker.port} sub_topic=lat/t num_buffers=2 ! "
+            "tensor_sink name=out"
+        )
+        src = recv.get("src")
+        outs = []
+        recv.get("out").connect(lambda b: outs.append(b))
+        recv.start()
+        time.sleep(0.3)
+        sender_base = src._base_epoch + 5_000_000_000  # sender 5s ahead
+
+        pub = M.MqttClient(port=mqtt_broker.port)
+        for k, delay in ((0, 0.0), (1, 0.5)):  # second frame arrives late
+            time.sleep(delay)
+            pub.publish("lat/t", M.pack_gst_mqtt_message(
+                [np.float32(k).tobytes()], "", sender_base,
+                sender_base + k, pts=k * 1000))
+        assert recv.wait(timeout=30).kind == "eos"
+        recv.stop()
+        pub.close()
+        assert [b.pts for b in outs] == \
+            [0 * 1000 + 5_000_000_000, 1 * 1000 + 5_000_000_000]
+
+
+class TestSntp:
+    def _serve_once(self, server_offset_ns: int, delay: float = 0.0,
+                    blank_recv_ts: bool = False):
+        """One-shot mock NTP server; returns (port, thread)."""
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+
+        def run():
+            data, addr = sock.recvfrom(512)
+            t_server = time.time_ns() + server_offset_ns
+            if delay:
+                time.sleep(delay)  # asymmetric-looking processing delay
+            from nnstreamer_tpu.query.ntp import _to_ntp
+
+            r_sec, r_frac = _to_ntp(t_server)
+            x_sec, x_frac = _to_ntp(time.time_ns() + server_offset_ns)
+            if blank_recv_ts:
+                r_sec = r_frac = 0
+            reply = struct.pack(
+                ">B3x11I", 0x24, 0, 0, 0, 0, 0,
+                *struct.unpack_from(">2I", data, 40),  # origin := client xmit
+                r_sec, r_frac, x_sec, x_frac)
+            sock.sendto(reply, addr)
+            sock.close()
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        return port, t
+
+    def test_offset_measured(self):
+        from nnstreamer_tpu.query.ntp import sntp_offset_ns
+
+        port, t = self._serve_once(server_offset_ns=3_000_000_000)
+        off = sntp_offset_ns("127.0.0.1", port)
+        t.join(5)
+        assert abs(off - 3_000_000_000) < 200_000_000  # within 200ms
+
+    def test_offset_excludes_latency(self):
+        """A slow server round trip must not leak into the offset (the
+        reference's transmit-timestamp-only math would be off by ~delay)."""
+        from nnstreamer_tpu.query.ntp import sntp_offset_ns
+
+        port, t = self._serve_once(server_offset_ns=0, delay=0.4)
+        off = sntp_offset_ns("127.0.0.1", port, timeout=5)
+        t.join(5)
+        assert abs(off) < 250_000_000  # << the 400ms injected delay
+
+    def test_corrected_epoch_fallback(self, monkeypatch):
+        from nnstreamer_tpu.query import ntp
+
+        ntp.reset_offset_cache()
+        # unreachable server: falls back to the local clock, streaming on
+        before = time.time_ns()
+        got = ntp.corrected_epoch_ns([("127.0.0.1", 1)], timeout=0.2)
+        assert got >= before
+        ntp.reset_offset_cache()
