@@ -35,7 +35,9 @@ class TensorAggregator(Element):
         super().__init__(name, **props)
         self.add_sink_pad("sink")
         self.add_src_pad("src")
-        self._window: List[np.ndarray] = []  # unit frames along frames_dim
+        #: one window per tensor position in the frame — every tensor of a
+        #: multi-tensor stream is aggregated, none silently dropped
+        self._windows: List[List[np.ndarray]] = []
         self._pts: Optional[int] = None
 
     def transform_caps(self, pad, caps):
@@ -48,36 +50,50 @@ class TensorAggregator(Element):
         fin = int(self.get_property("frames_in"))
         fout = int(self.get_property("frames_out"))
         flush = int(self.get_property("frames_flush")) or fout
-        arr = buf.tensors[0]
-        axis = self._axis(arr)
+        if not buf.tensors:
+            return None  # empty frame: nothing to window (and `all([])`
+            # below would spin forever)
+        if not self._windows:
+            self._windows = [[] for _ in buf.tensors]
+        elif len(buf.tensors) != len(self._windows):
+            raise ValueError(
+                f"tensor_aggregator: frame has {len(buf.tensors)} tensors, "
+                f"stream started with {len(self._windows)}"
+            )
         if self._pts is None:
             self._pts = buf.pts
-        # split the incoming buffer into its `frames_in` unit frames
         n = max(fin, 1)
-        if arr.shape[axis] % n:
-            raise ValueError(
-                f"tensor_aggregator: dim {self.get_property('frames_dim')} "
-                f"size {arr.shape[axis]} not divisible by frames-in {n}"
-            )
-        per = arr.shape[axis] // n
-        for k in range(n):
-            sl = [slice(None)] * arr.ndim
-            sl[axis] = slice(k * per, (k + 1) * per)
-            self._window.append(arr[tuple(sl)])
+        for ti, arr in enumerate(buf.tensors):
+            axis = self._axis(arr)
+            # split the incoming tensor into its `frames_in` unit frames
+            if arr.shape[axis] % n:
+                raise ValueError(
+                    f"tensor_aggregator: dim "
+                    f"{self.get_property('frames_dim')} size "
+                    f"{arr.shape[axis]} not divisible by frames-in {n}"
+                )
+            per = arr.shape[axis] // n
+            for k in range(n):
+                sl = [slice(None)] * arr.ndim
+                sl[axis] = slice(k * per, (k + 1) * per)
+                self._windows[ti].append(arr[tuple(sl)])
         ret = None
-        while len(self._window) >= fout:
-            chunk = self._window[:fout]
-            if self.get_property("concat"):
-                if is_device_array(chunk[0]):
-                    import jax.numpy as jnp
+        while all(len(w) >= fout for w in self._windows):
+            outs = []
+            for w in self._windows:
+                chunk = w[:fout]
+                axis = self._axis(chunk[0])
+                if self.get_property("concat"):
+                    if is_device_array(chunk[0]):
+                        import jax.numpy as jnp
 
-                    outs = [jnp.concatenate(chunk, axis=axis)]
+                        outs.append(jnp.concatenate(chunk, axis=axis))
+                    else:
+                        outs.append(np.concatenate(chunk, axis=axis))
                 else:
-                    outs = [np.concatenate(chunk, axis=axis)]
-            else:
-                # concat=false: collected frames stay separate tensors
-                # (reference tensor_aggregator concat property)
-                outs = list(chunk)
+                    # concat=false: collected frames stay separate tensors
+                    # (reference tensor_aggregator concat property)
+                    outs.extend(chunk)
             if self.srcpad.caps is None:
                 from nnstreamer_tpu.tensors.types import TensorsConfig
 
@@ -87,10 +103,10 @@ class TensorAggregator(Element):
             ret = self.srcpad.push(
                 TensorBuffer(outs, pts=self._pts)
             )
-            self._window = self._window[flush:]
+            self._windows = [w[flush:] for w in self._windows]
             self._pts = buf.pts
         return ret
 
     def handle_eos(self):
-        self._window.clear()
+        self._windows.clear()
         self._pts = None

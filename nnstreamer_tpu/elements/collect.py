@@ -78,6 +78,27 @@ class CollectPads:
             for frame in ready:
                 self.on_ready(frame)
 
+    def requeue_front(self, pad_index: int, buf: TensorBuffer) -> None:
+        """Put a buffer back at the head of a pad's queue (no collect
+        trigger) — used by consumers that reject a pairing and keep the
+        newer buffer for the next one (tensor_crop lateness). Follow with
+        :meth:`recheck` once the rejection is fully handled."""
+        with self._lock:
+            self._queues[pad_index].insert(0, buf)
+
+    def recheck(self) -> List[List[tuple]]:
+        """Re-run collection without a new arrival (after requeue_front or
+        EOS) and dispatch any now-ready frames. Not for the ``refresh``
+        policy, which is strictly arrival-driven."""
+        if self.policy == "refresh":
+            raise ValueError("recheck() is undefined for policy 'refresh'")
+        with self._lock:
+            ready = self._collect_locked(-1)
+        if ready and self.on_ready:
+            for frame in ready:
+                self.on_ready(frame)
+        return ready
+
     def set_eos(self, pad_index: int) -> bool:
         """Mark a pad EOS; returns True when ALL pads are EOS."""
         with self._lock:

@@ -206,14 +206,28 @@ class TensorFilter(Element):
             infos.append(out_info[idx] if kind == "o" else in_info[idx])
         return TensorsInfo(infos)
 
+    def src_event(self, pad, event):
+        """Throttle QoS from downstream (tensor_rate throttle=true,
+        gsttensorrate.c:27-36): adopt the target interval and consume the
+        event — the filter is the expensive element the QoS targets."""
+        from nnstreamer_tpu.pipeline.element import QosEvent
+
+        if isinstance(event, QosEvent):
+            self._qos_interval_s = event.target_interval_ns / 1e9
+            return
+        super().src_event(pad, event)
+
     # -- hot path ------------------------------------------------------------
     def chain(self, pad, buf):
         throttle = int(self.get_property("throttle"))
-        if throttle > 0:
+        # min invoke interval: own throttle prop and downstream QoS combine
+        interval = 1.0 / throttle if throttle > 0 else 0.0
+        interval = max(interval, getattr(self, "_qos_interval_s", 0.0))
+        if interval > 0:
             import time
 
             now = time.monotonic()
-            if now - self._last_invoke_t < 1.0 / throttle:
+            if now - self._last_invoke_t < interval:
                 return None  # QoS drop (tensor_filter.c:426)
             self._last_invoke_t = now
         fw = self.fw or self._open_fw()

@@ -258,7 +258,13 @@ class TensorPubSubSrc(SourceElement, _PubSubBase):
                 body = self._q.get(timeout=0.1)
             except _queue.Empty:
                 continue
-            buf, sender_base, pts = self._decode(body)
+            try:
+                buf, sender_base, pts = self._decode(body)
+            except (ValueError, KeyError) as e:
+                # foreign/malformed message on a shared topic: log and keep
+                # streaming (the reference mqttsrc does not die either)
+                self.log.warning("dropping undecodable message (%s)", e)
+                continue
             if self.get_property("rebase_timestamps") and pts is not None:
                 # reference _put_timestamp_on_gst_buf: shift pts AND dts by
                 # the difference of base epochs — no message latency involved

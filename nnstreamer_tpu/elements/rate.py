@@ -3,14 +3,19 @@
 Reference: ``gst/nnstreamer/elements/gsttensorrate.c`` (997 LoC): converts
 stream framerate by dropping/duplicating frames and, with ``throttle=true``,
 propagates QoS so upstream inference skips work for frames that would be
-dropped (gsttensorrate.c:27-36).
+dropped (gsttensorrate.c:27-36). Here the QoS rides a :class:`QosEvent`
+upstream (posted at caps time and whenever the target rate changes);
+``tensor_filter`` honors it in its invoke drop check.
+
+``silent`` (reference gsttensorrate "silent" property) gates per-drop /
+per-duplicate debug logging; counters are always kept.
 """
 
 from __future__ import annotations
 
 from typing import Optional
 
-from nnstreamer_tpu.pipeline.element import CapsEvent, Element
+from nnstreamer_tpu.pipeline.element import Element, QosEvent
 from nnstreamer_tpu.registry import ELEMENT, subplugin
 from nnstreamer_tpu.tensors.types import Fraction, TensorsConfig
 
@@ -19,7 +24,7 @@ from nnstreamer_tpu.tensors.types import Fraction, TensorsConfig
 class TensorRate(Element):
     ELEMENT_NAME = "tensor_rate"
     PROPERTIES = {**Element.PROPERTIES, "framerate": None, "throttle": True,
-                  "silent_drop": False}
+                  "silent_drop": None}  # deprecated alias of `silent`
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
@@ -27,6 +32,7 @@ class TensorRate(Element):
         self.add_src_pad("src")
         self._in_rate: Optional[Fraction] = None
         self._next_ts: Optional[float] = None  # set from first buffer's pts
+        self._posted_interval: Optional[int] = None
         self.dropped = 0
         self.duplicated = 0
         self.out_count = 0
@@ -35,11 +41,36 @@ class TensorRate(Element):
         spec = self.get_property("framerate")
         return Fraction.parse(spec) if spec else None
 
+    def _post_qos(self) -> None:
+        """Tell upstream the target inter-frame interval (0 lifts it)."""
+        out = self._out_rate()
+        interval = 0
+        if bool(self.get_property("throttle")) and out is not None \
+                and out.num > 0:
+            interval = out.frame_duration_ns or 0
+        if interval != self._posted_interval:
+            self._posted_interval = interval
+            self.sinkpads[0].push_upstream_event(
+                QosEvent(target_interval_ns=interval))
+
+    def property_changed(self, key):
+        if key == "silent_drop":  # deprecated alias, kept for old strings
+            v = self.get_property("silent_drop")
+            if v is not None:  # launch strings deliver str, API bool
+                self.set_property("silent", str(v).strip().lower()
+                                  in ("1", "true", "yes", "on"))
+            return
+        # guard: set_property runs from __init__ before our fields exist
+        if key in ("framerate", "throttle") and \
+                getattr(self, "_posted_interval", None) is not None:
+            self._post_qos()
+
     def transform_caps(self, pad, caps):
         try:
             cfg = TensorsConfig.from_caps(caps)
             self._in_rate = cfg.rate
             out = self._out_rate()
+            self._post_qos()
             if out is not None:
                 cfg.rate = out
                 return cfg.to_caps()
@@ -67,7 +98,12 @@ class TensorRate(Element):
             self.out_count += 1
             if pushed:
                 self.duplicated += 1
+                if not self.get_property("silent"):
+                    self.log.debug("duplicated frame at pts %d", out.pts)
             pushed = True
         if not pushed:
             self.dropped += 1
+            if not self.get_property("silent"):
+                self.log.debug("dropped frame at pts %d (total %d)",
+                               buf.pts, self.dropped)
         return ret

@@ -78,6 +78,17 @@ class CustomEvent(Event):
     data: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
 
+@dataclasses.dataclass
+class QosEvent(Event):
+    """Throttling QoS — flows *upstream* (reference GST_EVENT_QOS, posted
+    by tensor_rate with throttle=true so upstream inference skips frames
+    that would be dropped, gsttensorrate.c:27-36).
+
+    ``target_interval_ns == 0`` lifts the throttle."""
+
+    target_interval_ns: int = 0
+
+
 # --------------------------------------------------------------------------
 # Pad
 # --------------------------------------------------------------------------
@@ -134,6 +145,14 @@ class Pad:
             self.caps = event.caps
         if self.peer is not None:
             self.peer.element._event_entry(self.peer, event)
+
+    def push_upstream_event(self, event: Event) -> None:
+        """Send an event upstream (sink pads only): it arrives on the
+        peer src pad and dispatches to that element's ``src_event``."""
+        if self.direction is not PadDirection.SINK:
+            raise ValueError(f"{self}: upstream events leave via sink pads")
+        if self.peer is not None:
+            self.peer.element._upstream_event_entry(self.peer, event)
 
     def set_caps(self, caps: Caps) -> None:
         """Fix this src pad's caps and announce downstream."""
@@ -319,12 +338,21 @@ class Element:
             pad.eos = True
         self.sink_event(pad, event)
 
+    def _upstream_event_entry(self, pad: Pad, event: Event) -> None:
+        self.src_event(pad, event)
+
     # -- subclass hooks ------------------------------------------------------
     def chain(self, pad: Pad, buf: TensorBuffer) -> Optional[FlowReturn]:
         """Process one input buffer. Default: passthrough to first src pad."""
         if self.srcpads:
             return self.srcpad.push(buf)
         return FlowReturn.OK
+
+    def src_event(self, pad: Pad, event: Event) -> None:
+        """Handle an upstream-flowing event arriving on a src pad.
+        Default: forward further upstream through every sink pad."""
+        for sp in self.sinkpads:
+            sp.push_upstream_event(event)
 
     def sink_event(self, pad: Pad, event: Event) -> None:
         """Handle a downstream-flowing event. Default: CAPS → negotiate via

@@ -194,6 +194,14 @@ class FusedRegion(Element):
     def chain(self, pad, buf):
         if pad is self.internal_pad:
             raise FlowError(f"{self.name}: buffer on internal event pad")
+        qos = getattr(self, "_qos_interval_s", 0.0)
+        if qos > 0:
+            import time
+
+            now = time.monotonic()
+            if now - getattr(self, "_last_invoke_t", 0.0) < qos:
+                return None  # downstream-rate QoS drop (tensor_filter.c:426)
+            self._last_invoke_t = now
         compiled = self._compiled
         if compiled is None:
             try:
@@ -238,6 +246,26 @@ class FusedRegion(Element):
         return first._chain_entry(first.sinkpads[0], buf)
 
     # -- events --------------------------------------------------------------
+    def src_event(self, pad: Pad, event: Event) -> None:
+        from nnstreamer_tpu.pipeline.element import QosEvent
+
+        if isinstance(event, QosEvent) and any(
+                type(m).src_event is not Element.src_event
+                for m in self.members):
+            # a member consumes QoS (the filter): the event targets THIS
+            # region's dispatch, since the members' chains don't run.
+            # Deliver through the member chain too, so per-member QoS
+            # state stays correct if the region later unsplices, and stop
+            # — exactly one throttle gates the stream.
+            self._qos_interval_s = event.target_interval_ns / 1e9
+            last = self.members[-1]
+            last._upstream_event_entry(last.srcpads[0], event)
+            return
+        # no consuming member: pass upstream past the region via the data
+        # sink pad only (the base default would also loop the internal
+        # pad, re-dispatching the event into the member chain)
+        self.sinkpads[0].push_upstream_event(event)
+
     def sink_event(self, pad: Pad, event: Event) -> None:
         if pad is self.internal_pad:
             # an event the member chain chose to forward — pass it on

@@ -291,6 +291,7 @@ class MqttClient:
         self._lock = threading.Lock()
         self._pid = 0
         self._suback = threading.Event()
+        self._suback_codes: Optional[bytes] = None
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._sock.settimeout(timeout)
         cid = client_id or f"nnstpu-{uuid.uuid4().hex[:12]}"
@@ -335,9 +336,16 @@ class MqttClient:
             self._pid = self._pid % 0xFFFF + 1
             self._subs.append((topic_filter, cb))
             self._suback.clear()
+            self._suback_codes = None
             self._sock.sendall(subscribe_packet(self._pid, topic_filter))
         if not self._suback.wait(timeout):
-            raise TimeoutError(f"mqtt: no SUBACK for {topic_filter!r}")
+            raise ConnectionError(f"mqtt: no SUBACK for {topic_filter!r}")
+        codes = self._suback_codes or b""
+        if any(c == 0x80 for c in codes):  # spec 3.9.3: 0x80 = failure
+            with self._lock:
+                self._subs.remove((topic_filter, cb))
+            raise ConnectionError(
+                f"mqtt: broker rejected subscription to {topic_filter!r}")
 
     def _read_loop(self):
         while self._alive:
@@ -360,6 +368,7 @@ class MqttClient:
                             except Exception as e:  # noqa: BLE001
                                 log.warning("mqtt subscriber callback: %s", e)
                 elif ptype == SUBACK:
+                    self._suback_codes = body[2:]  # skip packet id
                     self._suback.set()
                 elif ptype == PINGREQ:
                     with self._lock:

@@ -85,43 +85,43 @@ def sntp_offset_ns(server: str = "pool.ntp.org", port: int = 123,
     return ((t1 - t0) + (t2 - t3)) // 2
 
 
-class _OffsetCache:
-    def __init__(self):
-        self.lock = threading.Lock()
-        self.offset: Optional[int] = None
-        self.failed = False
+_FAILED = object()  # sentinel: this server list was tried and unreachable
 
-
-_cache = _OffsetCache()
+_cache_lock = threading.Lock()
+#: per-server-list measured offsets — elements with different ntp-server
+#: settings never poison each other's correction
+_cache: dict = {}
 
 
 def corrected_epoch_ns(servers: Optional[Iterable[Tuple[str, int]]] = None,
                        timeout: float = 2.0) -> int:
     """NTP-corrected Unix epoch (ns): ``time_ns() + cached offset``.
 
-    Tries each server once on first use (reference ntputil loops hnames
-    the same way); on total failure logs once and falls back to the
-    uncorrected clock — the element keeps streaming, matching
-    mqttsink.c's get-epoch fallback behavior.
+    The offset is measured once per distinct server list (reference
+    ntputil loops hnames the same way); on total failure logs once and
+    falls back to the uncorrected clock — the element keeps streaming,
+    matching mqttsink.c's get-epoch fallback behavior.
     """
-    with _cache.lock:
-        if _cache.offset is None and not _cache.failed:
-            for host, port in (servers or DEFAULT_SERVERS):
+    key = tuple(servers) if servers is not None else DEFAULT_SERVERS
+    with _cache_lock:
+        entry = _cache.get(key)
+        if entry is None:
+            for host, port in key:
                 try:
-                    _cache.offset = sntp_offset_ns(host, port, timeout)
+                    entry = sntp_offset_ns(host, port, timeout)
                     log.info("ntp: offset %+d us via %s",
-                             _cache.offset // 1000, host)
+                             entry // 1000, host)
                     break
                 except (OSError, ValueError) as e:
                     log.warning("ntp: %s:%d unreachable (%s)", host, port, e)
             else:
-                _cache.failed = True
-        off = _cache.offset or 0
+                entry = _FAILED
+            _cache[key] = entry
+    off = 0 if entry is _FAILED else entry
     return time.time_ns() + off
 
 
 def reset_offset_cache() -> None:
-    """Forget the measured offset (tests / long-running re-sync)."""
-    with _cache.lock:
-        _cache.offset = None
-        _cache.failed = False
+    """Forget measured offsets (tests / long-running re-sync)."""
+    with _cache_lock:
+        _cache.clear()

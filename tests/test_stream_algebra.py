@@ -145,6 +145,47 @@ class TestAggregator:
         assert bufs[0][0].shape == (16, 1)
 
 
+class TestAggregatorMultiTensor:
+    def test_all_tensors_aggregated(self):
+        """2-tensor frames: both positions window and concat (nothing
+        silently dropped, tensor_aggregator.c parity)."""
+        from nnstreamer_tpu.pipeline.pipeline import Pipeline
+        from nnstreamer_tpu.elements.source import AppSrc
+        from nnstreamer_tpu.elements.aggregator import TensorAggregator
+        from nnstreamer_tpu.elements.sink import TensorSink
+
+        src = AppSrc(name="a")
+        agg = TensorAggregator(frames_out=3, frames_dim=1)
+        sink = TensorSink()
+        pipe = Pipeline().add(src, agg, sink)
+        src.link(agg).link(sink)
+        pipe.start()
+        for k in range(3):
+            src.push([np.full((1, 4), k, np.float32),
+                      np.full((2, 2), 10 + k, np.int32)])
+        src.end_of_stream()
+        pipe.wait(timeout=15)
+        pipe.stop()
+        assert len(sink.buffers) == 1
+        out = sink.buffers[0]
+        assert out.num_tensors == 2
+        assert out[0].shape == (3, 4)
+        assert out[1].shape == (6, 2)
+        np.testing.assert_array_equal(out[0][:, 0], [0, 1, 2])
+
+    def test_tensor_count_change_raises(self):
+        from nnstreamer_tpu.elements.aggregator import TensorAggregator
+        from nnstreamer_tpu.tensors.buffer import TensorBuffer
+        import pytest as _pytest
+
+        agg = TensorAggregator(frames_out=4)
+        agg.chain(agg.sinkpads[0],
+                  TensorBuffer([np.zeros((1, 2)), np.zeros((1, 2))]))
+        with _pytest.raises(Exception, match="tensors"):
+            agg._chain_entry(agg.sinkpads[0],
+                             TensorBuffer([np.zeros((1, 2))]))
+
+
 class TestRate:
     def test_downsample(self):
         pipe = run_pipeline(
@@ -277,6 +318,77 @@ class TestCrop:
         assert out[0].shape == (5, 4, 3)
         assert out[1].shape == (8, 8, 3)
         np.testing.assert_array_equal(out[1], img[0, :8, :8])
+
+    def _crop_pipe(self, **props):
+        from nnstreamer_tpu.pipeline.pipeline import Pipeline
+        from nnstreamer_tpu.elements.source import AppSrc
+        from nnstreamer_tpu.elements.crop import TensorCrop
+        from nnstreamer_tpu.elements.sink import TensorSink
+
+        img_src, info_src = AppSrc(name="img"), AppSrc(name="info")
+        crop, sink = TensorCrop(**props), TensorSink()
+        pipe = Pipeline().add(img_src, info_src, crop, sink)
+        img_src.srcpad.link(crop.raw_pad)
+        info_src.srcpad.link(crop.info_pad)
+        crop.link(sink)
+        return pipe, img_src, info_src, sink
+
+    def test_multi_tensor_frames(self):
+        """every data tensor is cropped per region (tensor_crop.c parity:
+        multi-tensor raw frames are not silently truncated)."""
+        pipe, img_src, info_src, sink = self._crop_pipe()
+        a = np.arange(16 * 16 * 3, dtype=np.uint8).reshape(1, 16, 16, 3)
+        b = np.arange(16 * 16, dtype=np.float32).reshape(16, 16)
+        regions = np.array([[0, 0, 4, 4], [8, 8, 2, 2]], np.int32)
+        pipe.start()
+        img_src.push([a, b], pts=0)
+        info_src.push([regions], pts=0)
+        img_src.end_of_stream()
+        info_src.end_of_stream()
+        pipe.wait(timeout=15)
+        pipe.stop()
+        out = sink.buffers[0]
+        # region-major: r0(a, b), r1(a, b)
+        assert out.num_tensors == 4
+        assert out[0].shape == (4, 4, 3)
+        assert out[1].shape == (4, 4)
+        assert out[2].shape == (2, 2, 3)
+        np.testing.assert_array_equal(out[1], b[:4, :4])
+        np.testing.assert_array_equal(out[3], b[8:10, 8:10])
+        assert out.meta["crop_num_tensors"] == 2
+
+    def test_lateness_drops_old_info(self):
+        """|pts diff| > lateness drops the older buffer and pairs the
+        newer one with the next arrival (tensor_crop.c:734-759)."""
+        pipe, img_src, info_src, sink = self._crop_pipe(lateness=10)
+        img = np.zeros((1, 8, 8, 3), np.uint8)
+        r = np.array([[0, 0, 2, 2]], np.int32)
+        pipe.start()
+        # info frame way older than raw (1s vs 0): dropped, next info pairs
+        info_src.push([r], pts=0)
+        img_src.push([img], pts=1_000_000_000)
+        info_src.push([np.array([[0, 0, 3, 3]], np.int32)],
+                      pts=1_000_000_000)
+        img_src.end_of_stream()
+        info_src.end_of_stream()
+        pipe.wait(timeout=15)
+        pipe.stop()
+        assert len(sink.buffers) == 1
+        assert sink.buffers[0][0].shape == (3, 3, 3)  # the NEWER info won
+
+    def test_lateness_disabled_by_default(self):
+        pipe, img_src, info_src, sink = self._crop_pipe()
+        img = np.zeros((1, 8, 8, 3), np.uint8)
+        pipe.start()
+        info_src.push([np.array([[0, 0, 2, 2]], np.int32)], pts=0)
+        img_src.push([img], pts=5_000_000_000)  # 5s apart: still pairs
+        img_src.end_of_stream()
+        info_src.end_of_stream()
+        pipe.wait(timeout=15)
+        pipe.stop()
+        assert len(sink.buffers) == 1
+        assert sink.buffers[0][0].shape == (2, 2, 3)
+
 
 
 class TestRepoDynamicity:
@@ -439,3 +551,75 @@ class TestQuantEncDec:
         # nearest-rounding: error bounded by scale/2 + 0.5 cast rounding
         assert np.abs(back.astype(int) - x.astype(int)).max() <= \
             int(np.ceil(scale / 2 + 0.5))
+
+
+class TestRateThrottleQos:
+    """tensor_rate throttle=true posts QoS upstream so the *filter* skips
+    invokes for frames that would be dropped (gsttensorrate.c:27-36)."""
+
+    DESC = (
+        "videotestsrc num-buffers=20 width=4 height=4 framerate=1000/1 ! "
+        "tensor_converter ! tensor_transform mode=typecast option=float32 ! "
+        "tensor_filter framework=jax model=qos_id name=f ! "
+        "tensor_rate name=r framerate=2/1 throttle={throttle} ! "
+        "tensor_sink name=out"
+    )
+
+    def setup_method(self):
+        from nnstreamer_tpu.filters.jax_backend import register_jax_model
+
+        register_jax_model("qos_id", lambda x: x * 1.0)
+
+    def teardown_method(self):
+        from nnstreamer_tpu.filters.jax_backend import unregister_jax_model
+
+        unregister_jax_model("qos_id")
+
+    @staticmethod
+    def _invokes():
+        from nnstreamer_tpu.filters.jax_backend import JaxFilter
+
+        return JaxFilter.global_stats().snapshot()["total_invokes"]
+
+    def test_throttled_filter_skips_invokes(self, monkeypatch):
+        monkeypatch.setenv("NNSTPU_FUSE", "0")  # count fw.invoke directly
+        before = self._invokes()
+        run_pipeline(self.DESC.format(throttle="true"))
+        # 20 frames arrive within milliseconds; QoS demands >=500ms between
+        # invokes, so the filter must have run only a handful of times
+        assert self._invokes() - before <= 3
+
+    def test_unthrottled_filter_runs_every_frame(self, monkeypatch):
+        monkeypatch.setenv("NNSTPU_FUSE", "0")
+        before = self._invokes()
+        run_pipeline(self.DESC.format(throttle="false"))
+        assert self._invokes() - before == 20
+
+    def test_qos_throttles_fused_region_too(self):
+        """with fusion on, the filter is spliced into a FusedRegion — the
+        QoS must throttle the region's dispatch instead."""
+        pipe = run_pipeline(self.DESC.format(throttle="true"))
+        outs = len(pipe.get("out").buffers)
+        assert outs <= 3, outs
+
+    def test_fused_region_passes_all_without_throttle(self):
+        pipe = run_pipeline(self.DESC.format(throttle="false"))
+        # rate alone still drops by pts (1000fps -> 2fps over 20ms of
+        # stream time: ~1 frame), but nothing upstream is skipped; the
+        # filter's QoS state stays unset
+        assert getattr(pipe.get("f"), "_qos_interval_s", 0.0) == 0.0
+
+    def test_qos_event_reaches_filter_directly(self):
+        from nnstreamer_tpu import parse_launch
+        from nnstreamer_tpu.pipeline.element import QosEvent
+
+        pipe = parse_launch(
+            "appsrc name=a ! "
+            "tensor_filter framework=jax model=qos_id name=f ! "
+            "tensor_sink name=s")
+        pipe.get("s").sinkpad.push_upstream_event(
+            QosEvent(target_interval_ns=250_000_000))
+        assert pipe.get("f")._qos_interval_s == 0.25
+        # lifting the throttle
+        pipe.get("s").sinkpad.push_upstream_event(QosEvent(0))
+        assert pipe.get("f")._qos_interval_s == 0.0
