@@ -221,15 +221,8 @@ class TensorFilter(Element):
     def chain(self, pad, buf):
         throttle = int(self.get_property("throttle"))
         # min invoke interval: own throttle prop and downstream QoS combine
-        interval = 1.0 / throttle if throttle > 0 else 0.0
-        interval = max(interval, getattr(self, "_qos_interval_s", 0.0))
-        if interval > 0:
-            import time
-
-            now = time.monotonic()
-            if now - self._last_invoke_t < interval:
-                return None  # QoS drop (tensor_filter.c:426)
-            self._last_invoke_t = now
+        if self._qos_throttled(1.0 / throttle if throttle > 0 else 0.0):
+            return None  # QoS drop (tensor_filter.c:426)
         fw = self.fw or self._open_fw()
 
         in_comb = self._combination("input_combination")

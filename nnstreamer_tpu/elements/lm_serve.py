@@ -93,6 +93,7 @@ class TensorLMServe(Element):
         self._state_lock = threading.Lock()
         self._push_lock = threading.Lock()  # serialize downstream pushes
         self._inflight = 0
+        self._stopped = False  # set under _state_lock; _enqueue rejects
         self._idle = threading.Condition(self._state_lock)
 
     def _metrics_stats(self):
@@ -100,6 +101,8 @@ class TensorLMServe(Element):
 
     def start(self):
         super().start()
+        with self._state_lock:
+            self._stopped = False
         from nnstreamer_tpu.serving import get_engine
 
         name = self.get_property("engine")
@@ -127,6 +130,9 @@ class TensorLMServe(Element):
     def stop(self):
         self._cancel_all_inflight()
         with self._state_lock:
+            # chain() racing stop() must not recreate fifos/drainers after
+            # this point — _enqueue pushes an error response instead
+            self._stopped = True
             fifos = list(self._fifos.values())
             self._fifos.clear()
             drainers = list(self._drainers.values())
@@ -161,16 +167,29 @@ class TensorLMServe(Element):
 
     def _enqueue(self, cid: int, item) -> None:
         with self._state_lock:
-            fifo = self._fifos.get(cid)
-            if fifo is None:
-                fifo = self._fifos[cid] = _queue.Queue()
-                t = threading.Thread(target=self._drain, args=(cid, fifo),
-                                     name=f"{self.name}-c{cid}",
-                                     daemon=True)
-                self._drainers[cid] = t
-                t.start()
-            self._inflight += 1
-            fifo.put(item)
+            if self._stopped:
+                rejected = item
+            else:
+                rejected = None
+                fifo = self._fifos.get(cid)
+                if fifo is None:
+                    fifo = self._fifos[cid] = _queue.Queue()
+                    t = threading.Thread(target=self._drain,
+                                         args=(cid, fifo),
+                                         name=f"{self.name}-c{cid}",
+                                         daemon=True)
+                    self._drainers[cid] = t
+                    t.start()
+                self._inflight += 1
+                fifo.put(item)
+        if rejected is not None:
+            # element stopped between chain() and here: the client still
+            # gets its error response, and no drainer is recreated
+            stream, buf, _err, _t0 = rejected
+            if stream is not None:
+                stream.cancel()
+            self._push_response(
+                self._error_response(buf, "server stopped"))
 
     def _error_response(self, buf, reason: str):
         return buf.with_tensors(

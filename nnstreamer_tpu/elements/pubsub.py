@@ -28,6 +28,7 @@ disagree.
 from __future__ import annotations
 
 import queue as _queue
+import struct as _struct
 import time
 from typing import List, Optional, Tuple
 
@@ -260,7 +261,7 @@ class TensorPubSubSrc(SourceElement, _PubSubBase):
                 continue
             try:
                 buf, sender_base, pts = self._decode(body)
-            except (ValueError, KeyError) as e:
+            except (ValueError, KeyError, _struct.error) as e:
                 # foreign/malformed message on a shared topic: log and keep
                 # streaming (the reference mqttsrc does not die either)
                 self.log.warning("dropping undecodable message (%s)", e)

@@ -48,10 +48,13 @@ class TensorRate(Element):
         if bool(self.get_property("throttle")) and out is not None \
                 and out.num > 0:
             interval = out.frame_duration_ns or 0
-        if interval != self._posted_interval:
-            self._posted_interval = interval
+        # initial None counts as 0: a rate with no throttle to announce
+        # must stay silent, not post a lift that cancels an upstream
+        # rate's throttle mid-negotiation
+        if interval != (self._posted_interval or 0):
             self.sinkpads[0].push_upstream_event(
                 QosEvent(target_interval_ns=interval))
+        self._posted_interval = interval
 
     def property_changed(self, key):
         if key == "silent_drop":  # deprecated alias, kept for old strings

@@ -354,6 +354,24 @@ class Element:
         for sp in self.sinkpads:
             sp.push_upstream_event(event)
 
+    def _qos_throttled(self, min_interval_s: float = 0.0) -> bool:
+        """Shared invoke drop check (tensor_filter.c:426): True when this
+        invoke must be skipped to honor the larger of the element's own
+        minimum interval and the downstream QoS interval adopted in
+        ``src_event`` (``_qos_interval_s``). Updates the invoke clock when
+        the invoke is allowed."""
+        interval = max(min_interval_s,
+                       getattr(self, "_qos_interval_s", 0.0))
+        if interval <= 0:
+            return False
+        import time
+
+        now = time.monotonic()
+        if now - getattr(self, "_last_invoke_t", 0.0) < interval:
+            return True
+        self._last_invoke_t = now
+        return False
+
     def sink_event(self, pad: Pad, event: Event) -> None:
         """Handle a downstream-flowing event. Default: CAPS → negotiate via
         :meth:`transform_caps`; EOS/custom → forward when all sink pads agree.

@@ -194,14 +194,8 @@ class FusedRegion(Element):
     def chain(self, pad, buf):
         if pad is self.internal_pad:
             raise FlowError(f"{self.name}: buffer on internal event pad")
-        qos = getattr(self, "_qos_interval_s", 0.0)
-        if qos > 0:
-            import time
-
-            now = time.monotonic()
-            if now - getattr(self, "_last_invoke_t", 0.0) < qos:
-                return None  # downstream-rate QoS drop (tensor_filter.c:426)
-            self._last_invoke_t = now
+        if self._qos_throttled():
+            return None  # downstream-rate QoS drop (tensor_filter.c:426)
         compiled = self._compiled
         if compiled is None:
             try:

@@ -114,6 +114,78 @@ class GenerationStream:
         self._q.put(self._DONE)
 
 
+class _PrefixTrie:
+    """Token trie over the prefix-cache keys: longest-common-prefix lookup
+    in O(prompt_len), independent of entry count (the linear scan it
+    replaces was O(entries × prompt_len) per admission).
+
+    Each node counts the entries in its subtree and keeps a representative
+    one (``rep``), so a lookup never descends below the walk: every entry
+    in the deepest walkable node's subtree shares exactly the walked
+    tokens with the prompt, i.e. all tie at the maximal LCP.
+    """
+
+    __slots__ = ("root",)
+
+    @staticmethod
+    def _node():
+        return {"kids": {}, "entry": None, "count": 0, "rep": None}
+
+    def __init__(self):
+        self.root = self._node()
+
+    def insert(self, key: tuple) -> None:
+        node = self.root
+        node["count"] += 1
+        node["rep"] = key
+        for tok in key:
+            node = node["kids"].setdefault(tok, self._node())
+            node["count"] += 1
+            node["rep"] = key
+        node["entry"] = key
+
+    def remove(self, key: tuple) -> None:
+        path = [self.root]
+        node = self.root
+        for tok in key:
+            node = node["kids"][tok]
+            path.append(node)
+        node["entry"] = None
+        for n in path:
+            n["count"] -= 1
+        # prune empty nodes; repair representatives that pointed at key
+        for i in range(len(path) - 1, 0, -1):
+            parent, child = path[i - 1], path[i]
+            if child["count"] == 0:
+                del parent["kids"][key[i - 1]]
+        for n in path:
+            if n["count"] > 0 and n["rep"] == key:
+                n["rep"] = self._any_entry(n)
+
+    @staticmethod
+    def _any_entry(node):
+        while node["entry"] is None:
+            node = next(k for k in node["kids"].values() if k["count"] > 0)
+        return node["entry"]
+
+    def lookup(self, prompt) -> tuple:
+        """→ (best_key, lcp): a cached key maximizing LCP with ``prompt``
+        (an exact whole-prompt entry preferred), or (None, 0)."""
+        node = self.root
+        d = 0
+        for tok in prompt:
+            child = node["kids"].get(int(tok))
+            if child is None:
+                break
+            node = child
+            d += 1
+        if d == 0 or node["count"] == 0:
+            return None, 0
+        if d == len(prompt) and node["entry"] is not None:
+            return node["entry"], d  # exact match carries reusable logits
+        return node["rep"], d
+
+
 class _PendingRequest:
     def __init__(self, prompt: np.ndarray, max_new: int,
                  stream: GenerationStream):
@@ -283,8 +355,10 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"serving: prefix_cache must be >= 0, got {prefix_cache}")
         #: tuple(prompt ids) → (kv pytree [L,2,1,n,...], logits[1,V]) —
-        #: LRU, engine-thread only
+        #: LRU, engine-thread only; the trie mirrors the key set for
+        #: O(prompt_len) longest-common-prefix admission lookups
         self._prefix: "collections.OrderedDict" = collections.OrderedDict()
+        self._prefix_trie = _PrefixTrie()
         from nnstreamer_tpu.utils.stats import InvokeStats
 
         #: reference-style windowed read-outs (latency_us = one [B,K]
@@ -341,11 +415,21 @@ class ContinuousBatchingEngine:
 
     # -- public API -----------------------------------------------------------
     def start(self) -> "ContinuousBatchingEngine":
-        if self._thread is None:
-            self._stop_evt.clear()
-            self._thread = threading.Thread(target=self._loop,
-                                            name="cb-engine", daemon=True)
-            self._thread.start()
+        if self._thread is not None and not self._thread.is_alive():
+            # leftover from a timed-out stop() whose loop has since
+            # exited: reap it so restart works instead of silently no-op
+            self._thread.join(timeout=0)
+            self._thread = None
+        if self._thread is not None:
+            if self._stop_evt.is_set():
+                raise RuntimeError(
+                    "serving: previous engine loop is still shutting "
+                    "down; retry start() after it exits")
+            return self  # already running
+        self._stop_evt.clear()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="cb-engine", daemon=True)
+        self._thread.start()
         return self
 
     def stop(self):
@@ -440,21 +524,8 @@ class ContinuousBatchingEngine:
         (two different user prompts sharing a system preamble still
         reuse the shared part); returns (p, kv sliced to p, logits) —
         logits only when the whole prompt equals a whole stored key."""
-        best_key, best_lcp = None, 0
-        for key in self._prefix:
-            karr = np.asarray(key, np.int32)
-            m = min(karr.size, prompt.size)
-            neq = np.nonzero(karr[:m] != prompt[:m])[0]
-            lcp = int(neq[0]) if neq.size else m
-            # strict > keeps the first-found on ties EXCEPT an exact
-            # whole-prompt match, which always wins — it alone carries
-            # reusable logits (the zero-prefill repeat path)
-            exact = lcp == prompt.size == len(key)
-            if lcp > best_lcp or (exact and lcp >= best_lcp):
-                best_key, best_lcp = key, lcp
-                if exact:
-                    break
-        if best_key is None:
+        best_key, best_lcp = self._prefix_trie.lookup(prompt)
+        if best_key is None or best_lcp <= 0:
             return 0, None, None
         self._prefix.move_to_end(best_key)
         kv, logits = self._prefix[best_key]
@@ -478,10 +549,13 @@ class ContinuousBatchingEngine:
         # slice slot-S down to the prompt's n positions (axis 3 = S in
         # every cache leaf, values and int8 scales alike)
         kv = self._jax.tree.map(lambda a: a[:, :, :, :n], cache1)
+        if key not in self._prefix:
+            self._prefix_trie.insert(key)
         self._prefix[key] = (kv, logits)
         self._prefix.move_to_end(key)
         while len(self._prefix) > self.prefix_cache:
-            self._prefix.popitem(last=False)
+            evicted, _ = self._prefix.popitem(last=False)
+            self._prefix_trie.remove(evicted)
 
     def _place_prefix_kv(self, cache1, kv):
         """Write a cached kv slice into slots [0, n) of a fresh cache."""
@@ -660,31 +734,37 @@ class ContinuousBatchingEngine:
                 self._advance_partial()
                 progressed = True
             # admission: fill free slots from the pending queue
+            queue_dry = False
             for slot in range(self.B):
-                if self._slots[slot] is not None \
+                if queue_dry or self._slots[slot] is not None \
                         or self._partial is not None:
                     continue
-                try:
-                    req = self._pending.get_nowait()
-                except _queue.Empty:
-                    break
-                if req.stream.cancelled:
-                    req.stream._finish("cancelled")
-                    continue
-                try:
-                    if self.prefill_chunk is not None:
-                        self._begin_partial(req, slot)
-                    else:
-                        self._admit(req, slot)
-                    progressed = True
-                except Exception as e:  # noqa: BLE001 — a bad request
-                    # (or a prefill/cache-alloc failure) must not kill
-                    # the engine loop
-                    log.warning("serving: admit failed: %s", e)
-                    if self._slots[slot] is self._RESERVED:
-                        self._slots[slot] = None
-                    self._partial = None
-                    req.stream._finish(f"error: {e}")
+                # retry THIS slot past cancelled/failed queue heads — a
+                # cancelled request must not cost a slot its admission
+                while True:
+                    try:
+                        req = self._pending.get_nowait()
+                    except _queue.Empty:
+                        queue_dry = True
+                        break
+                    if req.stream.cancelled:
+                        req.stream._finish("cancelled")
+                        continue
+                    try:
+                        if self.prefill_chunk is not None:
+                            self._begin_partial(req, slot)
+                        else:
+                            self._admit(req, slot)
+                        progressed = True
+                        break  # slot filled
+                    except Exception as e:  # noqa: BLE001 — a bad request
+                        # (or a prefill/cache-alloc failure) must not kill
+                        # the engine loop
+                        log.warning("serving: admit failed: %s", e)
+                        if self._slots[slot] is self._RESERVED:
+                            self._slots[slot] = None
+                        self._partial = None
+                        req.stream._finish(f"error: {e}")
             if self.active_streams == 0:
                 if not progressed:
                     self._wake.wait(timeout=0.05)

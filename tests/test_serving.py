@@ -582,3 +582,108 @@ def test_submit_before_start_rejected():
     eng = ContinuousBatchingEngine(CFG, PARAMS, max_streams=1)
     with pytest.raises(RuntimeError):
         eng.submit([1, 2], max_new_tokens=4)
+
+
+class TestPrefixTrie:
+    """O(prompt_len) LCP index replacing the linear scan
+    (serving/engine.py _PrefixTrie)."""
+
+    @staticmethod
+    def _brute(keys, prompt):
+        best_key, best_lcp = None, 0
+        for key in keys:
+            m = min(len(key), len(prompt))
+            lcp = 0
+            while lcp < m and key[lcp] == prompt[lcp]:
+                lcp += 1
+            exact = lcp == len(prompt) == len(key)
+            if lcp > best_lcp or (exact and lcp >= best_lcp):
+                best_key, best_lcp = key, lcp
+        return best_lcp
+
+    def test_matches_brute_force_with_eviction(self):
+        import random
+
+        from nnstreamer_tpu.serving.engine import _PrefixTrie
+
+        rng = random.Random(7)
+        trie, keys = _PrefixTrie(), []
+        for step in range(400):
+            if keys and rng.random() < 0.3:
+                k = keys.pop(rng.randrange(len(keys)))
+                trie.remove(k)
+                continue
+            k = tuple(rng.randrange(4) for _ in range(rng.randrange(1, 10)))
+            if k not in keys:
+                keys.append(k)
+                trie.insert(k)
+            prompt = [rng.randrange(4) for _ in range(rng.randrange(1, 12))]
+            got_key, got_lcp = trie.lookup(prompt)
+            want_lcp = self._brute(keys, prompt)
+            assert got_lcp == want_lcp
+            if got_lcp:
+                # returned key really shares got_lcp tokens with prompt
+                assert tuple(got_key[:got_lcp]) == tuple(prompt[:got_lcp])
+
+    def test_exact_match_preferred(self):
+        from nnstreamer_tpu.serving.engine import _PrefixTrie
+
+        trie = _PrefixTrie()
+        trie.insert((1, 2, 3, 4, 5))  # longer key covering the prompt
+        trie.insert((1, 2, 3))        # exact
+        key, lcp = trie.lookup([1, 2, 3])
+        assert key == (1, 2, 3) and lcp == 3
+
+    def test_lookup_cost_is_prompt_bound(self):
+        """visits are bounded by prompt length, not entry count."""
+        from nnstreamer_tpu.serving.engine import _PrefixTrie
+
+        trie = _PrefixTrie()
+        for i in range(512):  # disjoint first tokens: a wide, shallow trie
+            trie.insert((1000 + i, 1, 2, 3))
+        calls = 0
+        orig_get = dict.get
+
+        class CountingDict(dict):
+            def get(self, *a):
+                nonlocal calls
+                calls += 1
+                return orig_get(self, *a)
+
+        # wrap every kids dict
+        def wrap(node):
+            node["kids"] = CountingDict(node["kids"])
+            for k in node["kids"].values():
+                wrap(k)
+
+        wrap(trie.root)
+        trie.lookup([1000, 1, 2, 3, 9, 9, 9, 9])
+        assert calls <= 8 + 1  # one child probe per prompt token
+
+
+class TestEngineRestartAfterStuckStop:
+    def test_start_reaps_dead_leftover_thread(self):
+        """ADVICE r2: a timed-out stop() retains _thread; once that loop
+        exits, start() must reap it and spin a fresh loop (not no-op)."""
+        eng = ContinuousBatchingEngine(
+            CFG, PARAMS, max_streams=2, steps_per_dispatch=2,
+            temperature=0.0).start()
+        try:
+            assert eng.generate([4, 8], max_new_tokens=2, timeout=120)
+            eng.stop()
+            # simulate the timed-out-stop leftover: thread ref retained
+            # though the loop has exited
+            dead = eng._thread if eng._thread is not None else None
+            if dead is None:
+                import threading
+
+                dead = threading.Thread(target=lambda: None)
+                dead.start()
+                dead.join()
+                eng._thread = dead
+                eng._stop_evt.set()
+            eng.start()  # must reap and restart, not silently no-op
+            assert eng._thread is not None and eng._thread.is_alive()
+            assert eng.generate([4, 8], max_new_tokens=2, timeout=120)
+        finally:
+            eng.stop()

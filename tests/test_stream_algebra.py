@@ -623,3 +623,16 @@ class TestRateThrottleQos:
         # lifting the throttle
         pipe.get("s").sinkpad.push_upstream_event(QosEvent(0))
         assert pipe.get("f")._qos_interval_s == 0.0
+
+    def test_downstream_plain_rate_does_not_cancel_throttle(self, monkeypatch):
+        """a second tensor_rate with NO framerate must stay silent at caps
+        time, not post QosEvent(0) that cancels the upstream throttle."""
+        monkeypatch.setenv("NNSTPU_FUSE", "0")
+        pipe = run_pipeline(
+            "videotestsrc num-buffers=2 width=4 height=4 "
+            "framerate=1000/1 ! tensor_converter ! "
+            "tensor_transform mode=typecast option=float32 ! "
+            "tensor_filter framework=jax model=qos_id name=f ! "
+            "tensor_rate framerate=2/1 throttle=true ! "
+            "tensor_rate ! tensor_sink name=out")
+        assert pipe.get("f")._qos_interval_s == 0.5
