@@ -24,10 +24,11 @@ import time
 
 import numpy as np
 
-#: 400 frames ≈ six deep-prefetch flush cycles in steady state — enough to
-#: average the tunnel's bursty flush cadence; 200 left only ~3 cycles and
-#: quantization noise dominated run-to-run spread
-N_FRAMES = int(os.environ.get("BENCH_FRAMES", "400"))
+#: 800 frames (100 batch-8 buffers) — long enough that the fixed per-run
+#: costs (first grouped flush, trailing drain RTT) amortize below ~3% of
+#: the span; shorter runs let single ~100 ms tunnel round trips dominate
+#: run-to-run spread
+N_FRAMES = int(os.environ.get("BENCH_FRAMES", "800"))
 WARMUP = int(os.environ.get("BENCH_WARMUP", "10"))
 #: tunnel throughput varies heavily run-to-run; the flagship reports the
 #: median of this many runs (first run also pays the compile) — on bad
@@ -41,10 +42,17 @@ IMAGE = 224
 FALLBACK_BASELINE_FPS = 40.0
 
 
-def build_pipeline(batch: int = 1):
+#: flagship micro-batch: the aggregator packs this many frames into one
+#: MXU dispatch. On a tunneled chip the per-dispatch RPC (~11 ms measured
+#: on a bad day) is the throughput floor for batch=1 — amortizing it over
+#: 8 frames is what makes the number tunnel-insensitive (the BASELINE.json
+#: north-star's own mux/merge-batching prescription, applied in-stream).
+BATCH = int(os.environ.get("BENCH_BATCH", "8"))
+
+
+def _register_mnv2(batch: int) -> str:
     import jax.numpy as jnp
 
-    from nnstreamer_tpu import parse_launch
     from nnstreamer_tpu.filters.jax_backend import (
         is_jax_model_registered,
         register_jax_model,
@@ -59,31 +67,86 @@ def build_pipeline(batch: int = 1):
         )
         register_jax_model(model_name, apply_fn, params,
                            in_info=in_info, out_info=out_info)
+    return model_name
+
+
+def build_pipeline(batch: int = BATCH):
+    from nnstreamer_tpu import parse_launch
+
+    model_name = _register_mnv2(batch)
+    # a partial trailing window never leaves the aggregator: round the
+    # frame count to a batch multiple so the configured workload is what
+    # actually gets measured
+    n_frames = ((N_FRAMES + batch - 1) // batch) * batch
+    # micro-batch stage BEFORE the transform: frames cross the tunnel as
+    # uint8 (4x fewer bytes than float32 — the tunnel's effective
+    # bandwidth, not compute, is the bad-day ceiling) and the typecast/
+    # normalize runs on-device inside the fused region with the model
+    agg = (f"tensor_aggregator frames-in=1 frames-out={batch} "
+           f"frames-flush={batch} frames-dim=3 concat=true ! "
+           if batch > 1 else "")
     # queue after the converter decouples host frame synthesis from device
     # dispatch (source thread fills frame N+1 while the fused region runs N)
     pipe = parse_launch(
-        f"videotestsrc num-buffers={N_FRAMES} width={IMAGE} height={IMAGE} "
-        "pattern=gradient ! tensor_converter ! queue max-size-buffers=8 ! "
+        f"videotestsrc num-buffers={n_frames} width={IMAGE} height={IMAGE} "
+        "pattern=gradient ! tensor_converter ! queue max-size-buffers=16 ! "
+        f"{agg}"
         "tensor_transform mode=arithmetic "
         "option=typecast:float32,add:-127.5,div:127.5 ! "
         f"tensor_filter framework=jax model={model_name} name=filter ! "
         "tensor_decoder mode=image_labeling ! "
-        # a device→host flush costs ~100 ms on a tunneled chip regardless of
-        # size; sustained fps ≈ frames-covered-per-flush / flush-time, so a
-        # deeper prefetch window directly raises throughput (A/B-measured
-        # ~2x median vs depth 32) at the cost of burst latency
-        "queue max-size-buffers=64 prefetch-host=true ! "
+        # a device→host flush costs ~100 ms on a tunneled chip regardless
+        # of size; materialize-host drains in GROUPS (one overlapped
+        # flush covers the whole backlog, pipeline/pipeline.py _drain)
+        "queue max-size-buffers=64 materialize-host=true ! "
         "tensor_sink name=sink to-host=true"
     )
     return pipe
 
 
-def measure_pipeline() -> dict:
-    pipe = build_pipeline()
+def device_probe(batch: int = BATCH, iters: int = 30) -> dict:
+    """Separate the chip from the weather: time the flagship model as pure
+    device dispatches (one end sync) and as blocking round trips. The gap
+    between ``pipeline fps`` and ``device_fps_ceiling`` is framework
+    overhead; the gap between dispatch and roundtrip is the tunnel."""
+    import jax
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu.models.mobilenet_v2 import mobilenet_v2
+
+    apply_fn, params, _, _ = mobilenet_v2(image_size=IMAGE, batch=batch,
+                                          dtype=jnp.bfloat16)
+    jf = jax.jit(apply_fn)
+    params = jax.device_put(params)
+    x = jax.device_put(jnp.zeros((batch, IMAGE, IMAGE, 3), jnp.float32))
+    np.asarray(jf(params, x))  # compile + warm
+    t0 = time.perf_counter()
+    outs = [jf(params, x) for _ in range(iters)]
+    np.asarray(outs[-1])
+    dispatch_ms = (time.perf_counter() - t0) / iters * 1e3
+    t0 = time.perf_counter()
+    for _ in range(3):
+        np.asarray(jf(params, x))
+    roundtrip_ms = (time.perf_counter() - t0) / 3 * 1e3
+    return dict(
+        device_dispatch_ms_per_batch=round(dispatch_ms, 3),
+        device_compute_ms_per_frame=round(dispatch_ms / batch, 4),
+        device_roundtrip_ms=round(roundtrip_ms, 2),
+        device_fps_ceiling=round(batch * 1e3 / dispatch_ms, 1),
+    )
+
+
+def measure_pipeline(batch: int = BATCH) -> dict:
+    pipe = build_pipeline(batch)
     frame_t = _collect(pipe)
-    steady = frame_t[WARMUP:]
+    warmup_arrivals = max(1, WARMUP // batch) if batch > 1 else WARMUP
+    steady = frame_t[warmup_arrivals:]
     if len(steady) >= 2:
         deltas = np.diff(steady)
+        # inter-ARRIVAL of sink buffers (one buffer = `batch` frames);
+        # honest name — a frame's true end-to-end latency under
+        # micro-batching includes waiting for its batch window, which
+        # this does NOT measure
         p50_ms = float(np.percentile(deltas, 50)) * 1e3
         p90_ms = float(np.percentile(deltas, 90)) * 1e3
     elif len(frame_t) >= 2:
@@ -92,32 +155,51 @@ def measure_pipeline() -> dict:
     else:
         p50_ms = p90_ms = 0.0
     filt = pipe.get("filter")
-    return dict(fps=_steady_fps(frame_t), p50_ms=p50_ms, p90_ms=p90_ms,
+    return dict(fps=_steady_fps(frame_t, frames_per_buffer=batch,
+                                warmup_arrivals=warmup_arrivals),
+                p50_ms=p50_ms, p90_ms=p90_ms,
                 invoke_latency_us=filt.get_property("latency"),
-                frames=len(frame_t))
+                frames=len(frame_t) * batch)
 
 
-def _steady_fps(frame_t, frames_per_buffer: int = 1):
-    """Sustained fps = frames/span over the post-warmup window — NOT median
-    inter-arrival, which overstates rate when arrivals are bursty (device→
-    host syncs batch up frames). Falls back to the whole run when too few
-    frames survive warmup (e.g. tiny BENCH_FRAMES)."""
-    steady = frame_t[WARMUP:]
-    if len(steady) < 2:
-        steady = frame_t
-    if len(steady) < 2:
+def _steady_fps(frame_t, frames_per_buffer: int = 1,
+                warmup_arrivals: int = None):
+    """Sustained fps = post-warmup frames / (first steady arrival → EOS).
+
+    Anchoring the window end at EOS (recorded by :func:`_collect`) rather
+    than the last arrival keeps the estimate honest under bursty
+    arrivals: grouped D2H flushes can deliver a whole backlog within
+    milliseconds, and frames/(last−first arrival) would then exclude the
+    very processing time being measured. ``warmup_arrivals`` is in
+    ARRIVAL units (buffers, not frames) so batched and single-frame
+    pipelines discard the same share of the run."""
+    del warmup_arrivals  # the first arrival IS the warmup anchor
+    eos_t = getattr(frame_t, "eos_t", None)
+    if len(frame_t) < 2:
         print("bench: too few frames for a rate estimate", file=sys.stderr)
         return 0.0
-    span = steady[-1] - steady[0]
-    return (len(steady) - 1) * frames_per_buffer / span
+    # anchor at the FIRST arrival (the post-compile instant) and EOS:
+    # these bracket all remaining work, so a grouped flush delivering the
+    # whole backlog in one burst cannot shrink the measured span
+    span = (eos_t if eos_t is not None else frame_t[-1]) - frame_t[0]
+    if span <= 0:
+        return 0.0
+    return (len(frame_t) - 1) * frames_per_buffer / span
+
+
+class _Arrivals(list):
+    """Arrival timestamps + the EOS instant (set by _collect)."""
+
+    eos_t = None
 
 
 def _collect(pipe, sink_name="sink", timeout=600):
-    frame_t = []
+    frame_t = _Arrivals()
     pipe.get(sink_name).connect(lambda b: frame_t.append(time.monotonic()))
     msg = pipe.run(timeout=timeout)
     if msg is None or msg.kind != "eos":
         raise RuntimeError(f"bench pipeline failed: {msg}")
+    frame_t.eos_t = time.monotonic()
     return frame_t
 
 
@@ -548,18 +630,33 @@ def main():
     # lower-middle run: the median for odd REPEATS, the conservative
     # middle (never the best run) for even
     stats = runs[(len(runs) - 1) // 2]
-    stats["fps_runs"] = [round(r["fps"], 2) for r in runs]
+    fps_runs = [round(r["fps"], 2) for r in runs]
+    spread = ((fps_runs[-1] - fps_runs[0]) / stats["fps"]
+              if stats["fps"] else 0.0)
+    probe = device_probe()
+    # the r01/r02-comparable single-frame pipeline rides along as a
+    # secondary (median of 3): it shows the per-dispatch tunnel floor the
+    # micro-batched flagship amortizes away
+    single = sorted(measure_pipeline(batch=1)["fps"] for _ in range(3))[1]
     baseline = measure_tflite_baseline() or FALLBACK_BASELINE_FPS
     result = {
         "metric": "mobilenetv2_224_pipeline_fps",
         "value": round(stats["fps"], 2),
         "unit": "fps",
         "vs_baseline": round(stats["fps"] / baseline, 3),
+        "batch": BATCH,
         "p50_interarrival_ms": round(stats["p50_ms"], 3),
         "p90_interarrival_ms": round(stats["p90_ms"], 3),
+        "amortized_ms_per_frame": round(stats["p50_ms"] / BATCH, 3),
         "invoke_latency_us": stats["invoke_latency_us"],
         "frames": stats["frames"],
-        "fps_runs": stats["fps_runs"],
+        "fps_runs": fps_runs,
+        "spread": round(spread, 3),
+        "single_frame_fps": round(single, 2),
+        **probe,
+        "pipeline_efficiency": round(
+            stats["fps"] / probe["device_fps_ceiling"], 3)
+        if probe["device_fps_ceiling"] else None,
         "baseline_fps": baseline,
         "platform": _platform(),
     }

@@ -36,34 +36,59 @@ class ImageLabeling:
         return Caps("text/x-raw", {"format": "utf8"})
 
     def decode(self, buf: TensorBuffer, config, options) -> TensorBuffer:
-        scores = np.asarray(buf[0]).reshape(-1)
-        idx = int(np.argmax(scores))
-        return self._emit(buf, idx, float(scores[idx]), options)
+        scores = np.asarray(buf[0])
+        if scores.ndim >= 2 and scores.shape[0] > 1:
+            # micro-batched stream ([B, classes], e.g. from an upstream
+            # tensor_aggregator): one label per row
+            flat = scores.reshape(scores.shape[0], -1)
+            idxs = np.argmax(flat, axis=-1)
+            tops = flat[np.arange(flat.shape[0]), idxs]
+            return self._emit(buf, idxs.tolist(), tops.tolist(), options)
+        flat = scores.reshape(-1)
+        idx = int(np.argmax(flat))
+        return self._emit(buf, idx, float(flat[idx]), options)
 
-    def _emit(self, buf, idx: int, score: float, options) -> TensorBuffer:
+    def _emit(self, buf, idx, score, options) -> TensorBuffer:
         labels = self._get_labels(options)
-        text = labels[idx] if labels and idx < len(labels) else str(idx)
+
+        def name(i):
+            return labels[i] if labels and i < len(labels) else str(i)
+
+        if isinstance(idx, list):
+            texts = [name(int(i)) for i in idx]
+            out = np.frombuffer("\n".join(texts).encode("utf-8"), np.uint8)
+            return buf.with_tensors([out]).replace(
+                meta={**buf.meta, "label_index": [int(i) for i in idx],
+                      "label": texts, "score": [float(s) for s in score]}
+            )
+        text = name(int(idx))
         out = np.frombuffer(text.encode("utf-8"), np.uint8)
         return buf.with_tensors([out]).replace(
-            meta={**buf.meta, "label_index": idx, "label": text,
-                  "score": score}
+            meta={**buf.meta, "label_index": int(idx), "label": text,
+                  "score": float(score)}
         )
 
     # -- fused-region split (elements/decoder.py device_stage) ---------------
     def device_kernel(self, options):
         """Device half: argmax + top score stay in the XLA program, so only
-        two scalars ever cross the tunnel instead of the full score tensor."""
+        per-frame scalars ever cross the tunnel instead of the full score
+        tensor (one pair per batch row on micro-batched streams)."""
         import jax.numpy as jnp
 
         def fn(consts, tensors):
-            scores = tensors[0].reshape(-1)
-            return [jnp.argmax(scores).astype(jnp.int32),
-                    jnp.max(scores).astype(jnp.float32)]
+            s = tensors[0]
+            rows = s.reshape(s.shape[0], -1) if s.ndim >= 2 else \
+                s.reshape(1, -1)
+            return [jnp.argmax(rows, axis=-1).astype(jnp.int32),
+                    jnp.max(rows, axis=-1).astype(jnp.float32)]
 
         return None, fn
 
     def host_finalize(self, host_buf: TensorBuffer, config, options
                       ) -> TensorBuffer:
-        idx = int(host_buf[0])
-        score = float(host_buf[1])
-        return self._emit(host_buf, idx, score, options)
+        idxs = np.asarray(host_buf[0]).reshape(-1)
+        scores = np.asarray(host_buf[1]).reshape(-1)
+        if idxs.size > 1:
+            return self._emit(host_buf, idxs.tolist(), scores.tolist(),
+                              options)
+        return self._emit(host_buf, int(idxs[0]), float(scores[0]), options)

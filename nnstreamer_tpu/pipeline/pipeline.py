@@ -113,7 +113,13 @@ class Queue(Element):
     ELEMENT_NAME = "queue"
     HANDLES_DEFERRED = True  # pure hand-off: finalize stays lazy across it
     PROPERTIES = {**Element.PROPERTIES, "max_size_buffers": 16, "leaky": "no",
-                  "prefetch_host": False, "prefetch_device": False}
+                  "prefetch_host": False, "prefetch_device": False,
+                  # materialize_host: drain in groups and hand HOST buffers
+                  # downstream (one overlapped D2H flush per backlog; the
+                  # deferred finalize is applied here). For sink-bound
+                  # queues feeding to-host consumers; unlike prefetch_host
+                  # it changes the payload type, so it is its own opt-in.
+                  "materialize_host": False}
 
     _EOS = object()
 
@@ -148,7 +154,9 @@ class Queue(Element):
         super().stop()
 
     def chain(self, pad, buf):
-        if self.get_property("prefetch_host"):
+        if self.get_property("prefetch_host") and \
+                not self.get_property("materialize_host"):
+            # (materialize_host issues the copies drain-side, grouped)
             # start D2H for device tensors NOW (producer side) so a
             # downstream to_host consumer finds the copy already in flight
             # instead of serializing one device round trip per frame
@@ -200,27 +208,58 @@ class Queue(Element):
             self._q.put(event)
 
     def _drain(self):
+        group_host = bool(self.get_property("materialize_host"))
         while not self._stop_evt.is_set():
             try:
                 item = self._q.get(timeout=0.1)
             except _queue.Empty:
                 continue
-            if item is self._EOS:
-                self.srcpad.push_event(EosEvent())
-                self._eos_done.set()
-                return
-            try:
-                if isinstance(item, Event):
-                    self.srcpad.push_event(item)
-                else:
-                    self.srcpad.push(item)
-            except Exception as e:  # noqa: BLE001 — downstream negotiation
-                # or chain failures must reach the bus, not silently kill
-                # this worker thread
-                self.post_error(e if isinstance(e, FlowError)
-                                else FlowError(f"{self.name}: {e}"))
-                self._eos_done.set()  # unblock a waiting EOS pusher
-                return
+            batch = [item]
+            if group_host and not isinstance(item, Event) and \
+                    item is not self._EOS:
+                # gather whatever is ALREADY queued (never wait): one
+                # grouped flush materializes the whole backlog. On a
+                # tunneled chip a blocking fetch costs a full RTT (~100 ms)
+                # no matter the size, but transfers started from this
+                # thread right before the block all ride the same round —
+                # A/B-measured 6x per-buffer (94 ms → 16 ms) at depth 10.
+                while len(batch) < 64:
+                    try:
+                        nxt = self._q.get_nowait()
+                    except _queue.Empty:
+                        break
+                    batch.append(nxt)
+                    if nxt is self._EOS or isinstance(nxt, Event):
+                        break  # events stay serialized with the data flow
+                for it in batch:
+                    if isinstance(it, Event) or it is self._EOS:
+                        continue
+                    for t in it.tensors:
+                        start_async = getattr(t, "copy_to_host_async", None)
+                        if start_async is not None:
+                            start_async()
+            for i, it in enumerate(batch):
+                if it is self._EOS:
+                    self.srcpad.push_event(EosEvent())
+                    self._eos_done.set()
+                    return
+                try:
+                    if isinstance(it, Event):
+                        self.srcpad.push_event(it)
+                    elif group_host:
+                        # materialize HERE, where the group's copies were
+                        # just issued — handing device arrays onward would
+                        # re-serialize the fetches at the sink
+                        self.srcpad.push(it.to_host())
+                    else:
+                        self.srcpad.push(it)
+                except Exception as e:  # noqa: BLE001 — downstream
+                    # negotiation or chain failures must reach the bus,
+                    # not silently kill this worker thread
+                    self.post_error(e if isinstance(e, FlowError)
+                                    else FlowError(f"{self.name}: {e}"))
+                    self._eos_done.set()  # unblock a waiting EOS pusher
+                    return
 
 
 class Pipeline:

@@ -179,3 +179,83 @@ def test_queue_prefetch_device_hands_off_device_arrays():
     assert isinstance(seen[0][0], jax.Array)
     np.testing.assert_array_equal(np.asarray(seen[0][0]),
                                   np.arange(6, dtype=np.float32))
+
+
+class TestQueueGroupedDrain:
+    """materialize-host queues drain in groups (one overlapped D2H flush
+    per backlog) — ordering and event serialization must survive
+    grouping."""
+
+    def test_order_preserved_under_backlog(self):
+        import threading
+        import time as _t
+
+        from nnstreamer_tpu import parse_launch
+
+        pipe = parse_launch(
+            "appsrc name=a block=true ! "
+            "queue max-size-buffers=64 materialize-host=true ! "
+            "tensor_sink name=s to-host=true")
+        got = []
+        gate = threading.Event()
+
+        def slow_cb(buf):
+            gate.wait(5)  # holds the drain so a backlog builds
+            got.append(int(np.asarray(buf[0])[0]))
+
+        pipe.get("s").connect(slow_cb)
+        pipe.start()
+        for i in range(20):
+            pipe.get("a").push([np.asarray([i], np.int32)])
+        gate.set()
+        pipe.get("a").end_of_stream()
+        assert pipe.wait(timeout=30).kind == "eos"
+        pipe.stop()
+        assert got == list(range(20))
+
+    def test_caps_event_not_overtaken(self):
+        """an event queued mid-stream stays ordered relative to buffers
+        even when the drain gathers groups."""
+        from nnstreamer_tpu import parse_launch
+        from nnstreamer_tpu.pipeline.element import CustomEvent
+
+        pipe = parse_launch(
+            "appsrc name=a ! queue max-size-buffers=64 materialize-host=true "
+            "name=q ! tensor_sink name=s to-host=true")
+        seen = []
+        pipe.get("s").connect(lambda b: seen.append(int(np.asarray(b[0])[0])))
+        orig = pipe.get("s").sink_event
+
+        def spy(pad, ev):
+            if isinstance(ev, CustomEvent):
+                seen.append(ev.name)
+            return orig(pad, ev)
+
+        pipe.get("s").sink_event = spy
+        pipe.start()
+        pipe.get("a").push([np.asarray([0], np.int32)])
+        import time as _t
+
+        _t.sleep(0.2)  # let buffer 0 drain so the event lands mid-stream
+        pipe.get("q").sinkpads[0].push_event(CustomEvent("marker"))
+        pipe.get("a").push([np.asarray([1], np.int32)])
+        pipe.get("a").end_of_stream()
+        assert pipe.wait(timeout=30).kind == "eos"
+        pipe.stop()
+        assert seen.index("marker") < seen.index(1)
+        assert seen.index(0) < seen.index("marker")
+
+
+class TestBatchLabelDecoder:
+    def test_per_row_labels(self):
+        from nnstreamer_tpu.decoders.image_labeling import ImageLabeling
+        from nnstreamer_tpu.tensors.buffer import TensorBuffer
+
+        scores = np.zeros((3, 5), np.float32)
+        scores[0, 2] = 1.0
+        scores[1, 4] = 2.0
+        scores[2, 0] = 3.0
+        out = ImageLabeling().decode(TensorBuffer([scores]), None, {})
+        assert out.meta["label_index"] == [2, 4, 0]
+        assert out.meta["score"] == [1.0, 2.0, 3.0]
+        assert out[0].tobytes().decode() == "2\n4\n0"
