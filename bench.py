@@ -94,7 +94,8 @@ def build_pipeline(batch: int = BATCH):
         "tensor_transform mode=arithmetic "
         "option=typecast:float32,add:-127.5,div:127.5 ! "
         f"tensor_filter framework=jax model={model_name} name=filter ! "
-        "tensor_decoder mode=image_labeling ! "
+        f"tensor_decoder mode=image_labeling "
+        f"{'option2=batched ' if batch > 1 else ''}! "
         # a device→host flush costs ~100 ms on a tunneled chip regardless
         # of size; materialize-host drains in GROUPS (one overlapped
         # flush covers the whole backlog, pipeline/pipeline.py _drain)

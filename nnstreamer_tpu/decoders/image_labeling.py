@@ -35,11 +35,18 @@ class ImageLabeling:
     def out_caps(self, config, options) -> Caps:
         return Caps("text/x-raw", {"format": "utf8"})
 
+    @staticmethod
+    def _batched(options) -> bool:
+        """option2=batched: rows of tensor[0] are separate frames (an
+        upstream tensor_aggregator micro-batch) — one label per row. The
+        default keeps reference semantics: argmax over the whole tensor
+        (a 2-D score tensor is ONE frame, tensordec-imagelabel.c)."""
+        return str(options.get("option2", "")).strip().lower() in (
+            "batched", "batch", "per-row")
+
     def decode(self, buf: TensorBuffer, config, options) -> TensorBuffer:
         scores = np.asarray(buf[0])
-        if scores.ndim >= 2 and scores.shape[0] > 1:
-            # micro-batched stream ([B, classes], e.g. from an upstream
-            # tensor_aggregator): one label per row
+        if self._batched(options) and scores.ndim >= 2:
             flat = scores.reshape(scores.shape[0], -1)
             idxs = np.argmax(flat, axis=-1)
             tops = flat[np.arange(flat.shape[0]), idxs]
@@ -72,13 +79,15 @@ class ImageLabeling:
     def device_kernel(self, options):
         """Device half: argmax + top score stay in the XLA program, so only
         per-frame scalars ever cross the tunnel instead of the full score
-        tensor (one pair per batch row on micro-batched streams)."""
+        tensor (one pair per batch row with option2=batched)."""
         import jax.numpy as jnp
+
+        batched = self._batched(options)
 
         def fn(consts, tensors):
             s = tensors[0]
-            rows = s.reshape(s.shape[0], -1) if s.ndim >= 2 else \
-                s.reshape(1, -1)
+            rows = s.reshape(s.shape[0], -1) if batched and s.ndim >= 2 \
+                else s.reshape(1, -1)
             return [jnp.argmax(rows, axis=-1).astype(jnp.int32),
                     jnp.max(rows, axis=-1).astype(jnp.float32)]
 

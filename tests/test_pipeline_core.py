@@ -255,7 +255,8 @@ class TestBatchLabelDecoder:
         scores[0, 2] = 1.0
         scores[1, 4] = 2.0
         scores[2, 0] = 3.0
-        out = ImageLabeling().decode(TensorBuffer([scores]), None, {})
+        out = ImageLabeling().decode(TensorBuffer([scores]), None,
+                                     {"option2": "batched"})
         assert out.meta["label_index"] == [2, 4, 0]
         assert out.meta["score"] == [1.0, 2.0, 3.0]
         assert out[0].tobytes().decode() == "2\n4\n0"
