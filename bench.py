@@ -70,10 +70,45 @@ def _register_mnv2(batch: int) -> str:
     return model_name
 
 
+_ARTIFACT_CACHE: dict = {}
+
+
+def _artifact_path(batch: int) -> str:
+    """Export the flagship model as a compiled StableHLO artifact once and
+    run the pipeline from the FILE (BENCH_ARTIFACT=1): proves the
+    opaque-model-file path end to end at benchmark scale."""
+    if batch not in _ARTIFACT_CACHE:
+        import tempfile
+
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.filters.artifact import save_artifact
+        from nnstreamer_tpu.models.mobilenet_v2 import mobilenet_v2
+
+        apply_fn, params, in_info, _ = mobilenet_v2(
+            image_size=IMAGE, batch=batch, dtype=jnp.bfloat16)
+        path = os.path.join(tempfile.gettempdir(),
+                            f"bench_mnv2_b{batch}.jaxexp")
+        platform = "cpu"
+        try:
+            import jax
+
+            platform = jax.default_backend()
+        except Exception:  # noqa: BLE001
+            pass
+        save_artifact(path, apply_fn, params, in_info=in_info,
+                      platforms=(platform,))
+        _ARTIFACT_CACHE[batch] = path
+    return _ARTIFACT_CACHE[batch]
+
+
 def build_pipeline(batch: int = BATCH):
     from nnstreamer_tpu import parse_launch
 
-    model_name = _register_mnv2(batch)
+    if os.environ.get("BENCH_ARTIFACT", "").strip() in ("1", "true", "yes"):
+        model_name = _artifact_path(batch)
+    else:
+        model_name = _register_mnv2(batch)
     # a partial trailing window never leaves the aggregator: round the
     # frame count to a batch multiple so the configured workload is what
     # actually gets measured
@@ -226,7 +261,7 @@ def measure_ssd() -> dict:
         "tensor_filter framework=jax model=ssd_bench name=filter ! "
         "tensor_decoder mode=bounding_boxes option1=mobilenet-ssd "
         "option4=300:300 option7=meta ! "
-        "queue max-size-buffers=64 prefetch-host=true ! "
+        "queue max-size-buffers=64 materialize-host=true ! "
         "tensor_sink name=sink to-host=true")
     frame_t = _collect(pipe)
     return dict(metric="ssd_mobilenet_300_pipeline_fps",
@@ -403,7 +438,7 @@ def measure_batch4() -> dict:
         "tensor_aggregator frames-in=1 frames-out=4 frames-flush=4 "
         "frames-dim=3 concat=true ! "
         "tensor_filter framework=jax model=mnv2_b4_bench name=filter ! "
-        "queue max-size-buffers=64 prefetch-host=true ! "
+        "queue max-size-buffers=64 materialize-host=true ! "
         "tensor_sink name=sink to-host=true")
     frame_t = _collect(pipe)
     return dict(metric="mobilenetv2_224_batch4_fps",

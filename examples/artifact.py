@@ -1,0 +1,63 @@
+"""Compiled-model artifacts: export once, run anywhere the chip is.
+
+The reference's core workflow is "point tensor_filter at an opaque model
+file" (any .tflite). The TPU-native artifact is StableHLO — produced by
+this framework's exporter, any JAX process, torch_xla, or TF (see
+docs/model-artifacts.md), and loaded by extension with framework=auto.
+
+Run:  python examples/artifact.py
+"""
+
+import os
+import tempfile
+
+import numpy as np
+
+from nnstreamer_tpu import parse_launch
+from nnstreamer_tpu.filters.artifact import export_model
+from nnstreamer_tpu.single import SingleShot
+
+
+def main():
+    workdir = tempfile.mkdtemp(prefix="nnstpu_artifact_")
+
+    # 1. author a model the usual way (a .py with get_model()) ...
+    model_py = os.path.join(workdir, "edge_detect.py")
+    with open(model_py, "w") as f:
+        f.write(
+            "import jax.numpy as jnp\n"
+            "from nnstreamer_tpu.tensors.types import TensorsInfo\n"
+            "IN_INFO = TensorsInfo.from_str('3:8:8:1', 'float32')\n"
+            "def get_model():\n"
+            "    def fn(x):\n"
+            "        gx = jnp.abs(jnp.diff(x, axis=2)).mean(axis=(1, 2, 3))\n"
+            "        return gx\n"
+            "    return fn\n"
+        )
+
+    # 2. ... export it to a self-contained artifact (weights baked in;
+    # equivalently: nns-launch --export edge_detect.py edge.jaxexp)
+    artifact = os.path.join(workdir, "edge.jaxexp")
+    # multi-platform artifacts run on the chip in production and CPU in CI
+    out_info = export_model(model_py, artifact, platforms=("tpu", "cpu"))
+    print(f"exported {artifact} (outputs: {out_info})")
+
+    # 3. the artifact is now an opaque file: any pipeline or SingleShot
+    # loads it by extension, caps come from the module signature
+    with SingleShot(model=artifact) as s:
+        print("input info:", s.get_input_info())
+        (y,) = s.invoke([np.ones((1, 8, 8, 3), np.float32)])
+        print("singleshot result:", np.asarray(y))
+
+    pipe = parse_launch(
+        "videotestsrc num-buffers=4 width=8 height=8 ! tensor_converter ! "
+        "tensor_transform mode=typecast option=float32 ! "
+        f"tensor_filter model={artifact} ! tensor_sink name=out"
+    )
+    pipe.get("out").connect(
+        lambda b: print("edge energy:", float(np.asarray(b[0])[0])))
+    pipe.run(timeout=120)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""Streaming tensors between pipelines over real MQTT.
+
+Two pipelines connected through an MQTT broker (the in-tree conformant
+MqttBroker here; point ``broker=mqtt://host:port`` at mosquitto or any
+3.1.1 broker in production). Payloads carry the reference's 1KB
+GstMQTTMessageHdr, so a reference mqttsrc could subscribe to the same
+topic. Timestamps rebase by base-epoch difference; add
+``ntp-server=pool.ntp.org`` on both elements for SNTP-corrected clocks
+across hosts.
+
+Run:  python examples/mqtt_stream.py
+"""
+
+import time
+
+import numpy as np
+
+from nnstreamer_tpu import parse_launch
+from nnstreamer_tpu.query.mqtt import MqttBroker
+
+
+def main():
+    broker = MqttBroker()  # 127.0.0.1, ephemeral port
+    url = f"mqtt://127.0.0.1:{broker.port}"
+    print(f"broker at {url}")
+
+    receiver = parse_launch(
+        f"tensor_pubsub_src broker={url} sub_topic=demo/frames "
+        "num_buffers=5 ! tensor_sink name=out"
+    )
+    receiver.get("out").connect(
+        lambda b: print(f"received {b.tensors[0].shape} "
+                        f"{b.tensors[0].dtype} pts={b.pts}"))
+    receiver.start()
+    time.sleep(0.3)  # let SUBSCRIBE land
+
+    sender = parse_launch(
+        "videotestsrc num-buffers=5 width=8 height=8 ! tensor_converter ! "
+        f"tensor_pubsub_sink broker={url} pub_topic=demo/frames"
+    )
+    sender.run(timeout=60)
+    receiver.wait(timeout=60)
+    receiver.stop()
+    broker.close()
+
+
+if __name__ == "__main__":
+    main()
