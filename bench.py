@@ -148,10 +148,11 @@ def device_probe(batch: int = BATCH, iters: int = 30) -> dict:
     import jax
     import jax.numpy as jnp
 
-    from nnstreamer_tpu.models.mobilenet_v2 import mobilenet_v2
+    from nnstreamer_tpu.filters.jax_backend import _registered
 
-    apply_fn, params, _, _ = mobilenet_v2(image_size=IMAGE, batch=batch,
-                                          dtype=jnp.bfloat16)
+    # reuse the flagship's registered model (same weights, no re-init)
+    entry = _registered.get(_register_mnv2(batch))
+    apply_fn, params = entry["fn"], entry["params"]
     jf = jax.jit(apply_fn)
     params = jax.device_put(params)
     x = jax.device_put(jnp.zeros((batch, IMAGE, IMAGE, 3), jnp.float32))
@@ -191,25 +192,22 @@ def measure_pipeline(batch: int = BATCH) -> dict:
     else:
         p50_ms = p90_ms = 0.0
     filt = pipe.get("filter")
-    return dict(fps=_steady_fps(frame_t, frames_per_buffer=batch,
-                                warmup_arrivals=warmup_arrivals),
+    return dict(fps=_steady_fps(frame_t, frames_per_buffer=batch),
                 p50_ms=p50_ms, p90_ms=p90_ms,
                 invoke_latency_us=filt.get_property("latency"),
                 frames=len(frame_t) * batch)
 
 
-def _steady_fps(frame_t, frames_per_buffer: int = 1,
-                warmup_arrivals: int = None):
-    """Sustained fps = post-warmup frames / (first steady arrival → EOS).
+def _steady_fps(frame_t, frames_per_buffer: int = 1):
+    """Sustained fps = frames after the first arrival / (first arrival →
+    EOS).
 
-    Anchoring the window end at EOS (recorded by :func:`_collect`) rather
-    than the last arrival keeps the estimate honest under bursty
-    arrivals: grouped D2H flushes can deliver a whole backlog within
-    milliseconds, and frames/(last−first arrival) would then exclude the
-    very processing time being measured. ``warmup_arrivals`` is in
-    ARRIVAL units (buffers, not frames) so batched and single-frame
-    pipelines discard the same share of the run."""
-    del warmup_arrivals  # the first arrival IS the warmup anchor
+    The first arrival is the warmup anchor (compile + first flush land
+    before it); anchoring the window END at EOS (recorded by
+    :func:`_collect`) rather than the last arrival keeps the estimate
+    honest under bursty arrivals: grouped D2H flushes can deliver a whole
+    backlog within milliseconds, and frames/(last−first arrival) would
+    then exclude the very processing time being measured."""
     eos_t = getattr(frame_t, "eos_t", None)
     if len(frame_t) < 2:
         print("bench: too few frames for a rate estimate", file=sys.stderr)

@@ -147,17 +147,27 @@ class TensorPubSubSink(Element, _PubSubBase):
             self._client = None
         super().stop()
 
+    def _caps_str(self, pad, tensors) -> str:
+        """Header caps string, cached per negotiated caps object (built
+        once, not per buffer)."""
+        caps = pad.caps
+        if caps is None:
+            caps = TensorsConfig.from_arrays(tensors).to_caps()
+            return _caps_to_string(caps)
+        cached = getattr(self, "_caps_str_cache", None)
+        if cached is None or cached[0] is not caps:
+            cached = (caps, _caps_to_string(caps))
+            self._caps_str_cache = cached
+        return cached[1]
+
     def chain(self, pad, buf):
         if self._transport == "mqtt":
             from nnstreamer_tpu.query.mqtt import pack_gst_mqtt_message
 
             host = buf.to_host()
-            caps = pad.caps
-            if caps is None:
-                caps = TensorsConfig.from_arrays(host.tensors).to_caps()
             payload = pack_gst_mqtt_message(
                 [np.ascontiguousarray(t).tobytes() for t in host.tensors],
-                _caps_to_string(caps),
+                self._caps_str(pad, host.tensors),
                 base_time_epoch=self._base_epoch,
                 sent_time_epoch=self._epoch_now(),
                 pts=buf.pts, dts=buf.dts, duration=buf.duration)

@@ -59,15 +59,11 @@ _MLIR_TO_NP = {
 _MLIR_BC_MAGIC = b"ML\xefR"
 
 
-def _bf16():
-    import ml_dtypes
-
-    return np.dtype(ml_dtypes.bfloat16)
-
-
 def _np_from_mlir(elem: str) -> np.dtype:
     if elem == "bf16":
-        return _bf16()
+        from nnstreamer_tpu.tensors.types import TensorType
+
+        return TensorType.BFLOAT16.np_dtype
     try:
         return _MLIR_TO_NP[elem]
     except KeyError:
