@@ -295,6 +295,10 @@ class SpeculativeDecoder:
                         self.tc, self.dc, self.gamma, m, self.S))
                 buf, count_rounds = self._fused[m](self.tp, self.dp, last,
                                                    t_cache, d_cache, pos)
+                # both transfers in flight before either blocks (one
+                # tunnel round trip instead of two)
+                for arr in (buf, count_rounds):
+                    getattr(arr, "copy_to_host_async", lambda: None)()
                 count, rounds = (int(x) for x in np.asarray(count_rounds))
                 out.extend(np.asarray(buf)[0, :count].tolist())
                 self.stats["dispatches"] += 1
@@ -304,6 +308,8 @@ class SpeculativeDecoder:
         while len(out) < max_new_tokens:
             buf, n_emits, last, t_cache, d_cache, pos = self._dispatch(
                 self.tp, self.dp, last, t_cache, d_cache, pos)
+            for arr in (buf, n_emits):
+                getattr(arr, "copy_to_host_async", lambda: None)()
             n_emits = np.asarray(n_emits)
             count = int(n_emits.sum())
             if count == 0:

@@ -776,6 +776,13 @@ class ContinuousBatchingEngine:
                     self.params, jnp.asarray(self._last),
                     self._cache, jnp.asarray(self._pos),
                     jnp.asarray(self._keys))
+                # start BOTH transfers before blocking on either: on a
+                # tunneled chip each cold fetch costs a full round trip,
+                # but copies in flight before the block share one
+                for t in (toks, lps):
+                    start_async = getattr(t, "copy_to_host_async", None)
+                    if start_async is not None:
+                        start_async()
                 toks = np.asarray(toks)  # [B,K] — the D2H sync; timed
                 lps = np.asarray(lps)
                 # latency reflects real completion, not async hand-off;
