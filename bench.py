@@ -323,7 +323,9 @@ def measure_query() -> dict:
     server = parse_launch(
         "tensor_query_serversrc name=ssrc port=0 ! "
         "tensor_filter framework=jax model=mnv2_query_bench ! "
-        "queue max-size-buffers=64 prefetch-host=true ! "
+        # serversink needs host bytes per result: grouped materialization
+        # turns one ~100ms link flush per FRAME into one per backlog
+        "queue max-size-buffers=64 materialize-host=true ! "
         "tensor_query_serversink")
     server.start()
     try:
