@@ -32,6 +32,7 @@ every dispatch so XLA updates it in place.
 
 from __future__ import annotations
 
+import collections
 import queue as _queue
 import threading
 import time as _time
@@ -286,6 +287,14 @@ class ContinuousBatchingEngine:
         # host-side per-slot state
         self._pos = np.zeros(self.B, np.int32)
         self._last = np.zeros(self.B, np.int32)
+        #: device-resident decode feedback (last, pos, keys) chaining
+        #: dispatch N+1 off dispatch N without a host sync; None = host
+        #: mirrors are authoritative (after admissions/recovery)
+        self._dev_state = None
+        #: issued-but-unprocessed dispatch blocks:
+        #: (t0, toks, lps, [(slot, stream), ...]) — host processing runs
+        #: one block behind so the fetch RTT overlaps the next compute
+        self._inflight: "collections.deque" = collections.deque()
         self._keys = np.zeros((self.B, 2), np.uint32)
         self._slots: List[Optional[GenerationStream]] = [None] * self.B
         self._budget = np.zeros(self.B, np.int64)  # tokens still allowed
@@ -348,8 +357,6 @@ class ContinuousBatchingEngine:
             "prefill_chunks": 0, "slot_steps": 0, "active_slot_steps": 0,
             "prefix_hits": 0, "prefix_tokens_reused": 0,
         }
-        import collections
-
         self.prefix_cache = int(prefix_cache)
         if self.prefix_cache < 0:
             raise ValueError(
@@ -380,7 +387,12 @@ class ContinuousBatchingEngine:
 
         def dispatch(params, token, cache, pos, keys):
             """K decode steps in one program: ([B],cache,[B],[B,2]) →
-            ([B,K] tokens, [B,K] logprobs, cache, keys)."""
+            ([B,K] tokens, [B,K] logprobs, cache, keys, last, pos').
+
+            The final carry (last token, advanced pos) comes back as
+            DEVICE arrays so the next dispatch can chain off them without
+            waiting for the token fetch — the loop pipelines the host
+            materialization one block behind the device (engine _loop)."""
 
             def body(carry, _):
                 token, cache, pos, keys = carry
@@ -390,7 +402,8 @@ class ContinuousBatchingEngine:
 
             (token, cache, pos, keys), (toks, lps) = jax.lax.scan(
                 body, (token, cache, pos, keys), None, length=K)
-            return jnp.transpose(toks), jnp.transpose(lps), cache, keys
+            return (jnp.transpose(toks), jnp.transpose(lps), cache, keys,
+                    token, pos)
 
         self._dispatch = jax.jit(dispatch, donate_argnums=(2,))
         self._sample_first = jax.jit(sample)
@@ -675,7 +688,15 @@ class ContinuousBatchingEngine:
 
     def _activate(self, req: _PendingRequest, slot: int, logits, cache1):
         """Common admission tail: seed the first token, install the
-        stream's cache into its batch slot."""
+        stream's cache into its batch slot.
+
+        Syncs host mirrors FIRST: this is the one place per-slot host
+        state is written, and doing the drain here (not at a
+        check-then-act distance from the pending queue) closes the race
+        where a submit() lands after the loop's emptiness check — the
+        dispatch that follows any activation always rebuilds its device
+        state from the mirrors."""
+        self._sync_host_state()
         jnp = self._jnp
         n = req.prompt.size
         self.stats["prefills"] += 1
@@ -709,6 +730,70 @@ class ContinuousBatchingEngine:
         elif self._budget[slot] <= 0:
             self._slots[slot] = None
             st._finish("length")
+
+    # -- pipelined block processing -------------------------------------------
+    def _process_block(self, t0, toks_dev, lps_dev, snapshot):
+        """Materialize one dispatched block and emit its tokens to the
+        streams that were active when it was ISSUED (a slot freed or
+        re-admitted since then skips emission — its tokens were garbage
+        or belong to a stream that already finished)."""
+        toks = np.asarray(toks_dev)  # the D2H sync; timed below
+        lps = np.asarray(lps_dev)
+        self.invoke_stats.record(_time.monotonic() - t0)
+        self.stats["dispatches"] += 1
+        self.stats["slot_steps"] += self.B * self.K
+        for slot, st in snapshot:
+            if self._slots[slot] is not st:
+                continue  # freed/replaced while the block was in flight
+            self._pos[slot] += self.K
+            self._last[slot] = toks[slot, -1]
+            for j in range(self.K):
+                tok = int(toks[slot, j])
+                self.stats["tokens_generated"] += 1
+                self.stats["active_slot_steps"] += 1
+                st._emit(tok, float(lps[slot, j]))
+                self._post_emit(slot, tok)
+                if self._slots[slot] is None:
+                    break  # EOS/length mid-block: drop the tail
+
+    def _drain_inflight(self):
+        while self._inflight:
+            self._process_block(*self._inflight.popleft())
+
+    def _sync_host_state(self):
+        """Drain the pipeline and pull the device decode state back into
+        the host mirrors so admissions (which write per-slot host state)
+        operate on current values."""
+        self._drain_inflight()
+        if self._dev_state is not None:
+            _last_d, _pos_d, keys_d = self._dev_state
+            # last/pos mirrors were advanced per processed block; only
+            # keys (folded on-device every step) need the fetch
+            self._keys = np.array(keys_d)
+            self._dev_state = None
+
+    def _recover(self, e) -> None:
+        """Device failure: salvage what the chip already computed (a
+        best-effort drain — those tokens were generated), then fail every
+        in-flight stream and any half-ingested prompt, rebuild the
+        (possibly donated-away) cache, and keep serving."""
+        log.error("serving: dispatch failed: %s", e)
+        try:
+            self._drain_inflight()
+        except Exception:  # noqa: BLE001 — wedged device: drop the rest
+            self._inflight.clear()
+        self._dev_state = None
+        if self._partial is not None:
+            self._partial[0].stream._finish(f"error: {e}")
+            self._partial = None
+        for slot in range(self.B):
+            st = self._slots[slot]
+            if st is self._RESERVED:
+                self._slots[slot] = None
+            elif st is not None:
+                st._finish(f"error: {e}")
+                self._slots[slot] = None
+        self._cache = self._init_cache()
 
     def _loop(self):
         jnp = self._jnp
@@ -766,62 +851,50 @@ class ContinuousBatchingEngine:
                         self._partial = None
                         req.stream._finish(f"error: {e}")
             if self.active_streams == 0:
-                if not progressed:
-                    self._wake.wait(timeout=0.05)
-                    self._wake.clear()
-                continue
+                try:
+                    self._sync_host_state()  # late EOS frees the last slot
+                except Exception as e:  # noqa: BLE001 — deferred device
+                    # errors surface at materialization; must not kill the
+                    # engine thread
+                    self._recover(e)
+                    continue
+                if self.active_streams == 0:
+                    if not progressed:
+                        self._wake.wait(timeout=0.05)
+                        self._wake.clear()
+                    continue
             try:
                 t0 = _time.monotonic()
-                toks, lps, self._cache, keys = self._dispatch(
-                    self.params, jnp.asarray(self._last),
-                    self._cache, jnp.asarray(self._pos),
-                    jnp.asarray(self._keys))
-                # start BOTH transfers before blocking on either: on a
-                # tunneled chip each cold fetch costs a full round trip,
-                # but copies in flight before the block share one
+                if self._dev_state is None:
+                    last_d = jnp.asarray(self._last)
+                    pos_d = jnp.asarray(self._pos)
+                    keys_d = jnp.asarray(self._keys)
+                else:
+                    last_d, pos_d, keys_d = self._dev_state
+                toks, lps, self._cache, keys_d, last_d, pos_d = \
+                    self._dispatch(self.params, last_d, self._cache,
+                                   pos_d, keys_d)
+                self._dev_state = (last_d, pos_d, keys_d)
+                # start the transfers NOW; the blocking materialization
+                # runs one block behind, so the link round trip overlaps
+                # the next dispatch's compute instead of serializing it
                 for t in (toks, lps):
                     start_async = getattr(t, "copy_to_host_async", None)
                     if start_async is not None:
                         start_async()
-                toks = np.asarray(toks)  # [B,K] — the D2H sync; timed
-                lps = np.asarray(lps)
-                # latency reflects real completion, not async hand-off;
-                # recorded only on success (a hung-then-failed dispatch
-                # must not dominate the latency window)
-                self.invoke_stats.record(_time.monotonic() - t0)
+                self._inflight.append((t0, toks, lps, [
+                    (slot, st) for slot, st in enumerate(self._slots)
+                    if st is not None and st is not self._RESERVED]))
+                if len(self._inflight) > 1:
+                    self._process_block(*self._inflight.popleft())
             except Exception as e:  # noqa: BLE001 — a device failure must
-                # not strand clients blocked on their streams: fail every
-                # in-flight stream (and any half-ingested prompt), rebuild
-                # the (possibly donated-away) cache, keep serving
-                log.error("serving: dispatch failed: %s", e)
-                if self._partial is not None:
-                    self._partial[0].stream._finish(f"error: {e}")
-                    self._partial = None
-                for slot in range(self.B):
-                    st = self._slots[slot]
-                    if st is self._RESERVED:
-                        self._slots[slot] = None
-                    elif st is not None:
-                        st._finish(f"error: {e}")
-                        self._slots[slot] = None
-                self._cache = self._init_cache()
+                # not strand clients blocked on their streams
+                self._recover(e)
                 continue
-            # np.array (copy): asarray on a jax array yields a READ-ONLY
-            # view, and _admit writes per-slot keys in place
-            self._keys = np.array(keys)
-            self.stats["dispatches"] += 1
-            self.stats["slot_steps"] += self.B * self.K
-            for slot in range(self.B):
-                st = self._slots[slot]
-                if st is None or st is self._RESERVED:
-                    continue  # free/reserved slot: set at (next) admit
-                self._pos[slot] += self.K
-                self._last[slot] = toks[slot, -1]
-                for j in range(self.K):
-                    tok = int(toks[slot, j])
-                    self.stats["tokens_generated"] += 1
-                    self.stats["active_slot_steps"] += 1
-                    st._emit(tok, float(lps[slot, j]))
-                    self._post_emit(slot, tok)
-                    if self._slots[slot] is None:
-                        break  # EOS/length mid-block: drop the tail
+        # stop requested: flush the pipelined blocks so streams whose
+        # tokens were already computed still receive them
+        try:
+            self._drain_inflight()
+        except Exception as e:  # noqa: BLE001 — draining on shutdown is
+            # best-effort; a dead device must not block stop()
+            log.warning("serving: drain at stop failed: %s", e)
