@@ -364,14 +364,31 @@ def measure_lstm() -> dict:
         return jnp.concatenate([h2, c2], axis=1).reshape(2 * hidden)
 
     register_jax_model("lstm_bench", step, params)
-    pipe = parse_launch(
-        f"tensor_reposrc slot=lstm_bench num-buffers={N_FRAMES} "
-        f"initial-dim={2 * hidden} initial-type=float32 initial-value=0.01 "
-        "timeout=30 ! "
-        "tensor_filter framework=jax model=lstm_bench name=filter ! "
-        "tee name=t  t. ! tensor_reposink slot=lstm_bench  "
-        "t. ! tensor_sink name=sink to-host=false")
+
+    def loop_desc(num):
+        return (f"tensor_reposrc slot=lstm_bench num-buffers={num} "
+                f"initial-dim={2 * hidden} initial-type=float32 "
+                "initial-value=0.01 timeout=30 ! "
+                "tensor_filter framework=jax model=lstm_bench name=filter ! "
+                "tee name=t  t. ! tensor_reposink slot=lstm_bench  "
+                "t. ! tensor_sink name=sink to-host=false")
+
+    from nnstreamer_tpu.elements.repo import GLOBAL_REPO as _repo
+
+    # compile off the clock (deferred tunnel compilation; see decode)
+    warm = parse_launch(loop_desc(2))
+    warm.run(timeout=300)
+    wbuf = _repo.get("lstm_bench", consume=True)
+    if wbuf is not None:
+        np.asarray(wbuf.tensors[0])
+    pipe = parse_launch(loop_desc(N_FRAMES))
     frame_t = _collect(pipe)
+    # completion-proven: the recurrence chain's final state materializes
+    # inside the timed window (see measure_decode)
+    final = _repo.get("lstm_bench")
+    if final is not None:
+        np.asarray(final.tensors[0])
+        frame_t.eos_t = time.monotonic()
     return dict(metric="lstm_repo_recurrence_steps_per_s",
                 fps=_steady_fps(frame_t), frames=len(frame_t))
 
@@ -468,23 +485,54 @@ def measure_decode() -> dict:
                             n_layers=8, d_ff=2048, max_seq=1024,
                             dtype=jnp.bfloat16)
     params = init_params(cfg)
-    register_jax_model("lm_decode_bench", build_greedy_stream_step(cfg),
-                       params)
-    n = min(N_FRAMES, 1000)
-    # seed with the device-resident cache directly: np.asarray here would
-    # bounce ~16 MB through the host just to re-upload on the first invoke
-    GLOBAL_REPO.set("lm_bench", TensorBuffer(
-        [np.asarray([1], np.int32),
-         init_cache(cfg, batch=1),
-         np.asarray(0, np.int32)], pts=0))
-    pipe = parse_launch(
-        f"tensor_reposrc slot=lm_bench num-buffers={n} timeout=120 ! "
-        "tensor_filter framework=jax model=lm_decode_bench name=filter ! "
-        "tee name=t  t. ! tensor_reposink slot=lm_bench  "
-        "t. ! tensor_sink name=sink to-host=false")
+    # 16 decode steps per invoke (lax.scan inside the program): the token
+    # chain is inherently sequential, so the only throughput lever is
+    # amortizing per-dispatch overhead across a block — the serving
+    # engine's K-step dispatch, repo-loop flavored
+    K = 16
+    register_jax_model("lm_decode_bench",
+                       build_greedy_stream_step(cfg, steps=K), params)
+    n = max(1, min(N_FRAMES, 1000) // K)
+
+    def seed():
+        # seed with the device-resident cache directly: np.asarray here
+        # would bounce ~16 MB through the host just to re-upload on the
+        # first invoke
+        GLOBAL_REPO.set("lm_bench", TensorBuffer(
+            [np.asarray([1], np.int32),
+             init_cache(cfg, batch=1),
+             np.asarray(0, np.int32)], pts=0))
+
+    def loop_desc(num):
+        return (f"tensor_reposrc slot=lm_bench num-buffers={num} "
+                "timeout=120 ! "
+                "tensor_filter framework=jax model=lm_decode_bench "
+                "name=filter input-combination=i0,i1,i2 ! "
+                "tee name=t  t. ! tensor_reposink slot=lm_bench  "
+                "t. ! tensor_sink name=sink to-host=false")
+
+    # compile OFF the clock: on a tunneled chip compilation is deferred to
+    # first execution, so a 2-buffer warm run + state materialization is
+    # the only reliable way to keep it out of the measured window
+    seed()
+    warm = parse_launch(loop_desc(2))
+    warm.run(timeout=300)
+    wbuf = GLOBAL_REPO.get("lm_bench")
+    if wbuf is not None:
+        np.asarray(wbuf.tensors[0])
+    seed()
+    pipe = parse_launch(loop_desc(n))
     frame_t = _collect(pipe)
+    # to-host=false arrivals measure dispatch ENQUEUE rate; the loop's
+    # final state proves actual completion of the whole token chain (each
+    # step depends on the previous) — fetch it inside the timed window
+    final = GLOBAL_REPO.get("lm_bench")
+    if final is not None:
+        np.asarray(final.tensors[0])
+        frame_t.eos_t = time.monotonic()
     return dict(metric="lm_decode_tokens_per_s_d512_l8_kv1024",
-                fps=_steady_fps(frame_t), frames=len(frame_t))
+                fps=_steady_fps(frame_t, frames_per_buffer=K),
+                frames=len(frame_t) * K)
 
 
 def measure_serve() -> dict:

@@ -480,18 +480,42 @@ def build_prefill(cfg: TransformerConfig,
 
 def build_greedy_stream_step(cfg: TransformerConfig,
                              max_seq: Optional[int] = None,
-                             kv_codec: Optional[str] = None) -> Callable:
+                             kv_codec: Optional[str] = None,
+                             steps: int = 1) -> Callable:
     """Pipeline-shaped greedy decode step for the tensor_repo loop:
-    ``step(params, token, cache, pos) -> (next_token, cache, pos+1)`` —
-    the state tuple a repo slot circulates (examples/llm_stream.py, bench
-    config ``decode``)."""
+    ``step(params, token, cache, pos) -> (next_token, cache, pos+steps)``
+    — the state tuple a repo slot circulates (examples/llm_stream.py,
+    bench config ``decode``).
+
+    With ``steps > 1`` the step runs a ``lax.scan`` of that many decode
+    steps inside ONE program and returns a fourth output, the ``[steps]``
+    token block — the serving engine's multi-step-dispatch idea applied
+    to the repo loop (per-invoke dispatch overhead amortizes over the
+    block; the sequential token chain itself cannot be batched). Use
+    ``input-combination=i0,i1,i2`` on the filter so the circulating state
+    stays (token, cache, pos)."""
     decode = build_decode_step(cfg, max_seq, kv_codec)
 
-    def step(params, token, cache, pos):
+    def one(params, token, cache, pos):
         logits, cache2 = decode(params, token.reshape(1).astype(jnp.int32),
                                 cache, pos.reshape(()).astype(jnp.int32))
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         return nxt, cache2, pos + 1
+
+    if steps <= 1:
+        return one
+
+    def step(params, token, cache, pos):
+        def body(carry, _):
+            tok, cache, pos = carry
+            nxt, cache, pos = one(params, tok, cache, pos)
+            return (nxt, cache, pos), nxt.reshape(())
+
+        (tok, cache, pos), toks = jax.lax.scan(
+            body, (token.reshape(1).astype(jnp.int32), cache,
+                   pos.reshape(()).astype(jnp.int32)),
+            None, length=steps)
+        return tok, cache, pos, toks
 
     return step
 

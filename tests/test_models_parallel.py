@@ -661,3 +661,37 @@ class TestIncrementalDecode:
         finally:
             unregister_jax_model("lm_sample_test")
             GLOBAL_REPO.remove("lm_s")
+
+
+def test_greedy_stream_step_multi_matches_single():
+    """steps=K scan chain must be token-exact vs K single steps."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nnstreamer_tpu.models.transformer import (
+        TransformerConfig,
+        build_greedy_stream_step,
+        init_cache,
+        init_params,
+    )
+
+    cfg = TransformerConfig(vocab=61, d_model=32, n_heads=4, n_layers=2,
+                            d_ff=64, max_seq=32, dtype=jnp.float32)
+    params = init_params(cfg, seed=5)
+    one = jax.jit(build_greedy_stream_step(cfg))
+    multi = jax.jit(build_greedy_stream_step(cfg, steps=6))
+
+    tok1, cache1 = jnp.asarray([3], jnp.int32), init_cache(cfg, batch=1)
+    pos1 = jnp.asarray(0, jnp.int32)
+    singles = []
+    for _ in range(6):
+        tok1, cache1, pos1 = one(params, tok1, cache1, pos1)
+        singles.append(int(tok1[0]))
+
+    tok2, cache2 = jnp.asarray([3], jnp.int32), init_cache(cfg, batch=1)
+    pos2 = jnp.asarray(0, jnp.int32)
+    tok2, cache2, pos2, toks = multi(params, tok2, cache2, pos2)
+    assert np.asarray(toks).tolist() == singles
+    assert int(tok2[0]) == singles[-1]
+    assert int(pos2) == 6
