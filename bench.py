@@ -294,8 +294,11 @@ def measure_pose_mux() -> dict:
     pipe = parse_launch(
         f"tensor_mux name=mux sync-mode=slowest ! "
         "tensor_filter framework=jax model=pose4_bench name=filter ! "
-        "queue max-size-buffers=64 prefetch-host=true ! "
-        "tensor_sink name=sink to-host=false " + srcs)
+        # keypoint decode fuses onto the device: [K,3] rows cross the
+        # link, not full heatmaps; completion-proven via the host sink
+        "tensor_decoder mode=pose_estimation option2=meta ! "
+        "queue max-size-buffers=64 materialize-host=true ! "
+        "tensor_sink name=sink to-host=true " + srcs)
     frame_t = _collect(pipe)
     return dict(metric="posenet_mux4_batched_fps",
                 fps=_steady_fps(frame_t, frames_per_buffer=4),

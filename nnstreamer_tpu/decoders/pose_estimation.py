@@ -84,22 +84,38 @@ class PoseEstimation:
     def decode(self, buf: TensorBuffer, config, options) -> TensorBuffer:
         o = self._opts(options)
         heat = np.asarray(buf[0], np.float32)
-        if heat.ndim == 4:
-            heat = heat[0]
-        offs = None
-        if buf.num_tensors > 1:
-            offs = np.asarray(buf[1], np.float32)
-            if offs.ndim == 4:
+        offs = np.asarray(buf[1], np.float32) if buf.num_tensors > 1 \
+            else None
+        if heat.ndim == 4 and heat.shape[0] > 1:
+            # batched heatmaps (mux'd multi-stream invoke): per-frame
+            # keypoint lists — nothing silently dropped
+            kps = [decode_pose(heat[b],
+                               None if offs is None else offs[b],
+                               o["threshold"])
+                   for b in range(heat.shape[0])]
+        else:
+            if heat.ndim == 4:
+                heat = heat[0]
+            if offs is not None and offs.ndim == 4:
                 offs = offs[0]
-        kps = decode_pose(heat, offs, o["threshold"])
+            kps = decode_pose(heat, offs, o["threshold"])
         return self._emit(buf, kps, o)
 
     def _emit(self, buf: TensorBuffer, kps, o) -> TensorBuffer:
         meta = {**buf.meta, "keypoints": kps}
+        batched = bool(kps) and isinstance(kps[0], list)
         if o["meta_only"]:
-            flat = np.asarray([[kp["y"], kp["x"], kp["score"]] for kp in kps],
-                              np.float32)
+            frames = kps if batched else [kps]
+            flat = np.asarray(
+                [[[kp["y"], kp["x"], kp["score"]] for kp in fr]
+                 for fr in frames], np.float32)
+            if not batched:
+                flat = flat[0]
             return buf.with_tensors([flat]).replace(meta=meta)
+        if batched:  # one overlay per frame
+            return buf.with_tensors(
+                [draw_pose(o["width"], o["height"], fr) for fr in kps]
+            ).replace(meta=meta)
         return buf.with_tensors(
             [draw_pose(o["width"], o["height"], kps)]
         ).replace(meta=meta)
@@ -111,39 +127,55 @@ class PoseEstimation:
         rows leave the device instead of full heatmaps."""
         import jax.numpy as jnp
 
-        def fn(consts, tensors):
-            heat = tensors[0].astype(jnp.float32)
-            if heat.ndim == 4:
-                heat = heat[0]
+        def one(heat, offs):
+            """[H,W,K](+[H,W,2K]) → [K,3] (y, x, score), all on device."""
             H, W, K = heat.shape
             flat = heat.reshape(-1, K)
             j = jnp.argmax(flat, axis=0)                      # [K]
             score = jnp.take_along_axis(flat, j[None, :], axis=0)[0]
             ys = (j // W).astype(jnp.float32)
             xs = (j % W).astype(jnp.float32)
-            if len(tensors) > 1:
-                offs = tensors[1].astype(jnp.float32)
-                if offs.ndim == 4:
-                    offs = offs[0]
+            if offs is not None:
                 offs_flat = offs.reshape(-1, offs.shape[-1])
                 kk = jnp.arange(K)
                 ys = ys + offs_flat[j, kk]
                 xs = xs + offs_flat[j, K + kk]
             y = ys / max(H - 1, 1)
             x = xs / max(W - 1, 1)
-            return [jnp.stack([y, x, score], axis=1)]
+            return jnp.stack([y, x, score], axis=1)
+
+        def fn(consts, tensors):
+            heat = tensors[0].astype(jnp.float32)
+            offs = tensors[1].astype(jnp.float32) if len(tensors) > 1 \
+                else None
+            if heat.ndim == 4:
+                # batched heatmaps (mux'd multi-stream invoke): one [K,3]
+                # block per frame — nothing silently dropped
+                import jax
+
+                if offs is not None:
+                    return [jax.vmap(one, in_axes=(0, 0))(heat, offs)]
+                return [jax.vmap(lambda h: one(h, None))(heat)]
+            return [one(heat, offs)]
 
         return None, fn
 
     def host_finalize(self, host_buf: TensorBuffer, config, options
                       ) -> TensorBuffer:
         o = self._opts(options)
-        rows = np.asarray(host_buf[0], np.float32).reshape(-1, 3)
-        kps = [{
-            "keypoint": k,
-            "y": float(r[0]),
-            "x": float(r[1]),
-            "score": float(r[2]),
-            "visible": float(r[2]) >= o["threshold"],
-        } for k, r in enumerate(rows)]
+        arr = np.asarray(host_buf[0], np.float32)
+
+        def to_kps(rows):
+            return [{
+                "keypoint": k,
+                "y": float(r[0]),
+                "x": float(r[1]),
+                "score": float(r[2]),
+                "visible": float(r[2]) >= o["threshold"],
+            } for k, r in enumerate(rows)]
+
+        if arr.ndim == 3:  # batched: per-frame keypoint lists
+            kps = [to_kps(frame) for frame in arr]
+        else:
+            kps = to_kps(arr.reshape(-1, 3))
         return self._emit(host_buf, kps, o)

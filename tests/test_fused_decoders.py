@@ -273,3 +273,34 @@ def test_mode_aliases_match_reference(postproc_model):
                     frame, fuse=False)
     assert [_det_key(d) for d in new.meta["detections"]] == \
         [_det_key(d) for d in old.meta["detections"]]
+
+
+def test_pose_batched_heatmaps_all_frames_decoded():
+    """[B,H,W,K] heatmaps (mux'd multi-stream invoke) yield per-frame
+    keypoints — no silent truncation to frame 0."""
+    from nnstreamer_tpu.decoders.pose_estimation import PoseEstimation
+    from nnstreamer_tpu.tensors.buffer import TensorBuffer
+
+    B, H, W, K = 3, 8, 8, 2
+    heat = np.zeros((B, H, W, K), np.float32)
+    peaks = [(1, 2), (4, 5), (6, 0)]
+    for b, (y, x) in enumerate(peaks):
+        heat[b, y, x, :] = 5.0
+    dec = PoseEstimation()
+    out = dec.decode(TensorBuffer([heat]), None, {"option2": "meta"})
+    kps = out.meta["keypoints"]
+    assert len(kps) == B and all(len(fr) == K for fr in kps)
+    for b, (y, x) in enumerate(peaks):
+        assert abs(kps[b][0]["y"] - y / (H - 1)) < 1e-6
+        assert abs(kps[b][0]["x"] - x / (W - 1)) < 1e-6
+    assert np.asarray(out[0]).shape == (B, K, 3)
+
+    # device kernel path agrees
+    _, fn = dec.device_kernel({"option2": "meta"})
+    import jax.numpy as jnp
+
+    (rows,) = fn(None, [jnp.asarray(heat)])
+    assert rows.shape == (B, K, 3)
+    finalized = dec.host_finalize(
+        TensorBuffer([np.asarray(rows)]), None, {"option2": "meta"})
+    assert len(finalized.meta["keypoints"]) == B
