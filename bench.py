@@ -347,6 +347,37 @@ def measure_query() -> dict:
                 fps=_steady_fps(frame_t), frames=len(frame_t))
 
 
+def _run_repo_loop(desc_fn, slot: str, n: int, reset=None):
+    """Shared completion-proof protocol for tensor_repo loop configs:
+    a 2-buffer warm run first (tunneled chips defer compilation to first
+    execution), then the measured run, then the final loop state
+    materializes INSIDE the timed window — the returned arrivals prove
+    the whole dependent chain executed, not just that dispatches were
+    enqueued."""
+    from nnstreamer_tpu import parse_launch
+    from nnstreamer_tpu.elements.repo import GLOBAL_REPO
+
+    if reset is not None:
+        reset()
+    warm = parse_launch(desc_fn(2))
+    warm.run(timeout=300)
+    wbuf = GLOBAL_REPO.get(slot, consume=True)
+    if wbuf is not None:
+        np.asarray(wbuf.tensors[0])
+    if reset is not None:
+        reset()
+    pipe = parse_launch(desc_fn(n))
+    frame_t = _collect(pipe)
+    final = GLOBAL_REPO.get(slot)
+    if final is None:
+        raise RuntimeError(
+            f"bench: repo slot {slot!r} empty after the run — cannot "
+            "prove completion")
+    np.asarray(final.tensors[0])
+    frame_t.eos_t = time.monotonic()
+    return frame_t
+
+
 def measure_lstm() -> dict:
     """Config #5: tensor_repo recurrence — LSTM state circulates through a
     repo slot as device-resident arrays; one filter invoke per step."""
@@ -376,22 +407,7 @@ def measure_lstm() -> dict:
                 "tee name=t  t. ! tensor_reposink slot=lstm_bench  "
                 "t. ! tensor_sink name=sink to-host=false")
 
-    from nnstreamer_tpu.elements.repo import GLOBAL_REPO as _repo
-
-    # compile off the clock (deferred tunnel compilation; see decode)
-    warm = parse_launch(loop_desc(2))
-    warm.run(timeout=300)
-    wbuf = _repo.get("lstm_bench", consume=True)
-    if wbuf is not None:
-        np.asarray(wbuf.tensors[0])
-    pipe = parse_launch(loop_desc(N_FRAMES))
-    frame_t = _collect(pipe)
-    # completion-proven: the recurrence chain's final state materializes
-    # inside the timed window (see measure_decode)
-    final = _repo.get("lstm_bench")
-    if final is not None:
-        np.asarray(final.tensors[0])
-        frame_t.eos_t = time.monotonic()
+    frame_t = _run_repo_loop(loop_desc, "lstm_bench", N_FRAMES)
     return dict(metric="lstm_repo_recurrence_steps_per_s",
                 fps=_steady_fps(frame_t), frames=len(frame_t))
 
@@ -514,25 +530,7 @@ def measure_decode() -> dict:
                 "tee name=t  t. ! tensor_reposink slot=lm_bench  "
                 "t. ! tensor_sink name=sink to-host=false")
 
-    # compile OFF the clock: on a tunneled chip compilation is deferred to
-    # first execution, so a 2-buffer warm run + state materialization is
-    # the only reliable way to keep it out of the measured window
-    seed()
-    warm = parse_launch(loop_desc(2))
-    warm.run(timeout=300)
-    wbuf = GLOBAL_REPO.get("lm_bench")
-    if wbuf is not None:
-        np.asarray(wbuf.tensors[0])
-    seed()
-    pipe = parse_launch(loop_desc(n))
-    frame_t = _collect(pipe)
-    # to-host=false arrivals measure dispatch ENQUEUE rate; the loop's
-    # final state proves actual completion of the whole token chain (each
-    # step depends on the previous) — fetch it inside the timed window
-    final = GLOBAL_REPO.get("lm_bench")
-    if final is not None:
-        np.asarray(final.tensors[0])
-        frame_t.eos_t = time.monotonic()
+    frame_t = _run_repo_loop(loop_desc, "lm_bench", n, reset=seed)
     return dict(metric="lm_decode_tokens_per_s_d512_l8_kv1024",
                 fps=_steady_fps(frame_t, frames_per_buffer=K),
                 frames=len(frame_t) * K)

@@ -112,10 +112,13 @@ class PoseEstimation:
             if not batched:
                 flat = flat[0]
             return buf.with_tensors([flat]).replace(meta=meta)
-        if batched:  # one overlay per frame
-            return buf.with_tensors(
-                [draw_pose(o["width"], o["height"], fr) for fr in kps]
-            ).replace(meta=meta)
+        if batched:
+            # overlay caps declare ONE video frame; a batched overlay
+            # needs a demux upstream — refuse rather than emit frames a
+            # caps-respecting consumer would silently drop
+            raise ValueError(
+                "pose_estimation: batched heatmaps require option2=meta "
+                "(overlay output is single-frame; demux the stream first)")
         return buf.with_tensors(
             [draw_pose(o["width"], o["height"], kps)]
         ).replace(meta=meta)
@@ -148,7 +151,7 @@ class PoseEstimation:
             heat = tensors[0].astype(jnp.float32)
             offs = tensors[1].astype(jnp.float32) if len(tensors) > 1 \
                 else None
-            if heat.ndim == 4:
+            if heat.ndim == 4 and heat.shape[0] > 1:
                 # batched heatmaps (mux'd multi-stream invoke): one [K,3]
                 # block per frame — nothing silently dropped
                 import jax
@@ -156,6 +159,9 @@ class PoseEstimation:
                 if offs is not None:
                     return [jax.vmap(one, in_axes=(0, 0))(heat, offs)]
                 return [jax.vmap(lambda h: one(h, None))(heat)]
+            if heat.ndim == 4:  # B==1: squeeze, matching the host path
+                heat = heat[0]
+                offs = None if offs is None else offs[0]
             return [one(heat, offs)]
 
         return None, fn
