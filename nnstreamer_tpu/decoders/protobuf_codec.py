@@ -42,16 +42,8 @@ from nnstreamer_tpu.tensors.types import (
     Fraction,
     TensorFormat,
     TensorInfo,
-    TensorType,
 )
-
-#: reference Tensor_type enum order (nnstreamer.proto:8-19): NNS_INT32=0 …
-#: NNS_UINT64=9. The first 10 TensorType members match it exactly;
-#: FLOAT16/BFLOAT16 beyond have no reference value.
-_TYPE_ORDER = list(TensorType)
-_REF_TYPE_COUNT = 10
-_FORMAT_ORDER = list(TensorFormat)  # STATIC=0, FLEXIBLE=1, SPARSE=2 (:36-40)
-_REF_RANK = 4  # NNS_TENSOR_RANK_LIMIT in the reference proto era
+from nnstreamer_tpu.tensors import wire
 
 _lock = threading.Lock()
 _msgs = None
@@ -117,34 +109,16 @@ def encode_protobuf(buf: TensorBuffer, rate: Optional[Fraction] = None,
     msg = Tensors()
     host = buf.to_host()
     msg.num_tensor = host.num_tensors
-    if rate is not None:  # accepts our Fraction or fractions.Fraction
-        msg.fr.rate_n = int(getattr(rate, "num",
-                                    getattr(rate, "numerator", 0)))
-        msg.fr.rate_d = int(getattr(rate, "den",
-                                    getattr(rate, "denominator", 1))) or 1
-    else:
-        msg.fr.rate_n = 0
-        msg.fr.rate_d = 1
-    msg.format = _FORMAT_ORDER.index(TensorFormat.from_any(fmt))
+    msg.fr.rate_n, msg.fr.rate_d = wire.rate_pair(rate)
+    msg.format = wire.ref_format_index(fmt)
     names = buf.meta.get("tensor_names") or []
     for i, t in enumerate(host.tensors):
         info = TensorInfo.from_array(t)
-        type_idx = _TYPE_ORDER.index(info.type)
-        if type_idx >= _REF_TYPE_COUNT:
-            raise ValueError(
-                f"protobuf codec: {info.type.value} has no value in the "
-                "reference Tensor_type enum (nnstreamer.proto:8-19); "
-                "typecast to float32 first")
-        if len(info.dim) > _REF_RANK:
-            raise ValueError(
-                f"protobuf codec: rank {len(info.dim)} exceeds the "
-                f"reference wire rank {_REF_RANK}; use flexbuf for "
-                "higher-rank tensors")
         tm = msg.tensor.add()
         tm.name = str(names[i]) if i < len(names) and names[i] else ""
-        tm.type = type_idx
-        tm.dimension.extend(
-            tuple(info.dim) + (1,) * (_REF_RANK - len(info.dim)))
+        tm.type = wire.ref_type_index(info, "protobuf", "mode=nnstpu-flex")
+        tm.dimension.extend(wire.ref_dims(info, "protobuf",
+                                          "mode=nnstpu-flex"))
         tm.data = np.ascontiguousarray(t).tobytes()
     return msg.SerializeToString()
 
@@ -160,10 +134,7 @@ def decode_protobuf(blob: bytes) -> TensorBuffer:
     tensors = []
     names = []
     for tm in msg.tensor:
-        if not 0 <= tm.type < _REF_TYPE_COUNT:
-            raise ValueError(
-                f"protobuf codec: unknown Tensor_type value {tm.type}")
-        ttype = _TYPE_ORDER[tm.type]
+        ttype = wire.ref_type_from_index(tm.type, "protobuf")
         shape = tuple(reversed(list(tm.dimension)))
         tensors.append(np.frombuffer(tm.data,
                                      ttype.np_dtype).reshape(shape))
@@ -171,10 +142,8 @@ def decode_protobuf(blob: bytes) -> TensorBuffer:
     meta = {}
     if msg.fr.rate_n:
         meta["framerate"] = Fraction(msg.fr.rate_n, msg.fr.rate_d or 1)
-    if not 0 <= msg.format < len(_FORMAT_ORDER):
-        raise ValueError(
-            f"protobuf codec: unknown Tensor_format value {msg.format}")
-    meta["format"] = _FORMAT_ORDER[msg.format].value
+    meta["format"] = wire.ref_format_from_index(msg.format,
+                                                "protobuf").value
     if any(names):
         meta["tensor_names"] = names
     return TensorBuffer(tensors, meta=meta)

@@ -43,6 +43,16 @@ def _codecs(idl: str):
 
         return encode_protobuf, decode_protobuf
     if idl == "flexbuf":
+        # reference FlexBuffers layout — interoperates with a reference
+        # nnstreamer gRPC peer (tensor_decoder/tensordec-flexbuf.cc map)
+        from nnstreamer_tpu.decoders.flexbuf import (
+            decode_flexbuf,
+            encode_flexbuf,
+        )
+
+        return encode_flexbuf, decode_flexbuf
+    if idl == "nnstpu-flex":
+        # framework-native framing: carries pts, allows rank>4/fp16
         from nnstreamer_tpu.decoders.flexbuf import decode_flex, encode_flex
 
         return encode_flex, decode_flex
@@ -53,7 +63,8 @@ def _codecs(idl: str):
         )
 
         return encode_flatbuf, decode_flatbuf
-    raise ValueError(f"grpc: unknown idl {idl!r} (protobuf|flexbuf|flatbuf)")
+    raise ValueError(
+        f"grpc: unknown idl {idl!r} (protobuf|flexbuf|flatbuf|nnstpu-flex)")
 
 
 def _noop_serializer(_) -> bytes:  # Empty message

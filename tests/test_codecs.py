@@ -11,9 +11,15 @@ from nnstreamer_tpu.tensors.buffer import TensorBuffer
 
 CODECS = {}
 
-from nnstreamer_tpu.decoders.flexbuf import decode_flex, encode_flex  # noqa: E402
+from nnstreamer_tpu.decoders.flexbuf import (  # noqa: E402
+    decode_flex,
+    decode_flexbuf,
+    encode_flex,
+    encode_flexbuf,
+)
 
-CODECS["flexbuf"] = (encode_flex, decode_flex)
+CODECS["flexbuf"] = (encode_flexbuf, decode_flexbuf)
+CODECS["nnstpu-flex"] = (encode_flex, decode_flex)
 from nnstreamer_tpu.decoders.protobuf_codec import (  # noqa: E402
     decode_protobuf,
     encode_protobuf,
@@ -42,7 +48,7 @@ def test_codec_roundtrip(name):
     assert out.num_tensors == 3
     for a, b in zip(_buf().tensors, out.tensors):
         assert a.dtype == b.dtype
-        if name == "protobuf":
+        if name in ("protobuf", "flexbuf"):
             # wire-parity with the reference rank-4 format: shapes come
             # back 1-padded to rank 4 (see decoders/protobuf_codec.py)
             assert b.shape == (1,) * (4 - a.ndim) + a.shape
@@ -53,7 +59,8 @@ def test_codec_roundtrip(name):
 
 @pytest.mark.parametrize("name", sorted(set(CODECS) & {"flatbuf",
                                                        "protobuf",
-                                                       "flexbuf"}))
+                                                       "flexbuf",
+                                                       "nnstpu-flex"}))
 def test_codec_pipeline_loop(name):
     """tensor_decoder mode=<codec> ! tensor_converter mode=<codec> is an
     identity transform over the wire format."""
@@ -194,12 +201,12 @@ class TestProtobufWireCompat:
 
     def test_fp16_refused(self):
         buf = TensorBuffer([np.zeros((2, 2), np.float16)])
-        with pytest.raises(ValueError, match="Tensor_type"):
+        with pytest.raises(ValueError, match="tensor_type"):
             CODECS["protobuf"][0](buf)
 
     def test_rank5_refused(self):
         buf = TensorBuffer([np.zeros((1, 2, 3, 4, 5), np.float32)])
-        with pytest.raises(ValueError, match="flexbuf"):
+        with pytest.raises(ValueError, match="nnstpu-flex"):
             CODECS["protobuf"][0](buf)
 
     def test_bad_wire_values_refused(self, ref_pb2):
@@ -208,11 +215,11 @@ class TestProtobufWireCompat:
         t.type = -1
         t.dimension.extend([1, 1, 1, 1])
         t.data = b"\x00\x00"
-        with pytest.raises(ValueError, match="Tensor_type"):
+        with pytest.raises(ValueError, match="tensor_type"):
             CODECS["protobuf"][1](msg.SerializeToString())
         msg.tensor[0].type = ref_pb2.Tensor.NNS_INT16
         msg.format = -1
-        with pytest.raises(ValueError, match="Tensor_format"):
+        with pytest.raises(ValueError, match="tensor_format"):
             CODECS["protobuf"][1](msg.SerializeToString())
 
     def test_converter_keeps_wire_meta(self, ref_pb2):
@@ -230,5 +237,139 @@ class TestProtobufWireCompat:
         from nnstreamer_tpu.converters.protobuf_codec import ProtobufConverter
 
         out = ProtobufConverter().convert(TensorBuffer([blob]), None)
+        assert str(out.meta["framerate"]) == "10/1"
+        assert out.meta["tensor_names"] == ["probs"]
+
+
+# ---------------------------------------------------------------------------
+# Wire compatibility with the reference flexbuf layout
+# (tensordec-flexbuf.cc:26-35 / tensor_converter_flexbuf.cc:107-141)
+# ---------------------------------------------------------------------------
+
+
+def _ref_peer_encode(tensors, names=None, rate=(30, 1), fmt=0):
+    """Build a payload exactly the way the reference decoder does
+    (tensordec-flexbuf.cc:138-168) — same call sequence on a flexbuffers
+    Builder — standing in for a reference peer."""
+    from flatbuffers import flexbuffers
+
+    fbb = flexbuffers.Builder()
+    type_order = ["int32", "uint32", "int16", "uint16", "int8", "uint8",
+                  "float64", "float32", "int64", "uint64"]
+    with fbb.Map():
+        fbb.Key("num_tensors")
+        fbb.UInt(len(tensors))
+        fbb.Key("rate_n")
+        fbb.Int(rate[0])
+        fbb.Key("rate_d")
+        fbb.Int(rate[1])
+        fbb.Key("format")
+        fbb.Int(fmt)
+        for i, t in enumerate(tensors):
+            fbb.Key(f"tensor_{i}")
+            dims = list(reversed(t.shape)) if t.ndim else [1]
+            with fbb.Vector():
+                fbb.String(names[i] if names and names[i] else "")
+                fbb.Int(type_order.index(str(t.dtype)))
+                fbb.TypedVectorFromElements(dims + [1] * (4 - len(dims)))
+                fbb.Blob(np.ascontiguousarray(t).tobytes())
+    return bytes(fbb.Finish())
+
+
+class TestFlexbufWireCompat:
+    def test_reference_parses_our_payload(self):
+        """A reference peer reads our bytes with plain flexbuffers calls
+        (the exact reads tensor_converter_flexbuf.cc:107-141 makes)."""
+        from flatbuffers import flexbuffers
+
+        from nnstreamer_tpu.tensors.types import Fraction
+
+        blob = encode_flexbuf(_buf(), rate=Fraction(30, 1))
+        m = flexbuffers.GetRoot(blob).AsMap
+        assert m["num_tensors"].AsInt == 3
+        assert (m["rate_n"].AsInt, m["rate_d"].AsInt) == (30, 1)
+        assert m["format"].AsInt == 0
+        t0 = m["tensor_0"].AsVector
+        assert t0[0].AsString == ""
+        assert t0[1].AsInt == 7  # _NNS_FLOAT32
+        assert [d.AsInt for d in t0[2].AsTypedVector] == [4, 3, 2, 1]
+        np.testing.assert_array_equal(
+            np.frombuffer(bytes(t0[3].AsBlob), np.float32).reshape(2, 3, 4),
+            _buf().tensors[0])
+        assert m["tensor_1"].AsVector[1].AsInt == 5  # _NNS_UINT8
+        assert m["tensor_2"].AsVector[1].AsInt == 8  # _NNS_INT64
+
+    def test_we_parse_reference_payload(self):
+        a = np.arange(12, dtype=np.int16).reshape(3, 4)
+        b = np.array([1.5, -2.5], np.float64)
+        blob = _ref_peer_encode([a, b], names=["scores", None],
+                                rate=(25, 1))
+        out = decode_flexbuf(blob)
+        assert out.num_tensors == 2
+        assert out.tensors[0].shape == (1, 1, 3, 4)
+        np.testing.assert_array_equal(out.tensors[0].reshape(3, 4), a)
+        assert out.tensors[1].dtype == np.float64
+        np.testing.assert_array_equal(out.tensors[1].reshape(2), b)
+        assert str(out.meta["framerate"]) == "25/1"
+        assert out.meta["format"] == "static"
+        assert out.meta["tensor_names"] == ["scores", None]
+
+    def test_byte_identical_serialization(self):
+        """Same logical frame → byte-identical output from our codec and
+        the reference call sequence (proves we make exactly the builder
+        calls tensordec-flexbuf.cc:138-168 makes)."""
+        from nnstreamer_tpu.tensors.types import Fraction
+
+        frame = _buf()
+        ours = encode_flexbuf(frame, rate=Fraction(15, 2))
+        theirs = _ref_peer_encode(list(frame.tensors), rate=(15, 2))
+        assert ours == theirs
+
+    def test_fp16_refused(self):
+        buf = TensorBuffer([np.zeros((2, 2), np.float16)])
+        with pytest.raises(ValueError, match="tensor_type"):
+            encode_flexbuf(buf)
+
+    def test_rank5_goes_to_native_framing(self):
+        buf = TensorBuffer([np.zeros((1, 2, 3, 4, 5), np.float32)])
+        with pytest.raises(ValueError, match="nnstpu-flex"):
+            encode_flexbuf(buf)
+        out = decode_flex(encode_flex(buf))  # native framing handles it
+        assert out.tensors[0].shape == (1, 2, 3, 4, 5)
+
+    def test_bad_wire_values_refused(self):
+        a = np.zeros((2,), np.float32)
+        blob = _ref_peer_encode([a], fmt=9)
+        with pytest.raises(ValueError, match="tensor_format"):
+            decode_flexbuf(blob)
+        from flatbuffers import flexbuffers
+
+        fbb = flexbuffers.Builder()
+        with fbb.Map():
+            fbb.Key("num_tensors")
+            fbb.UInt(1)
+            fbb.Key("rate_n")
+            fbb.Int(0)
+            fbb.Key("rate_d")
+            fbb.Int(1)
+            fbb.Key("format")
+            fbb.Int(0)
+            fbb.Key("tensor_0")
+            with fbb.Vector():
+                fbb.String("")
+                fbb.Int(99)  # not a tensor_type
+                fbb.TypedVectorFromElements([1, 1, 1, 1])
+                fbb.Blob(b"\x00")
+        with pytest.raises(ValueError, match="tensor_type"):
+            decode_flexbuf(bytes(fbb.Finish()))
+
+    def test_converter_keeps_wire_meta(self):
+        """pipeline converter path surfaces framerate/names from the wire."""
+        from nnstreamer_tpu.converters.flexbuf import FlexBufConverter
+
+        blob = _ref_peer_encode([np.zeros(2, np.float32)], names=["probs"],
+                                rate=(10, 1))
+        out = FlexBufConverter().convert(
+            TensorBuffer([np.frombuffer(blob, np.uint8)]), None)
         assert str(out.meta["framerate"]) == "10/1"
         assert out.meta["tensor_names"] == ["probs"]
