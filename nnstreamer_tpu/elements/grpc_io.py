@@ -4,7 +4,10 @@ Reference: ``ext/nnstreamer/tensor_source/tensor_src_grpc.c`` (515 LoC) and
 ``ext/nnstreamer/tensor_sink/tensor_sink_grpc.c`` (396 LoC): each element
 runs either as a gRPC *server* or *client* (``server`` property), src
 yields buffers received over TensorService, sink ships buffers out;
-``idl`` selects the payload encoding (protobuf | flexbuf).
+``idl`` selects the payload encoding: protobuf | flexbuf | flatbuf
+(reference-layout, interoperable with a reference nnstreamer peer;
+rank-4 normalizing, no pts) or nnstpu-flex (framework-native framing —
+carries pts, allows rank>4/fp16, our peers only).
 
 Roles (mirroring the reference's mode matrix):
 - src  + server=true : hosts the service; remote clients stream tensors IN

@@ -10,9 +10,12 @@ nnstreamer_grpc_common.h:32) exposing ``TensorService`` from
     }
 
 Same service shape here, built on grpcio generic handlers with the
-framework's own wire codecs as (de)serializers — protobuf ``Tensors``
-messages (decoders/protobuf_codec.py, wire-compatible field layout) or
-flexbuf (``idl`` option), no generated stubs. In the TPU deployment this
+framework's own wire codecs as (de)serializers — the ``idl`` option
+picks protobuf / flexbuf / flatbuf (all reference-layout, interoperable
+with a reference nnstreamer peer, rank-4 normalizing, no pts on the
+wire) or ``nnstpu-flex`` (framework-native framing: carries pts,
+allows rank>4 and fp16/bf16, but only our peers parse it); no
+generated stubs. In the TPU deployment this
 is the DCN ingress/egress: frames arrive over gRPC, flow device-resident
 through the pipeline, and results stream back; intra-slice movement is
 XLA collectives, never this path (SURVEY §5 distributed-backend mapping).

@@ -62,12 +62,14 @@ _BUILTIN_PROVIDERS: Dict[str, Dict[str, str]] = {
         "direct_video": "nnstreamer_tpu.decoders.direct_video",
         "octet_stream": "nnstreamer_tpu.decoders.octet_stream",
         "flexbuf": "nnstreamer_tpu.decoders.flexbuf",
+        "nnstpu-flex": "nnstreamer_tpu.decoders.flexbuf",
         "protobuf": "nnstreamer_tpu.decoders.protobuf_codec",
         "flatbuf": "nnstreamer_tpu.decoders.flatbuf_codec",
         "python3": "nnstreamer_tpu.decoders.python3",
     },
     CONVERTER: {
         "flexbuf": "nnstreamer_tpu.converters.flexbuf",
+        "nnstpu-flex": "nnstreamer_tpu.converters.flexbuf",
         "protobuf": "nnstreamer_tpu.converters.protobuf_codec",
         "flatbuf": "nnstreamer_tpu.decoders.flatbuf_codec",
         "python3": "nnstreamer_tpu.converters.python3",

@@ -30,8 +30,8 @@ def test_service_send_roundtrip(idl):
         client.send_stream(iter(_frames()))
         client.close()
         assert len(got) == 4
-        # protobuf is rank-4 normalizing on the wire (reference parity);
-        # flexbuf/flatbuf preserve rank exactly
+        # all three reference codecs (protobuf/flexbuf/flatbuf) are
+        # rank-4 normalizing on the wire; only nnstpu-flex keeps rank
         np.testing.assert_array_equal(
             got[2].tensors[0].reshape(2, 3),
             np.full((2, 3), 2, np.float32))
