@@ -1,12 +1,21 @@
 """tensor_sparse_enc / tensor_sparse_dec — dense↔sparse transcoding.
 
 Reference: ``gst/nnstreamer/elements/gsttensorsparseenc.c`` (414 LoC) /
-``...dec.c`` (408) + ``tensor_sparse_util.c``: COO-style encoding (nnz
-indices + values) of mostly-zero tensors to save transport bandwidth,
-emitted as flexible-format buffers with self-describing headers.
+``...dec.c`` (408) + ``tensor_sparse/tensor_sparse_util.c``: COO-style
+encoding (nnz values + flat indices) of mostly-zero tensors to save
+transport bandwidth, emitted as flexible-format buffers with
+self-describing headers.
 
-Wire layout per tensor (after the TensorMetaInfo header, which carries the
-dense dim/type and nnz): uint32 flat indices [nnz] then values [nnz].
+Two selectable wire layouts (``layout`` property on the encoder; the
+decoder sniffs the header and accepts both):
+
+- ``reference`` (default): byte-exact ``GstTensorMetaInfo`` v1 header
+  (128 B) + values[nnz] + uint32 flat indices[nnz] — the order
+  gst_tensor_sparse_from_dense writes (tensor_sparse_util.c:236-240)
+  — so streams interoperate with reference sparse_dec peers.
+- ``native``: the framework's TMI1 header + uint32 indices[nnz] +
+  values[nnz]; supports rank>4 and fp16/bf16 tensors the reference
+  enum cannot express.
 """
 
 from __future__ import annotations
@@ -15,7 +24,7 @@ import numpy as np
 
 from nnstreamer_tpu.pipeline.element import Element
 from nnstreamer_tpu.registry import ELEMENT, subplugin
-from nnstreamer_tpu.tensors.meta import HEADER_SIZE, TensorMetaInfo
+from nnstreamer_tpu.tensors.meta import TensorMetaInfo, parse_header
 from nnstreamer_tpu.tensors.types import (
     TensorFormat,
     TensorInfo,
@@ -23,39 +32,60 @@ from nnstreamer_tpu.tensors.types import (
 )
 
 
-def sparse_encode(arr: np.ndarray) -> bytes:
+def sparse_encode(arr: np.ndarray, layout: str = "reference") -> bytes:
     from nnstreamer_tpu import native
 
+    if layout not in ("reference", "native"):
+        raise ValueError(f"sparse_encode: unknown layout {layout!r} "
+                         "(reference|native)")
     arr = np.ascontiguousarray(np.asarray(arr))
     idx, vals = native.sparse_encode_arrays(arr)  # GIL-free scan in C++
     meta = TensorMetaInfo.from_info(
         TensorInfo.from_array(arr), format=TensorFormat.SPARSE,
         sparse_nnz=int(idx.size),
     )
+    if layout == "reference":
+        # values first, then indices (tensor_sparse_util.c:236-240)
+        return meta.pack_ref() + vals.tobytes() + idx.tobytes()
     return meta.pack() + idx.tobytes() + vals.tobytes()
 
 
 def sparse_decode(blob: bytes, offset: int = 0):
-    meta = TensorMetaInfo.unpack(blob[offset:offset + HEADER_SIZE])
+    from nnstreamer_tpu import native
+    from nnstreamer_tpu.tensors.meta import REF_HEADER_SIZE
+
+    meta, hsize = parse_header(blob, offset)
     if meta.format is not TensorFormat.SPARSE:
         raise ValueError("sparse_decode: not a sparse payload")
-    from nnstreamer_tpu import native
-
     nnz = meta.sparse_nnz
     dtype = meta.type.np_dtype
-    p = offset + HEADER_SIZE
-    idx = np.frombuffer(blob[p:p + 4 * nnz], np.uint32)
-    p += 4 * nnz
-    vals = np.frombuffer(blob[p:p + dtype.itemsize * nnz], dtype)
-    p += dtype.itemsize * nnz
+    p = offset + hsize
+    end = p + (dtype.itemsize + 4) * nnz
+    if len(blob) < end:
+        raise ValueError(f"sparse_decode: truncated payload ({len(blob)} "
+                         f"bytes, header promises {end})")
+    if hsize == REF_HEADER_SIZE:
+        # reference order: values then flat indices
+        vals = np.frombuffer(blob[p:p + dtype.itemsize * nnz], dtype)
+        idx = np.frombuffer(blob[p + dtype.itemsize * nnz:end], np.uint32)
+    else:
+        idx = np.frombuffer(blob[p:p + 4 * nnz], np.uint32)
+        vals = np.frombuffer(blob[p + 4 * nnz:end], dtype)
     info = meta.to_info()
+    if nnz and int(idx.max()) >= info.num_elements:
+        raise ValueError(f"sparse_decode: index {int(idx.max())} outside "
+                         f"dense tensor of {info.num_elements} elements")
     dense = native.sparse_decode_arrays(idx, vals, info.num_elements)
-    return dense.reshape(info.shape), p
+    return dense.reshape(info.shape), end
 
 
 @subplugin(ELEMENT, "tensor_sparse_enc")
 class TensorSparseEnc(Element):
     ELEMENT_NAME = "tensor_sparse_enc"
+    PROPERTIES = {
+        **Element.PROPERTIES,
+        "layout": "reference",
+    }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
@@ -66,8 +96,12 @@ class TensorSparseEnc(Element):
         return TensorsConfig(format=TensorFormat.SPARSE).to_caps()
 
     def chain(self, pad, buf):
+        layout = self.get_property("layout")
+        if layout not in ("reference", "native"):
+            raise ValueError(f"tensor_sparse_enc: unknown layout {layout!r} "
+                             "(reference|native)")
         host = buf.to_host()  # applies any deferred finalize exactly once
-        blobs = [np.frombuffer(sparse_encode(t), np.uint8)
+        blobs = [np.frombuffer(sparse_encode(t, layout=layout), np.uint8)
                  for t in host.tensors]
         return self.srcpad.push(host.with_tensors(blobs))
 
