@@ -39,6 +39,10 @@ class TensorAggregator(Element):
         #: multi-tensor stream is aggregated, none silently dropped
         self._windows: List[List[np.ndarray]] = []
         self._pts: Optional[int] = None
+        #: capture timestamps of the unit frames in flight, parallel to the
+        #: windows — emitted as meta["create_ts"] so end-to-end latency
+        #: under micro-batching includes each frame's batch-window wait
+        self._create_ts: List[float] = []
 
     def transform_caps(self, pad, caps):
         return None  # announced from the first output (shape changes)
@@ -63,6 +67,15 @@ class TensorAggregator(Element):
         if self._pts is None:
             self._pts = buf.pts
         n = max(fin, 1)
+        stamps = buf.meta.get("create_ts") or (
+            [buf.meta["create_t"]] if "create_t" in buf.meta else ())
+        if stamps:
+            # one stamp per unit frame: replicate a singular stamp across
+            # the frames_in split; an upstream aggregate already carries
+            # per-frame stamps (pad short lists with the last stamp)
+            if len(stamps) < n:
+                stamps = list(stamps) + [stamps[-1]] * (n - len(stamps))
+            self._create_ts.extend(stamps[:n] if n > 1 else stamps)
         for ti, arr in enumerate(buf.tensors):
             axis = self._axis(arr)
             # split the incoming tensor into its `frames_in` unit frames
@@ -100,13 +113,18 @@ class TensorAggregator(Element):
                 self.srcpad.set_caps(
                     TensorsConfig.from_arrays(outs).to_caps()
                 )
+            meta = {}
+            if self._create_ts:
+                meta["create_ts"] = list(self._create_ts[:fout])
             ret = self.srcpad.push(
-                TensorBuffer(outs, pts=self._pts)
+                TensorBuffer(outs, pts=self._pts, meta=meta)
             )
             self._windows = [w[flush:] for w in self._windows]
+            self._create_ts = self._create_ts[flush:]
             self._pts = buf.pts
         return ret
 
     def handle_eos(self):
         self._windows.clear()
+        self._create_ts.clear()
         self._pts = None

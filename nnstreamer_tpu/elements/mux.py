@@ -64,14 +64,21 @@ class TensorMux(Element):
     def _emit(self, frame):
         tensors = []
         pts = None
+        create_ts = []
         for _, buf in frame:
             tensors.extend(buf.tensors)
             if buf.pts is not None:
                 pts = max(pts, buf.pts) if pts is not None else buf.pts
+            # singular stamp from plain sources, plural from upstream
+            # aggregators/muxes — keep every constituent frame's stamp
+            stamps = buf.meta.get("create_ts") or (
+                [buf.meta["create_t"]] if "create_t" in buf.meta else ())
+            create_ts.extend(stamps)
         if self.srcpad.caps is None:
             self._announce_caps(frame)
+        meta = {"create_ts": create_ts} if create_ts else {}
         self.srcpad.push(TensorBuffer(tensors[:NNS_TENSOR_SIZE_LIMIT],
-                                      pts=pts))
+                                      pts=pts, meta=meta))
 
     def _announce_caps(self, frame):
         cfgs = []

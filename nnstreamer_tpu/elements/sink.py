@@ -8,6 +8,8 @@ are used throughout the reference's SSAT golden tests (dump + byte-compare).
 from __future__ import annotations
 
 import threading
+import time
+from collections import deque
 from typing import Callable, List, Optional
 
 import numpy as np
@@ -43,6 +45,9 @@ class TensorSink(Element):
         self._callbacks: List[Callable[[TensorBuffer], None]] = []
         self._cv = threading.Condition()
         self.eos = False
+        #: end-to-end per-frame latencies in seconds (create_t → chain);
+        #: ring-bounded so long-lived live pipelines don't grow forever
+        self.latencies: deque = deque(maxlen=100_000)
 
     def connect(self, callback: Callable[[TensorBuffer], None]) -> None:
         """Register a per-buffer callback (reference ``new-data`` signal)."""
@@ -55,6 +60,21 @@ class TensorSink(Element):
         # stage's tensors, e.g. two scalars, never full frames)
         if self.get_property("to_host") or buf.finalize is not None:
             buf = buf.to_host()
+        # end-to-end frame latency: source create() → here (payload is
+        # host-materialized above). Under micro-batching meta carries one
+        # capture stamp per constituent frame, so each frame's latency
+        # includes its batch-window wait (BASELINE.md north-star metric;
+        # the reference self-measures around its hot path the same way,
+        # tensor_filter.c:349-423).
+        # only record once the payload is actually host-resident —
+        # recording a device handle's arrival would measure dispatch
+        # enqueue, not completion (the round-3 bench-honesty rule)
+        if not buf.on_device():
+            now = time.monotonic()
+            stamps = buf.meta.get("create_ts") or (
+                [buf.meta["create_t"]] if "create_t" in buf.meta else ())
+            if stamps:
+                self.latencies.extend(now - t for t in stamps)
         with self._cv:
             if len(self.buffers) < int(self.get_property("max_stored")):
                 self.buffers.append(buf)
@@ -62,6 +82,16 @@ class TensorSink(Element):
         for cb in self._callbacks:
             cb(buf)
         return FlowReturn.OK
+
+    def latency_percentiles(self, *qs: float):
+        """End-to-end frame latency percentiles in ms (create→sink), the
+        queryable pipeline stat counterpart of the per-element
+        InvokeStats. Default (p50, p99)."""
+        if not self.latencies:
+            return None
+        qs = qs or (50.0, 99.0)
+        vals = np.asarray(self.latencies, dtype=np.float64) * 1e3
+        return tuple(float(np.percentile(vals, q)) for q in qs)
 
     def sink_event(self, pad, event):
         if isinstance(event, EosEvent):

@@ -20,6 +20,7 @@ from __future__ import annotations
 import enum
 import queue as _queue
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from nnstreamer_tpu.log import get_logger
@@ -86,6 +87,12 @@ class SourceElement(Element):
                 buf = self.create()
                 if buf is None:
                     break
+                # capture-time stamp for end-to-end frame latency: sinks
+                # measure now-create_t at materialization (the reference
+                # self-measures exactly this around its hot path,
+                # tensor_filter.c:349-423). appsrc callers may pre-set it.
+                if "create_t" not in buf.meta:
+                    buf.meta["create_t"] = time.monotonic()
                 ret = self.srcpad.push(buf)
                 if ret is FlowReturn.EOS:
                     break

@@ -216,3 +216,53 @@ class TestPlatformProbe:
         assert plat.ensure_jax_platform() == "cpu"
         assert plat.ensure_jax_platform() == "cpu"
         assert len(calls) == 1  # second call served from the cache
+
+
+class TestEndToEndLatency:
+    """North-star latency stat: source create() stamps, sink measures at
+    materialization (BASELINE.md; reference tensor_filter.c:349-423)."""
+
+    def test_latency_recorded_per_frame(self):
+        from nnstreamer_tpu import parse_launch
+
+        pipe = parse_launch(
+            "videotestsrc num-buffers=6 width=8 height=8 ! "
+            "tensor_converter ! tensor_sink name=out")
+        msg = pipe.run(timeout=30)
+        assert msg is not None and msg.kind == "eos"
+        sink = pipe.get("out")
+        assert len(sink.latencies) == 6
+        p50, p99 = sink.latency_percentiles(50, 99)
+        assert 0 < p50 <= p99 < 10_000
+
+    def test_microbatched_latency_counts_batch_wait(self):
+        """Aggregated buffers carry one stamp per constituent frame, so
+        latency includes the batch-window wait and the count equals the
+        FRAME count, not the buffer count."""
+        from nnstreamer_tpu import parse_launch
+
+        pipe = parse_launch(
+            "videotestsrc num-buffers=8 width=8 height=8 ! "
+            "tensor_converter ! "
+            "tensor_aggregator frames-in=1 frames-out=4 frames-flush=4 "
+            "frames-dim=3 concat=true ! tensor_sink name=out")
+        msg = pipe.run(timeout=30)
+        assert msg is not None and msg.kind == "eos"
+        sink = pipe.get("out")
+        assert len(sink.buffers) == 2
+        assert len(sink.latencies) == 8  # per frame, not per buffer
+        assert sink.latency_percentiles() is not None
+
+    def test_mux_latency_spans_all_streams(self):
+        from nnstreamer_tpu import parse_launch
+
+        pipe = parse_launch(
+            "tensor_mux name=m sync-mode=slowest ! tensor_sink name=out "
+            "videotestsrc num-buffers=3 width=4 height=4 ! "
+            "tensor_converter ! m. "
+            "videotestsrc num-buffers=3 width=4 height=4 ! "
+            "tensor_converter ! m.")
+        msg = pipe.run(timeout=30)
+        assert msg is not None and msg.kind == "eos"
+        sink = pipe.get("out")
+        assert len(sink.latencies) == 6  # 3 muxed frames x 2 streams
