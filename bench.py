@@ -628,6 +628,43 @@ def measure_decode() -> dict:
                 frames=len(frame_t) * K)
 
 
+def _hbm_bandwidth_probe(mb: int = 256, iters: int = 10):
+    """Measured HBM read bandwidth (bytes/s): a reduction over a
+    device-resident array is memory-bound, so bytes/time is the
+    achievable stream rate — the roofline denominator for decode."""
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from jax import lax
+
+        n = mb * (1 << 20) // 2  # bf16 elements
+        passes = 50  # in-program passes amortize the per-dispatch RPC
+        x = jax.device_put(jnp.ones((n,), jnp.bfloat16))
+
+        @jax.jit
+        def f(a):
+            # each pass re-reads the full array: the elementwise max
+            # against the evolving accumulator cannot be hoisted or
+            # factored out of the reduction, and max+reduce fuse, so the
+            # loop body is a pure streaming read
+            return lax.fori_loop(
+                0, passes,
+                lambda i, acc: acc + jnp.sum(jnp.maximum(
+                    a, acc.astype(jnp.bfloat16)).astype(jnp.float32)),
+                jnp.float32(0.0))
+
+        np.asarray(f(x))  # compile + warm
+        t0 = time.perf_counter()
+        outs = [f(x) for _ in range(iters)]
+        np.asarray(outs[-1])
+        dt = time.perf_counter() - t0
+        return 2.0 * n * passes * iters / dt
+    except Exception as e:  # noqa: BLE001 — roofline is informative
+        print(f"bench: hbm probe failed ({e})", file=sys.stderr)
+        return None
+
+
 def measure_serve() -> dict:
     """Continuous-batching serving: 8 concurrent streams share one batched
     KV-cached decode program (serving/engine.py). Metric: aggregate
@@ -666,8 +703,40 @@ def measure_serve() -> dict:
         dt = _t.monotonic() - t0
     finally:
         engine.stop()
+    tps = total / dt
+
+    # ---- roofline: the decode ceiling this config could ever reach ----
+    # every decode step streams all params plus the full static KV cache
+    # from HBM and yields max_streams tokens, so
+    #   bytes/token = (params_bytes + cache_bytes) / max_streams
+    # and tokens_per_s_ceiling = measured HBM bandwidth / bytes_per_token
+    # (jax-ml.github.io/scaling-book's bandwidth-bound decode recipe)
+    import jax
+
+    from nnstreamer_tpu.models.transformer import init_cache
+
+    n_params = sum(int(np.prod(v.shape))
+                   for v in jax.tree_util.tree_leaves(
+                       jax.eval_shape(lambda: init_params(cfg))))
+    itemsize = np.dtype(jnp.bfloat16).itemsize
+    params_bytes = n_params * itemsize
+    cache_bytes = sum(
+        int(np.prod(a.shape)) * a.dtype.itemsize
+        for a in jax.tree_util.tree_leaves(
+            jax.eval_shape(lambda: init_cache(cfg, batch=8))))
+    bytes_per_token = (params_bytes + cache_bytes) / 8
+    bw = _hbm_bandwidth_probe()
+    peak = _peak_flops()
+    ceiling = bw / bytes_per_token if bw else None
     return dict(metric="serving_aggregate_tokens_per_s_d512_l8_x8streams",
-                fps=total / dt, frames=total)
+                fps=tps, frames=total,
+                hbm_bandwidth_gbps=round(bw / 1e9, 1) if bw else None,
+                model_mbytes=round(params_bytes / 1e6, 1),
+                kv_cache_mbytes=round(cache_bytes / 1e6, 1),
+                tokens_per_s_ceiling=round(ceiling, 1) if ceiling else None,
+                vs_ceiling=round(tps / ceiling, 4) if ceiling else None,
+                mfu_serve=round(tps * 2 * n_params / peak, 5)
+                if peak else None)
 
 
 def measure_spec() -> dict:

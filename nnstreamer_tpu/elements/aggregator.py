@@ -67,15 +67,16 @@ class TensorAggregator(Element):
         if self._pts is None:
             self._pts = buf.pts
         n = max(fin, 1)
-        stamps = buf.meta.get("create_ts") or (
-            [buf.meta["create_t"]] if "create_t" in buf.meta else ())
+        stamps = buf.create_stamps()
         if stamps:
-            # one stamp per unit frame: replicate a singular stamp across
-            # the frames_in split; an upstream aggregate already carries
-            # per-frame stamps (pad short lists with the last stamp)
-            if len(stamps) < n:
-                stamps = list(stamps) + [stamps[-1]] * (n - len(stamps))
-            self._create_ts.extend(stamps[:n] if n > 1 else stamps)
+            # exactly one stamp per unit frame keeps the stamp list in
+            # lockstep with the windows; when the carried stamp count
+            # doesn't match the frames_in split (e.g. a muxed buffer with
+            # one stamp per input stream), use the EARLIEST stamp for all
+            # of them — conservative (reports the longest latency)
+            if len(stamps) != n:
+                stamps = [min(stamps)] * n
+            self._create_ts.extend(stamps)
         for ti, arr in enumerate(buf.tensors):
             axis = self._axis(arr)
             # split the incoming tensor into its `frames_in` unit frames

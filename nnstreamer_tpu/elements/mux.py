@@ -69,11 +69,9 @@ class TensorMux(Element):
             tensors.extend(buf.tensors)
             if buf.pts is not None:
                 pts = max(pts, buf.pts) if pts is not None else buf.pts
-            # singular stamp from plain sources, plural from upstream
-            # aggregators/muxes — keep every constituent frame's stamp
-            stamps = buf.meta.get("create_ts") or (
-                [buf.meta["create_t"]] if "create_t" in buf.meta else ())
-            create_ts.extend(stamps)
+            # keep every constituent frame's stamp (singular from plain
+            # sources, plural from upstream aggregators/muxes)
+            create_ts.extend(buf.create_stamps())
         if self.srcpad.caps is None:
             self._announce_caps(frame)
         meta = {"create_ts": create_ts} if create_ts else {}

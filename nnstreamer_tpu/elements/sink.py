@@ -71,8 +71,7 @@ class TensorSink(Element):
         # enqueue, not completion (the round-3 bench-honesty rule)
         if not buf.on_device():
             now = time.monotonic()
-            stamps = buf.meta.get("create_ts") or (
-                [buf.meta["create_t"]] if "create_t" in buf.meta else ())
+            stamps = buf.create_stamps()
             if stamps:
                 self.latencies.extend(now - t for t in stamps)
         with self._cv:

@@ -97,6 +97,18 @@ class TensorBuffer:
     def nbytes(self) -> int:
         return sum(int(np.prod(t.shape)) * t.dtype.itemsize for t in self.tensors)
 
+    def create_stamps(self):
+        """Capture timestamps carried in meta for end-to-end latency:
+        the plural ``create_ts`` (aggregated/muxed frames, one stamp per
+        constituent frame) or the singular ``create_t`` a source
+        stamped. Returns a (possibly empty) list."""
+        stamps = self.meta.get("create_ts")
+        if stamps:
+            return list(stamps)
+        if "create_t" in self.meta:
+            return [self.meta["create_t"]]
+        return []
+
     def on_device(self) -> bool:
         return bool(self.tensors) and all(is_device_array(t) for t in self.tensors)
 
