@@ -26,6 +26,22 @@ from nnstreamer_tpu.tensors.types import TensorsInfo
 from nnstreamer_tpu.utils.stats import InvokeStats
 
 
+def parse_custom(custom: Optional[str]) -> Dict[str, str]:
+    """Parse the backend-agnostic ``custom`` option string:
+    comma-separated ``key:value`` (or ``key=value``) pairs. Values may
+    carry ';'-separated lists (e.g. multiple tensor names) — the comma
+    is the only pair separator."""
+    out: Dict[str, str] = {}
+    for part in (custom or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        sep = ":" if ":" in part else "="
+        k, _, v = part.partition(sep)
+        out[k.strip()] = v.strip()
+    return out
+
+
 @dataclasses.dataclass
 class FilterProperties:
     """Everything a backend needs at open() time (reference

@@ -231,13 +231,23 @@ class JaxFilter(FilterFramework):
                 os.path.isdir(model)
                 and os.path.isfile(os.path.join(model, "saved_model.pb"))):
             # the reference runs these via libtensorflow
-            # (tensor_filter_tensorflow.cc:785); the TPU-native route is a
-            # one-time offline export to StableHLO
+            # (tensor_filter_tensorflow.cc:785); the TPU-native route
+            # stages the graph through TF's XLA bridge to StableHLO at
+            # open() when tensorflow is importable (filters/tf_backend),
+            # else falls back to the offline-export recipe
+            from nnstreamer_tpu.filters.tf_backend import (
+                have_tensorflow,
+                tf_model_entry,
+            )
+
+            if have_tensorflow():
+                return tf_model_entry(model, custom=props.custom,
+                                      props_in_info=props.input_info)
             raise ValueError(
-                f"jax: {model!r} is a TensorFlow GraphDef/SavedModel; "
-                "export it to a StableHLO artifact first (see "
-                "docs/model-artifacts.md, 'TensorFlow models') and load "
-                "the .stablehlo file instead"
+                f"jax: {model!r} is a TensorFlow GraphDef/SavedModel and "
+                "tensorflow is not importable here; export it to a "
+                "StableHLO artifact first (see docs/model-artifacts.md, "
+                "'TensorFlow models') and load the .stablehlo file instead"
             )
         raise ValueError(
             f"jax: cannot load model {model!r} (not registered, not a .py/"

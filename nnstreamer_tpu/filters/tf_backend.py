@@ -1,0 +1,214 @@
+"""TensorFlow filter backend — direct in-process SavedModel/GraphDef
+ingestion.
+
+Reference: ``ext/nnstreamer/tensor_filter/tensor_filter_tensorflow.cc``
+(785 LoC) runs TF graphs in-process via libtensorflow Session::Run. The
+TPU-native route never runs TF at stream time: at ``open()`` the graph
+is staged once through TF's own XLA bridge —
+``tf.function(jit_compile=True)`` →
+``experimental_get_compiler_ir(stage="stablehlo")`` — and the resulting
+StableHLO module is wrapped into a ``jax.export.Exported``
+(``filters/artifact.py`` raw-module path). From then on the model is an
+ordinary jittable XLA callee: device-resident, fusable into pipeline
+regions, no TF in the hot loop.
+
+``framework=tensorflow model=saved_model_dir`` (or ``model.pb`` frozen
+GraphDef with ``inputname``/``outputname`` in the ``custom`` option,
+mirroring the reference's required input/output properties). The
+offline export recipe (docs/model-artifacts.md) remains the fallback
+when ``tensorflow`` is not importable.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from nnstreamer_tpu.filters.jax_backend import JaxFilter
+from nnstreamer_tpu.log import get_logger
+from nnstreamer_tpu.registry import FILTER, subplugin
+
+log = get_logger("filter.tf")
+
+
+def have_tensorflow() -> bool:
+    try:
+        import tensorflow  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+from nnstreamer_tpu.filters.api import parse_custom as _parse_custom
+
+
+def _concrete_to_stablehlo(tf_callable, specs, name: str):
+    """Stage a TF callable to StableHLO text via TF's XLA bridge and
+    wrap it as a jax.export.Exported (platform-agnostic raw module)."""
+    import jax
+    import tensorflow as tf
+
+    from nnstreamer_tpu.filters.artifact import _exported_from_raw_module
+
+    fn = tf.function(tf_callable, jit_compile=True,
+                     input_signature=specs)
+    ir = fn.experimental_get_compiler_ir(*specs)(stage="stablehlo")
+    if isinstance(ir, bytes):
+        ir = ir.decode()
+    return _exported_from_raw_module(ir.encode(), jax.default_backend(),
+                                     name)
+
+
+def _static_specs(specs, model: str):
+    import tensorflow as tf
+
+    fixed = []
+    for s in specs:
+        if s.shape.rank is None or any(d is None for d in s.shape):
+            raise ValueError(
+                f"tensorflow: {model!r} input {s.name or ''} has dynamic "
+                f"shape {s.shape} — XLA needs static shapes; set the "
+                "input property on tensor_filter (input=DIMS "
+                "inputtype=TYPE) to pin it")
+        fixed.append(tf.TensorSpec(s.shape, s.dtype, name=s.name))
+    return fixed
+
+
+def _stage_entry(call, specs, model: str, what: str) -> dict:
+    """Stage a TF callable and build the backend entry dict
+    (fn/params/in_info/out_info) — the same shape ``artifact_entry``
+    returns."""
+    from nnstreamer_tpu.filters.artifact import artifact_tensors_info
+
+    exp = _concrete_to_stablehlo(call, specs, os.path.basename(model))
+    in_info, out_info = artifact_tensors_info(exp)
+    log.info("tensorflow: staged %s %s to StableHLO (%d inputs -> %d "
+             "outputs)", what, model, len(in_info), len(out_info))
+
+    def fn(*xs):
+        out = exp.call(*xs)
+        return out if isinstance(out, (list, tuple)) else (out,)
+
+    return dict(fn=fn, params=None, in_info=in_info, out_info=out_info,
+                exported=exp)
+
+
+def saved_model_entry(model: str, signature: Optional[str] = None,
+                      props_in_info=None) -> dict:
+    """SavedModel dir → backend entry dict (fn/params/in_info/out_info),
+    the same shape ``artifact_entry`` returns."""
+    import tensorflow as tf
+
+    sm = tf.saved_model.load(model)
+    sig_name = signature or "serving_default"
+    if sig_name not in sm.signatures:
+        raise ValueError(
+            f"tensorflow: SavedModel {model!r} has no signature "
+            f"{sig_name!r} (available: {sorted(sm.signatures)})")
+    cf = sm.signatures[sig_name]
+    kwargs_sig = cf.structured_input_signature[1]
+    names = sorted(kwargs_sig)  # deterministic positional order (matches
+    # TF nest's sorted-key dict flattening, so frozen.inputs line up)
+    specs = [kwargs_sig[n] for n in names]
+    if props_in_info is not None and len(props_in_info) == len(specs):
+        # user-pinned dims (innermost-first) override dynamic dims
+        specs = [tf.TensorSpec(tuple(reversed(ti.dim)), s.dtype,
+                               name=s.name)
+                 for ti, s in zip(props_in_info, specs)]
+    specs = _static_specs(specs, model)
+    # freeze captured variables into graph constants — otherwise TF's
+    # XLA bridge lifts every variable as an extra module parameter and
+    # the staged StableHLO signature stops matching the tensor stream
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2,
+    )
+
+    frozen = convert_variables_to_constants_v2(cf)
+
+    def call(*xs):
+        out = frozen(*xs)
+        return list(out) if isinstance(out, (list, tuple)) else [out]
+
+    return _stage_entry(call, specs, model,
+                        f"SavedModel sig={sig_name}")
+
+
+def graphdef_entry(model: str, custom: Optional[str] = None,
+                   props_in_info=None) -> dict:
+    """Frozen GraphDef ``.pb`` → backend entry. Needs tensor names the
+    way the reference does (tensor_filter_tensorflow.cc requires
+    input/output properties): ``custom="inputname:x,outputname:y"``
+    (comma-separate multiple names with ``;``)."""
+    import tensorflow as tf
+
+    opts = _parse_custom(custom)
+    in_names = [n for n in opts.get("inputname", "").split(";") if n]
+    out_names = [n for n in opts.get("outputname", "").split(";") if n]
+    if not in_names or not out_names:
+        raise ValueError(
+            "tensorflow: a frozen GraphDef needs tensor names — pass "
+            'custom="inputname:input0,outputname:logits" on tensor_filter '
+            "(the reference requires the same via input/output props, "
+            "tensor_filter_tensorflow.cc)")
+    gd = tf.compat.v1.GraphDef()
+    with open(model, "rb") as f:
+        gd.ParseFromString(f.read())
+
+    def _name(t):
+        return t if ":" in t else t + ":0"
+
+    wrapped = tf.compat.v1.wrap_function(
+        lambda: tf.compat.v1.import_graph_def(gd, name=""), [])
+    cf = wrapped.prune([_name(n) for n in in_names],
+                       [_name(n) for n in out_names])
+    # pruned wrap_functions carry no structured signature; their flat
+    # .inputs are the placeholders in the order prune() was given
+    specs = [tf.TensorSpec(t.shape, t.dtype) for t in cf.inputs]
+    if props_in_info is not None and len(props_in_info) == len(specs):
+        specs = [tf.TensorSpec(tuple(reversed(ti.dim)), s.dtype)
+                 for ti, s in zip(props_in_info, specs)]
+    specs = _static_specs(specs, model)
+
+    def call(*xs):
+        out = cf(*xs)
+        return list(out) if isinstance(out, (list, tuple)) else [out]
+
+    return _stage_entry(call, specs, model, "GraphDef")
+
+
+def tf_model_entry(model: str, custom: Optional[str] = None,
+                   props_in_info=None) -> dict:
+    opts = _parse_custom(custom)
+    if os.path.isdir(model):
+        return saved_model_entry(model, signature=opts.get("signature"),
+                                 props_in_info=props_in_info)
+    return graphdef_entry(model, custom=custom, props_in_info=props_in_info)
+
+
+@subplugin(FILTER, "tensorflow")
+class TensorFlowFilter(JaxFilter):
+    """framework=tensorflow — SavedModel/.pb staged through XLA at open().
+
+    Execution inherits the jax backend wholesale (device placement, jit,
+    fusion, stats): after staging, a TF model IS a jax model."""
+
+    NAME = "tensorflow"
+
+    def _load(self, model: str, props):
+        if not have_tensorflow():
+            raise RuntimeError(
+                "tensorflow: the tensorflow package is not importable in "
+                "this environment; export the model offline to StableHLO "
+                "instead (docs/model-artifacts.md, 'TensorFlow models') "
+                "and load it with framework=jax")
+        is_pb = model.endswith(".pb") and os.path.isfile(model)
+        is_sm = os.path.isdir(model) and (
+            os.path.isfile(os.path.join(model, "saved_model.pb")) or
+            os.path.isfile(os.path.join(model, "saved_model.pbtxt")))
+        if not (is_pb or is_sm):
+            raise ValueError(
+                f"tensorflow: {model!r} is neither a SavedModel directory "
+                "nor a frozen .pb GraphDef")
+        return tf_model_entry(model, custom=props.custom,
+                              props_in_info=props.input_info)

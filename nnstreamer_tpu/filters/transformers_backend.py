@@ -35,15 +35,7 @@ from nnstreamer_tpu.registry import FILTER, subplugin
 from nnstreamer_tpu.tensors.types import TensorInfo, TensorsInfo, TensorType
 
 
-def _parse_custom(custom: Optional[str]) -> Dict[str, str]:
-    out: Dict[str, str] = {}
-    for part in (custom or "").split(","):
-        part = part.strip()
-        if not part:
-            continue
-        k, _, v = part.partition(":")
-        out[k.strip()] = v.strip()
-    return out
+from nnstreamer_tpu.filters.api import parse_custom as _parse_custom
 
 
 @subplugin(FILTER, "transformers")

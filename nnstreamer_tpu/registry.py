@@ -49,6 +49,7 @@ _BUILTIN_PROVIDERS: Dict[str, Dict[str, str]] = {
         "custom-easy": "nnstreamer_tpu.filters.custom",
         "tflite": "nnstreamer_tpu.filters.tflite_backend",
         "tensorflow-lite": "nnstreamer_tpu.filters.tflite_backend",
+        "tensorflow": "nnstreamer_tpu.filters.tf_backend",
         "native": "nnstreamer_tpu.filters.native_filter",
         "script": "nnstreamer_tpu.filters.script",
         "pipeline": "nnstreamer_tpu.filters.pipeline_filter",

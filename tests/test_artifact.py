@@ -213,13 +213,22 @@ class TestExportTool:
 
 
 class TestRejections:
-    def test_savedmodel_pb_pointed_error(self, tmp_path):
+    def test_savedmodel_pb_pointed_error(self, tmp_path, monkeypatch):
+        """Without tensorflow importable, TF model paths get the
+        offline-recipe error; with it, they go to in-process ingestion
+        (tests/test_tf_backend.py)."""
+        import nnstreamer_tpu.filters.tf_backend as tfb
+
+        monkeypatch.setattr(tfb, "have_tensorflow", lambda: False)
         pb = tmp_path / "frozen.pb"
         pb.write_bytes(b"\x08\x01")
         with pytest.raises(ValueError, match="StableHLO"):
             SingleShot(framework="jax", model=str(pb))
 
-    def test_savedmodel_dir_pointed_error(self, tmp_path):
+    def test_savedmodel_dir_pointed_error(self, tmp_path, monkeypatch):
+        import nnstreamer_tpu.filters.tf_backend as tfb
+
+        monkeypatch.setattr(tfb, "have_tensorflow", lambda: False)
         d = tmp_path / "sm"
         d.mkdir()
         (d / "saved_model.pb").write_bytes(b"\x08\x01")

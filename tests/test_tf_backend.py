@@ -1,0 +1,145 @@
+"""Direct TensorFlow SavedModel / frozen-GraphDef ingestion
+(filters/tf_backend.py; reference tensor_filter_tensorflow.cc runs TF
+in-process — here the graph stages once through TF's XLA bridge to
+StableHLO and then runs as an ordinary jittable XLA callee)."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from nnstreamer_tpu import parse_launch  # noqa: E402
+from nnstreamer_tpu.filters.tf_backend import tf_model_entry  # noqa: E402
+
+W = np.arange(12, dtype=np.float32).reshape(3, 4)
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tfm") / "sm"
+
+    class M(tf.Module):
+        def __init__(self):
+            self.w = tf.Variable(tf.constant(W))
+
+        @tf.function(input_signature=[tf.TensorSpec([2, 3], tf.float32)])
+        def __call__(self, x):
+            return {"y": tf.matmul(x, self.w) + 1.0}
+
+    tf.saved_model.save(M(), str(d))
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def frozen_pb(tmp_path_factory, saved_model):
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2,
+    )
+
+    sm = tf.saved_model.load(saved_model)
+    frozen = convert_variables_to_constants_v2(
+        sm.signatures["serving_default"])
+    d = tmp_path_factory.mktemp("tfpb")
+    tf.io.write_graph(frozen.graph.as_graph_def(), str(d), "frozen.pb",
+                      as_text=False)
+    inp = frozen.inputs[0].name.split(":")[0]
+    outp = frozen.outputs[0].name.split(":")[0]
+    return str(d / "frozen.pb"), inp, outp
+
+
+class TestSavedModelIngestion:
+    def test_numerics_match_tf(self, saved_model):
+        e = tf_model_entry(saved_model)
+        x = np.random.default_rng(0).normal(size=(2, 3)).astype(np.float32)
+        got = np.asarray(e["fn"](x)[0])
+        np.testing.assert_allclose(got, x @ W + 1.0, rtol=1e-5)
+
+    def test_self_describing_info(self, saved_model):
+        e = tf_model_entry(saved_model)
+        assert [tuple(t.dim) for t in e["in_info"]] == [(3, 2)]
+        assert [tuple(t.dim) for t in e["out_info"]] == [(4, 2)]
+
+    def test_variables_frozen_not_lifted(self, saved_model):
+        """Captured tf.Variables must become module constants, not extra
+        StableHLO parameters (the staged signature must match the
+        tensor stream exactly)."""
+        e = tf_model_entry(saved_model)
+        assert len(e["in_info"]) == 1
+
+    def test_missing_signature_pointed_error(self, saved_model):
+        with pytest.raises(ValueError, match="signature"):
+            tf_model_entry(saved_model, custom="signature:nope")
+
+
+class TestGraphDefIngestion:
+    def test_numerics_match_tf(self, frozen_pb):
+        path, inp, outp = frozen_pb
+        e = tf_model_entry(path, custom=f"inputname:{inp},outputname:{outp}")
+        x = np.random.default_rng(1).normal(size=(2, 3)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(e["fn"](x)[0]), x @ W + 1.0,
+                                   rtol=1e-5)
+
+    def test_names_required(self, frozen_pb):
+        with pytest.raises(ValueError, match="inputname"):
+            tf_model_entry(frozen_pb[0])
+
+
+class TestPipeline:
+    def test_framework_tensorflow_golden(self, tmp_path):
+        """framework=tensorflow model=<SavedModel dir> runs a golden
+        pipeline end to end (VERDICT r3 item 7 done criterion)."""
+
+        class Vision(tf.Module):
+            @tf.function(input_signature=[
+                tf.TensorSpec([1, 4, 4, 3], tf.uint8)])
+            def __call__(self, x):
+                xf = tf.cast(x, tf.float32)
+                return {"mean": tf.reduce_mean(xf, axis=[1, 2, 3])}
+
+        sm = tmp_path / "vision_sm"
+        tf.saved_model.save(Vision(), str(sm))
+        pipe = parse_launch(
+            "videotestsrc num-buffers=3 width=4 height=4 "
+            "pattern=gradient ! tensor_converter ! "
+            f"tensor_filter framework=tensorflow model={sm} ! "
+            "tensor_sink name=out")
+        msg = pipe.run(timeout=120)
+        assert msg is not None and msg.kind == "eos", msg
+        outs = pipe.get("out").buffers
+        assert len(outs) == 3
+        from nnstreamer_tpu.elements.source import VideoTestSrc
+
+        want = float(VideoTestSrc(width=4, height=4, pattern="gradient")
+                     ._frame(0).astype(np.float32).mean())
+        got = float(np.asarray(outs[0].tensors[0])[0])
+        assert abs(got - want) < 1e-3
+
+    def test_framework_jax_delegates_saved_model(self, tmp_path):
+        """framework=jax with a SavedModel path ingests in-process too
+        (the old recipe error only remains when TF is unavailable)."""
+
+        class Tiny(tf.Module):
+            @tf.function(input_signature=[tf.TensorSpec([1, 2],
+                                                        tf.float32)])
+            def __call__(self, x):
+                return {"y": x * 2.0}
+
+        sm = tmp_path / "tiny_sm"
+        tf.saved_model.save(Tiny(), str(sm))
+        from nnstreamer_tpu.filters.jax_backend import JaxFilter
+        from nnstreamer_tpu.filters.api import FilterProperties
+
+        f = JaxFilter()
+        entry = f._load(str(sm), FilterProperties(model=str(sm)))
+        np.testing.assert_allclose(
+            np.asarray(entry["fn"](np.ones((1, 2), np.float32))[0]),
+            [[2.0, 2.0]])
+
+
+def test_custom_multi_names_survive_parsing():
+    """';'-separated multi-tensor-name lists in custom must survive the
+    option parser (inputname:x1;x2,outputname:y)."""
+    from nnstreamer_tpu.filters.api import parse_custom
+
+    opts = parse_custom("inputname:x1;x2,outputname:y")
+    assert opts == {"inputname": "x1;x2", "outputname": "y"}
