@@ -5,9 +5,10 @@ paho; their payloads prepend the fixed 1024-byte ``GstMQTTMessageHdr``
 (``gst/mqtt/mqttcommon.h:49-63``) so any subscriber can reconstruct the
 buffer. This module provides the same capability without paho:
 
-- **packet codec** — CONNECT/CONNACK/SUBSCRIBE/SUBACK/PUBLISH(QoS0,
-  retain)/PING*/DISCONNECT encode+decode per the MQTT 3.1.1 spec
-  (unit-tested always; any conformant broker understands them);
+- **packet codec** — CONNECT/CONNACK/SUBSCRIBE/SUBACK/PUBLISH(QoS0/
+  QoS1, retain)/PUBACK/PING*/DISCONNECT encode+decode per the MQTT
+  3.1.1 spec (unit-tested always; any conformant broker understands
+  them);
 - :class:`MqttClient` — a minimal client (same surface as the in-process
   shim's ``Client``) usable against any broker reachable at
   ``mqtt://host:port``;
@@ -18,9 +19,12 @@ buffer. This module provides the same capability without paho:
   duration/dts/pts, 512-byte caps string, 1024 bytes total), so streams
   interop with reference mqttsink/mqttsrc peers.
 
-QoS0-only by design: tensor streams are latest-wins; the reference's
-default QoS for streams is 0 as well, and retransmit logic belongs to
-the query protocol (which has in-flight windows), not here.
+QoS0 is the stream default (tensor streams are latest-wins, matching
+the reference's default); QoS1 (packet id + PUBACK + DUP retransmit)
+is available per publish/subscribe for control-plane topics, with
+client auto-reconnect/resubscribe and active keepalive failure
+detection mirroring the reference's paho MQTTAsync options
+(gst/mqtt/mqttsink.c).
 """
 
 from __future__ import annotations
@@ -28,6 +32,7 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 import uuid
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -39,6 +44,7 @@ log = get_logger("mqtt")
 CONNECT = 1
 CONNACK = 2
 PUBLISH = 3
+PUBACK = 4
 SUBSCRIBE = 8
 SUBACK = 9
 UNSUBSCRIBE = 10
@@ -103,10 +109,23 @@ def connack_packet(return_code: int = 0,
                    bytes([1 if session_present else 0, return_code]))
 
 
-def publish_packet(topic: str, payload: bytes, retain: bool = False) -> bytes:
-    """QoS0 PUBLISH (no packet id in QoS0, spec 3.3.2.2)."""
-    return _packet(PUBLISH, 0x01 if retain else 0x00,
-                   _utf8(topic) + payload)
+def publish_packet(topic: str, payload: bytes, retain: bool = False,
+                   qos: int = 0, packet_id: Optional[int] = None,
+                   dup: bool = False) -> bytes:
+    """PUBLISH. QoS0 carries no packet id (spec 3.3.2.2); QoS1 requires
+    one and may set DUP on retransmission (3.3.1.1)."""
+    flags = (0x01 if retain else 0) | ((qos & 0x03) << 1) | \
+        (0x08 if dup else 0)
+    body = _utf8(topic)
+    if qos:
+        if packet_id is None:
+            raise ValueError("mqtt: QoS>0 PUBLISH needs a packet id")
+        body += struct.pack(">H", packet_id)
+    return _packet(PUBLISH, flags, body + payload)
+
+
+def puback_packet(packet_id: int) -> bytes:
+    return _packet(PUBACK, 0, struct.pack(">H", packet_id))
 
 
 def subscribe_packet(packet_id: int, topic_filter: str,
@@ -176,15 +195,18 @@ def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     return buf
 
 
-def parse_publish(flags: int, body: bytes) -> Tuple[str, bytes, bool]:
-    """→ (topic, payload, retain). QoS>0 carries a packet id we skip."""
+def parse_publish(flags: int, body: bytes
+                  ) -> Tuple[str, bytes, bool, int, Optional[int]]:
+    """→ (topic, payload, retain, qos, packet_id)."""
     (tlen,) = struct.unpack_from(">H", body)
     topic = body[2:2 + tlen].decode()
     off = 2 + tlen
     qos = (flags >> 1) & 0x03
+    pid = None
     if qos:
+        (pid,) = struct.unpack_from(">H", body, off)
         off += 2
-    return topic, body[off:], bool(flags & 0x01)
+    return topic, body[off:], bool(flags & 0x01), qos, pid
 
 
 def topic_matches(pattern: str, topic: str) -> bool:
@@ -279,71 +301,216 @@ def parse_gst_mqtt_message(data: bytes) -> dict:
 # ---------------------------------------------------------------------------
 
 class MqttClient:
-    """Minimal MQTT 3.1.1 client (QoS0 pub/sub, retain) with the same
-    surface as the shim's ``Client`` so the pubsub elements can swap
-    transports via ``broker=mqtt://host:port``."""
+    """MQTT 3.1.1 client (QoS0/QoS1 pub/sub, retain, auto-reconnect)
+    with the same surface as the shim's ``Client`` so the pubsub
+    elements can swap transports via ``broker=mqtt://host:port``.
+
+    QoS1 publishes keep a packet-id→message in-flight map and
+    retransmit with DUP until PUBACK (spec 4.4, at-least-once — tensor
+    subscribers are latest-wins, so duplicates are harmless). The
+    client auto-reconnects with exponential backoff, re-issues every
+    subscription, and resends unacked QoS1 messages (paho
+    ``MQTTAsync``-style recovery, gst/mqtt/mqttsink.c options).
+    Keepalive failure is detected actively: a PINGREQ with no PINGRESP
+    within 1.5x the ping interval marks the connection dead
+    [MQTT-3.1.2-24]."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 1883,
                  client_id: Optional[str] = None, keepalive: int = 60,
-                 timeout: float = 10.0):
+                 timeout: float = 10.0, reconnect: bool = True,
+                 max_reconnect_attempts: int = 8):
         self.failed = threading.Event()
-        self._subs: List[Tuple[str, Callable[[str, bytes], None]]] = []
+        self._host, self._port = host, port
+        self._timeout = timeout
+        self._keepalive = keepalive
+        self._reconnect = reconnect
+        self._max_attempts = max_reconnect_attempts
+        #: (topic filter, callback, requested qos)
+        self._subs: List[Tuple[str, Callable[[str, bytes], None], int]] = []
         self._lock = threading.Lock()
         self._pid = 0
         self._suback = threading.Event()
         self._suback_codes: Optional[bytes] = None
-        self._sock = socket.create_connection((host, port), timeout=timeout)
-        self._sock.settimeout(timeout)
-        cid = client_id or f"nnstpu-{uuid.uuid4().hex[:12]}"
-        self._sock.sendall(connect_packet(cid, keepalive))
-        pkt = read_packet(self._sock)
-        if pkt is None or pkt[0] != CONNACK or pkt[2][1] != 0:
-            self._sock.close()
-            raise ConnectionError(
-                f"mqtt: CONNECT to {host}:{port} refused "
-                f"(code {pkt[2][1] if pkt else 'EOF'})")
-        self._sock.settimeout(None)
+        #: QoS1 in flight: pid → (topic, payload, retain, acked-event)
+        self._unacked: Dict[int, tuple] = {}
+        self._cid = client_id or f"nnstpu-{uuid.uuid4().hex[:12]}"
+        self._pong_at = time.monotonic()
+        self._ping_at = 0.0
+        self.reconnects = 0  # observable recovery count
+        self._sock = self._connect()
         self._alive = True
+        self._stop_evt = threading.Event()
         self._reader = threading.Thread(target=self._read_loop, daemon=True,
                                         name="mqtt-client-read")
         self._reader.start()
         # keepalive: a conformant broker drops clients silent for
-        # 1.5x the advertised interval [MQTT-3.1.2-24]
-        self._stop_evt = threading.Event()
+        # 1.5x the advertised interval [MQTT-3.1.2-24]; we ping at half
+        # and treat a missing PINGRESP as a dead link
         self._pinger = threading.Thread(
-            target=self._ping_loop, args=(max(1.0, keepalive / 2),),
+            target=self._ping_loop, args=(max(0.5, keepalive / 2),),
             daemon=True, name="mqtt-client-ping")
         self._pinger.start()
+
+    # -- connection management ------------------------------------------
+
+    def _connect(self, timeout: Optional[float] = None) -> socket.socket:
+        sock = socket.create_connection((self._host, self._port),
+                                        timeout=timeout or self._timeout)
+        sock.settimeout(self._timeout)
+        sock.sendall(connect_packet(self._cid, self._keepalive))
+        pkt = read_packet(sock)
+        if pkt is None or pkt[0] != CONNACK or pkt[2][1] != 0:
+            sock.close()
+            raise ConnectionError(
+                f"mqtt: CONNECT to {self._host}:{self._port} refused "
+                f"(code {pkt[2][1] if pkt else 'EOF'})")
+        sock.settimeout(None)
+        self._pong_at = time.monotonic()
+        self._ping_at = 0.0
+        return sock
+
+    def _recover(self) -> bool:
+        """Reconnect with backoff; resubscribe and resend unacked QoS1
+        (DUP set). Returns False when attempts are exhausted — only
+        then does ``failed`` latch."""
+        for attempt in range(self._max_attempts):
+            if not self._alive:
+                return False
+            delay = min(2.0 ** attempt * 0.05, 2.0)
+            if self._stop_evt.wait(delay):
+                return False
+            try:
+                # bounded per-attempt connect so `failed` latches within
+                # seconds, not minutes, when the broker is unreachable
+                sock = self._connect(timeout=min(self._timeout, 2.0))
+            except (OSError, ConnectionError) as e:  # incl. CONNACK refusal
+                log.info("mqtt: reconnect attempt %d failed: %s",
+                         attempt + 1, e)
+                continue
+            # publish the socket, resubscribe, and resend unacked while
+            # holding the lock: app publishers / the pinger must not
+            # interleave writes mid-recovery on the fresh socket
+            with self._lock:
+                self._sock = sock
+                subs = list(self._subs)
+                unacked = list(self._unacked.items())
+                try:
+                    for filt, _cb, qos in subs:
+                        self._pid = self._pid % 0xFFFF + 1
+                        sock.sendall(subscribe_packet(self._pid, filt,
+                                                      qos=qos))
+                    for pid, (topic, payload, retain, _evt) in unacked:
+                        sock.sendall(publish_packet(topic, payload, retain,
+                                                    qos=1, packet_id=pid,
+                                                    dup=True))
+                except OSError:
+                    continue
+            self.reconnects += 1
+            log.info("mqtt: reconnected to %s:%d (attempt %d, %d subs, "
+                     "%d unacked resent)", self._host, self._port,
+                     attempt + 1, len(subs), len(unacked))
+            return True
+        return False
+
+    def _on_link_down(self) -> bool:
+        """Shared failure path for reader EOF and keepalive timeout."""
+        if not self._alive:
+            return False
+        if self._reconnect and self._recover():
+            return True
+        self.failed.set()
+        return False
 
     def _ping_loop(self, interval: float):
         while not self._stop_evt.wait(interval):
             if not self._alive:
                 return
+            now = time.monotonic()
+            if self._ping_at and self._pong_at < self._ping_at and \
+                    now - self._ping_at > 1.5 * interval:
+                # PINGREQ went unanswered: the link is dead even though
+                # the socket may still look open (half-open TCP)
+                log.warning("mqtt: keepalive timeout (no PINGRESP)")
+                try:
+                    # shutdown (not just close) unblocks the reader,
+                    # which owns the reconnect
+                    self._sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                continue
             try:
                 self.ping()
             except OSError:
-                return
+                pass  # reader sees the dead socket and recovers
+            # background at-least-once: resend unacked QoS1 with DUP each
+            # keepalive tick (covers fire-and-forget publishes too)
+            with self._lock:
+                unacked = list(self._unacked.items())
+                for pid, (topic, payload, retain, _evt) in unacked:
+                    try:
+                        self._sock.sendall(publish_packet(
+                            topic, payload, retain, qos=1, packet_id=pid,
+                            dup=True))
+                    except OSError:
+                        break
 
-    def publish(self, topic: str, payload: bytes,
-                retain: bool = False) -> None:
+    # -- pub/sub ---------------------------------------------------------
+
+    def publish(self, topic: str, payload: bytes, retain: bool = False,
+                qos: int = 0, timeout: Optional[float] = None) -> None:
+        """Publish. ``qos=1``: blocks until PUBACK when ``timeout`` is
+        given; without one it returns immediately and the keepalive
+        loop retransmits (DUP) each tick until PUBACK."""
+        if qos == 0:
+            with self._lock:
+                self._sock.sendall(publish_packet(topic, payload, retain))
+            return
+        if qos != 1:
+            raise ValueError("mqtt: only QoS 0/1 supported")
+        evt = threading.Event()
         with self._lock:
-            self._sock.sendall(publish_packet(topic, payload, retain))
+            self._pid = self._pid % 0xFFFF + 1
+            pid = self._pid
+            self._unacked[pid] = (topic, payload, retain, evt)
+            self._sock.sendall(publish_packet(topic, payload, retain,
+                                              qos=1, packet_id=pid))
+        if timeout is not None:
+            deadline = time.monotonic() + timeout
+            while not evt.wait(0.25):
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"mqtt: no PUBACK for packet {pid} within "
+                        f"{timeout}s")
+                with self._lock:
+                    try:  # retransmit with DUP while waiting
+                        self._sock.sendall(publish_packet(
+                            topic, payload, retain, qos=1, packet_id=pid,
+                            dup=True))
+                    except OSError:
+                        pass
 
     def subscribe(self, topic_filter: str,
                   cb: Callable[[str, bytes], None],
-                  timeout: float = 10.0) -> None:
+                  timeout: float = 10.0, qos: int = 0) -> None:
+        """Subscribe. Tensor streams default to QoS0 (latest-wins, no
+        broker-side tracking); pass ``qos=1`` for control topics."""
         with self._lock:
             self._pid = self._pid % 0xFFFF + 1
-            self._subs.append((topic_filter, cb))
+            self._subs.append((topic_filter, cb, qos))
             self._suback.clear()
             self._suback_codes = None
-            self._sock.sendall(subscribe_packet(self._pid, topic_filter))
+            self._sock.sendall(subscribe_packet(self._pid, topic_filter,
+                                                qos=qos))
         if not self._suback.wait(timeout):
             raise ConnectionError(f"mqtt: no SUBACK for {topic_filter!r}")
         codes = self._suback_codes or b""
         if any(c == 0x80 for c in codes):  # spec 3.9.3: 0x80 = failure
             with self._lock:
-                self._subs.remove((topic_filter, cb))
+                self._subs.remove((topic_filter, cb, qos))
             raise ConnectionError(
                 f"mqtt: broker rejected subscription to {topic_filter!r}")
 
@@ -354,22 +521,34 @@ class MqttClient:
             except Exception:
                 pkt = None
             if pkt is None:
-                if self._alive:
-                    self.failed.set()
+                if self._on_link_down():
+                    continue
                 return
             ptype, flags, body = pkt
             try:
                 if ptype == PUBLISH:
-                    topic, payload, _retain = parse_publish(flags, body)
-                    for pattern, cb in list(self._subs):
+                    topic, payload, _retain, qos, pid = \
+                        parse_publish(flags, body)
+                    if qos and pid is not None:
+                        with self._lock:
+                            self._sock.sendall(puback_packet(pid))
+                    for pattern, cb, _q in list(self._subs):
                         if topic_matches(pattern, topic):
                             try:
                                 cb(topic, payload)
                             except Exception as e:  # noqa: BLE001
                                 log.warning("mqtt subscriber callback: %s", e)
+                elif ptype == PUBACK:
+                    (pid,) = struct.unpack_from(">H", body)
+                    with self._lock:
+                        entry = self._unacked.pop(pid, None)
+                    if entry is not None:
+                        entry[3].set()
                 elif ptype == SUBACK:
                     self._suback_codes = body[2:]  # skip packet id
                     self._suback.set()
+                elif ptype == PINGRESP:
+                    self._pong_at = time.monotonic()
                 elif ptype == PINGREQ:
                     with self._lock:
                         self._sock.sendall(pingresp_packet())
@@ -377,12 +556,13 @@ class MqttClient:
                 # framing state is unreliable past a parse error: fail the
                 # connection so pollers of `failed` see it, don't hang
                 log.warning("mqtt: malformed packet type %d: %s", ptype, e)
-                if self._alive:
-                    self.failed.set()
+                if self._on_link_down():
+                    continue
                 return
 
     def ping(self) -> None:
         with self._lock:
+            self._ping_at = time.monotonic()
             self._sock.sendall(pingreq_packet())
 
     def close(self) -> None:
@@ -405,10 +585,15 @@ class MqttClient:
 # ---------------------------------------------------------------------------
 
 class MqttBroker:
-    """In-process broker speaking real MQTT 3.1.1 (QoS0 + retain).
+    """In-process broker speaking real MQTT 3.1.1 (QoS0/QoS1 + retain).
 
     Gives loopback tests and brokerless edge deployments a conformant
-    peer; production fleets point ``broker=mqtt://`` at their own."""
+    peer; production fleets point ``broker=mqtt://`` at their own.
+    Incoming QoS1 publishes are PUBACKed; deliveries to QoS1
+    subscribers carry packet ids and are retransmitted (DUP) by a sweep
+    thread until the subscriber PUBACKs."""
+
+    _RETX_INTERVAL = 1.0  # seconds between QoS1 redelivery sweeps
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -417,13 +602,46 @@ class MqttBroker:
         self._srv.listen(32)
         self.port = self._srv.getsockname()[1]
         self._lock = threading.Lock()
-        #: sock → list of topic filters
-        self._clients: Dict[socket.socket, List[str]] = {}
+        #: sock → list of (topic filter, granted qos)
+        self._clients: Dict[socket.socket, List[Tuple[str, int]]] = {}
         self._retained: Dict[str, bytes] = {}
+        #: sock → {pid: (topic, payload, retain)} awaiting PUBACK
+        self._inflight: Dict[socket.socket, Dict[int, tuple]] = {}
+        #: sock → write lock: handler threads, _route callers, and the
+        #: retransmit sweeper all write to subscriber sockets — without
+        #: per-socket serialization their frames would interleave
+        self._wlocks: Dict[socket.socket, threading.Lock] = {}
+        self._next_pid = 0
         self._alive = True
         self._acceptor = threading.Thread(target=self._accept_loop,
                                           daemon=True, name="mqtt-accept")
         self._acceptor.start()
+        self._sweeper = threading.Thread(target=self._retx_loop,
+                                         daemon=True, name="mqtt-retx")
+        self._sweeper.start()
+
+    def _send(self, sock: socket.socket, data: bytes) -> None:
+        with self._lock:
+            wlock = self._wlocks.get(sock)
+        if wlock is None:
+            sock.sendall(data)  # pre-registration (CONNACK): single-owner
+            return
+        with wlock:
+            sock.sendall(data)
+
+    def _retx_loop(self):
+        while self._alive:
+            time.sleep(self._RETX_INTERVAL)
+            with self._lock:
+                work = [(s, dict(m)) for s, m in self._inflight.items() if m]
+            for sock, msgs in work:
+                for pid, (topic, payload, retain) in msgs.items():
+                    try:
+                        self._send(sock, publish_packet(
+                            topic, payload, retain, qos=1, packet_id=pid,
+                            dup=True))
+                    except OSError:
+                        break
 
     def _accept_loop(self):
         while self._alive:
@@ -448,14 +666,23 @@ class MqttBroker:
             sock.sendall(connack_packet(0))
             with self._lock:
                 self._clients[sock] = []
+                self._inflight[sock] = {}
+                self._wlocks[sock] = threading.Lock()
             while self._alive:
                 pkt = read_packet(sock)
                 if pkt is None:
                     break
                 ptype, flags, body = pkt
                 if ptype == PUBLISH:
-                    topic, payload, retain = parse_publish(flags, body)
+                    topic, payload, retain, qos, pid = \
+                        parse_publish(flags, body)
+                    if qos and pid is not None:
+                        self._send(sock, puback_packet(pid))
                     self._route(topic, payload, retain)
+                elif ptype == PUBACK:
+                    (pid,) = struct.unpack_from(">H", body)
+                    with self._lock:
+                        self._inflight.get(sock, {}).pop(pid, None)
                 elif ptype == SUBSCRIBE:
                     (pid,) = struct.unpack_from(">H", body)
                     off, codes = 2, []
@@ -464,22 +691,25 @@ class MqttBroker:
                     while off < len(body):
                         (tlen,) = struct.unpack_from(">H", body, off)
                         filt = body[off + 2:off + 2 + tlen].decode()
-                        off += 2 + tlen + 1  # + requested QoS byte
-                        codes.append(0)  # granted QoS0
+                        req_qos = body[off + 2 + tlen] & 0x03
+                        off += 2 + tlen + 1
+                        granted = min(req_qos, 1)
+                        codes.append(granted)
                         if filters is not None:
-                            filters.append(filt)
+                            filters.append((filt, granted))
                         self._send_retained(sock, filt)
-                    sock.sendall(suback_packet(pid, codes))
+                    self._send(sock, suback_packet(pid, codes))
                 elif ptype == UNSUBSCRIBE:
                     (pid,) = struct.unpack_from(">H", body)
                     (tlen,) = struct.unpack_from(">H", body, 2)
                     filt = body[4:4 + tlen].decode()
                     with self._lock:
-                        if filt in self._clients.get(sock, []):
-                            self._clients[sock].remove(filt)
-                    sock.sendall(unsuback_packet(pid))
+                        subs = self._clients.get(sock, [])
+                        self._clients[sock] = [
+                            (f, q) for f, q in subs if f != filt]
+                    self._send(sock, unsuback_packet(pid))
                 elif ptype == PINGREQ:
-                    sock.sendall(pingresp_packet())
+                    self._send(sock, pingresp_packet())
                 elif ptype == DISCONNECT:
                     break
         except OSError:
@@ -487,6 +717,8 @@ class MqttBroker:
         finally:
             with self._lock:
                 self._clients.pop(sock, None)
+                self._inflight.pop(sock, None)
+                self._wlocks.pop(sock, None)
             sock.close()
 
     def _send_retained(self, sock: socket.socket, filt: str):
@@ -495,7 +727,8 @@ class MqttBroker:
                     if topic_matches(filt, t)]
         for topic, payload in hits:
             try:
-                sock.sendall(publish_packet(topic, payload, retain=True))
+                self._send(sock, publish_packet(topic, payload,
+                                                retain=True))
             except OSError:
                 pass
 
@@ -506,17 +739,42 @@ class MqttBroker:
                     self._retained[topic] = payload
                 else:
                     self._retained.pop(topic, None)  # spec 3.3.1.3
-            targets = [s for s, filters in self._clients.items()
-                       if any(topic_matches(f, topic) for f in filters)]
-        pkt = publish_packet(topic, payload)
-        for s in targets:
+            targets = []  # (sock, delivery qos)
+            for s, filters in self._clients.items():
+                qs = [q for f, q in filters if topic_matches(f, topic)]
+                if qs:
+                    targets.append((s, max(qs)))
+            qos1 = []
+            for s, q in targets:
+                if q:
+                    self._next_pid = self._next_pid % 0xFFFF + 1
+                    pid = self._next_pid
+                    self._inflight.setdefault(s, {})[pid] = \
+                        (topic, payload, retain)
+                    qos1.append((s, pid))
+        pkt0 = publish_packet(topic, payload)
+        for s, q in targets:
+            if q:
+                continue
             try:
-                s.sendall(pkt)
+                self._send(s, pkt0)
             except OSError:
                 pass
+        for s, pid in qos1:
+            try:
+                self._send(s, publish_packet(topic, payload, retain,
+                                             qos=1, packet_id=pid))
+            except OSError:
+                pass  # the sweep retries until the reader reaps the sock
 
     def close(self) -> None:
         self._alive = False
+        # shutdown() before close(): close() alone does not wake a
+        # recv()/accept() blocked in another thread
+        try:
+            self._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._srv.close()
         except OSError:
@@ -524,7 +782,13 @@ class MqttBroker:
         with self._lock:
             socks = list(self._clients)
             self._clients.clear()
+            self._inflight.clear()
+            self._wlocks.clear()
         for s in socks:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 s.close()
             except OSError:

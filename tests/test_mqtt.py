@@ -61,9 +61,10 @@ class TestPackets:
         pkt = M.publish_packet("t/x", b"payload", retain=True)
         assert pkt[0] == (M.PUBLISH << 4) | 0x01
         _, used = M.decode_varlen(pkt, 1)
-        topic, payload, retain = M.parse_publish(pkt[0] & 0x0F,
-                                                 pkt[1 + used:])
-        assert (topic, payload, retain) == ("t/x", b"payload", True)
+        topic, payload, retain, qos, pid = M.parse_publish(
+            pkt[0] & 0x0F, pkt[1 + used:])
+        assert (topic, payload, retain, qos, pid) == \
+            ("t/x", b"payload", True, 0, None)
 
     def test_subscribe_flags(self):
         pkt = M.subscribe_packet(7, "a/+/b")
@@ -334,3 +335,110 @@ class TestSntp:
         got = ntp.corrected_epoch_ns([("127.0.0.1", 1)], timeout=0.2)
         assert got >= before
         ntp.reset_offset_cache()
+
+
+class TestQoS1:
+    def test_publish_packet_qos1_layout(self):
+        pkt = M.publish_packet("a/b", b"xyz", qos=1, packet_id=300)
+        assert pkt[0] == (M.PUBLISH << 4) | 0x02  # qos1, no dup/retain
+        _, used = M.decode_varlen(pkt, 1)
+        topic, payload, retain, qos, pid = M.parse_publish(
+            pkt[0] & 0x0F, pkt[1 + used:])
+        assert (topic, payload, qos, pid) == ("a/b", b"xyz", 1, 300)
+        dup = M.publish_packet("a/b", b"xyz", qos=1, packet_id=300,
+                               dup=True)
+        assert dup[0] & 0x08  # DUP bit
+
+    def test_qos1_roundtrip_with_puback(self):
+        """QoS1 publish blocks until PUBACK; subscriber receives once
+        (and acks the broker's QoS1 delivery)."""
+        broker = M.MqttBroker()
+        got = []
+        try:
+            sub = M.MqttClient(port=broker.port)
+            sub.subscribe("q1/t", lambda t, p: got.append(p), qos=1)
+            pub = M.MqttClient(port=broker.port)
+            pub.publish("q1/t", b"hello-qos1", qos=1, timeout=10.0)
+            deadline = time.monotonic() + 10
+            while not got and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert got and got[0] == b"hello-qos1"
+            assert not pub._unacked  # PUBACK consumed
+            # broker's in-flight map drains once the subscriber acks
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                with broker._lock:
+                    if not any(broker._inflight.values()):
+                        break
+                time.sleep(0.05)
+            with broker._lock:
+                assert not any(broker._inflight.values())
+            pub.close(); sub.close()
+        finally:
+            broker.close()
+
+    def test_qos1_retransmits_until_acked(self):
+        """An unanswered QoS1 publish retransmits with DUP set."""
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", 0)); srv.listen(1)
+        port = srv.getsockname()[1]
+        seen = []
+
+        def fake_broker():
+            sock, _ = srv.accept()
+            M.read_packet(sock)  # CONNECT
+            sock.sendall(M.connack_packet(0))
+            while len(seen) < 2:
+                pkt = M.read_packet(sock)
+                if pkt is None:
+                    return
+                if pkt[0] == M.PUBLISH:
+                    seen.append(pkt[1])  # flags
+            # ack only after the retransmission arrived
+            sock.sendall(M.puback_packet(1))
+            M.read_packet(sock)
+
+        th = threading.Thread(target=fake_broker, daemon=True)
+        th.start()
+        c = M.MqttClient(port=port, reconnect=False)
+        c.publish("t", b"x", qos=1, timeout=15.0)
+        assert len(seen) >= 2
+        assert not seen[0] & 0x08   # first send: DUP clear
+        assert seen[-1] & 0x08      # retransmission: DUP set
+        c.close(); srv.close()
+
+    def test_reconnect_resubscribes_and_resends(self):
+        """Kill the broker mid-session: the client must reconnect to the
+        replacement on the same port, re-issue its subscription, and
+        resend the unacked QoS1 publish."""
+        broker = M.MqttBroker()
+        port = broker.port
+        got = []
+        c = M.MqttClient(port=port, keepalive=2)
+        c.subscribe("r/t", lambda t, p: got.append(p), qos=1)
+        broker.close()
+        time.sleep(0.1)
+        broker2 = M.MqttBroker(port=port)
+        try:
+            deadline = time.monotonic() + 15
+            while c.reconnects == 0 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert c.reconnects >= 1, "client never reconnected"
+            # subscription must be live on the NEW broker
+            c2 = M.MqttClient(port=port)
+            c2.publish("r/t", b"after-reconnect", qos=1, timeout=10.0)
+            deadline = time.monotonic() + 10
+            while not got and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert got and got[-1] == b"after-reconnect"
+            c2.close(); c.close()
+        finally:
+            broker2.close()
+
+    def test_failed_latches_when_reconnect_exhausted(self):
+        broker = M.MqttBroker()
+        c = M.MqttClient(port=broker.port, max_reconnect_attempts=2)
+        broker.close()
+        assert c.failed.wait(15), "failed never latched"
+        c.close()
