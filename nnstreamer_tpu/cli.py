@@ -45,12 +45,11 @@ def confchk() -> int:
     print(f"  conf file : {conf.path or '(none found)'}")
     envs = sorted(k for k in os.environ if k.startswith(ENV_PREFIX))
     print(f"  env overrides : {', '.join(envs) if envs else '(none)'}")
-    restricted = conf.get_bool("element-restriction", "enable")
+    allowed = conf.allowed_elements()
     print(f"  element restriction : "
-          f"{'ENABLED' if restricted else 'disabled'}")
-    if restricted:
-        print(f"    allowlist: "
-              f"{conf.get('element-restriction', 'restricted_elements')}")
+          f"{'ENABLED' if allowed is not None else 'disabled'}")
+    if allowed is not None:
+        print(f"    allowlist: {', '.join(sorted(allowed)) or '(empty)'}")
     print(f"  native runtime : "
           f"{'available' if native.available() else 'NOT built'}")
     try:

@@ -68,10 +68,14 @@ class Conf:
             self._cp.read(self.path)
 
     def get(self, group: str, key: str, default: Optional[str] = None):
-        """Env override first (NNSTREAMER_TPU_<GROUP>_<KEY>), then ini."""
-        env = os.environ.get(f"{ENV_PREFIX}{group.upper()}_{key.upper()}")
-        if env is not None:
-            return env
+        """Env override first (NNSTREAMER_TPU_<GROUP>_<KEY>), then ini.
+        Hyphenated group names (e.g. ``element-restriction``) also match
+        their underscore spelling — a shell cannot export a variable
+        with ``-`` in its name."""
+        for g in (group.upper(), group.upper().replace("-", "_")):
+            env = os.environ.get(f"{ENV_PREFIX}{g}_{key.upper()}")
+            if env is not None:
+                return env
         return self._cp.get(group, key, fallback=default)
 
     def get_bool(self, group: str, key: str, default: bool = False) -> bool:
@@ -85,6 +89,25 @@ class Conf:
         (reference [filter]/[decoder]/[converter] path keys)."""
         raw = self.get(kind, "path", "") or ""
         return [p for p in raw.split(os.pathsep) if p]
+
+    def allowed_elements(self) -> Optional[set]:
+        """Element allowlist, or ``None`` when restriction is off
+        (reference ``enable-element-restriction`` +
+        ``allowed-elements``, meson_options.txt:39-40; the reference's
+        value is space-separated — both space and comma work here).
+
+        Section ``[element-restriction]``: ``enable_element_restriction``
+        (or ``enable``) turns it on; ``allowed_elements`` (or the
+        reference-era ``restricted_elements`` key) names the permitted
+        factories. Restricted pipelines fail closed at parse time."""
+        if not (self.get_bool("element-restriction",
+                              "enable_element_restriction")
+                or self.get_bool("element-restriction", "enable")):
+            return None
+        raw = (self.get("element-restriction", "allowed_elements")
+               or self.get("element-restriction", "restricted_elements")
+               or "")
+        return {e for e in raw.replace(",", " ").split() if e}
 
     def framework_priority(self, model_path: str) -> List[str]:
         """Framework candidates for a model file, best first (reference

@@ -127,16 +127,13 @@ def _make_element(factory_name: str, props: List[Tuple[str, str]]) -> Element:
     # element-restriction allowlist (reference meson.build:531-540:
     # [element-restriction] enable_element_restriction + allowed_elements;
     # the short `enable`/`restricted_elements` spellings are also accepted)
-    if (conf.get_bool("element-restriction", "enable_element_restriction")
-            or conf.get_bool("element-restriction", "enable")):
-        allowed = {e.strip() for e in
-                   (conf.get("element-restriction", "allowed_elements")
-                    or conf.get("element-restriction", "restricted_elements")
-                    or "").split(",") if e.strip()}
-        if factory_name not in allowed:
-            raise ValueError(
-                f"element {factory_name!r} is not in the configured "
-                f"element-restriction allowlist")
+    allowed = conf.allowed_elements()
+    if allowed is not None and factory_name not in allowed:
+        # fail closed at parse: a restricted deployment never instantiates
+        # an unlisted element (reference enable-element-restriction)
+        raise ValueError(
+            f"element {factory_name!r} is not in the configured "
+            f"element-restriction allowlist")
     factory = get_subplugin(ELEMENT, factory_name)
     if factory is None:
         raise ValueError(f"no such element factory {factory_name!r}")

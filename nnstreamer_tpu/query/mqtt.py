@@ -365,6 +365,12 @@ class MqttClient:
                 f"mqtt: CONNECT to {self._host}:{self._port} refused "
                 f"(code {pkt[2][1] if pkt else 'EOF'})")
         sock.settimeout(None)
+        # bounded SENDS without touching recv: a half-open peer whose
+        # window closed must fail a sendall (freeing self._lock) instead
+        # of wedging the pinger/publishers forever
+        tv = struct.pack("ll", int(self._timeout),
+                         int(self._timeout % 1 * 1e6))
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO, tv)
         self._pong_at = time.monotonic()
         self._ping_at = 0.0
         return sock
@@ -373,6 +379,10 @@ class MqttClient:
         """Reconnect with backoff; resubscribe and resend unacked QoS1
         (DUP set). Returns False when attempts are exhausted — only
         then does ``failed`` latch."""
+        try:
+            self._sock.close()  # reap the dead fd before replacing it
+        except OSError:
+            pass
         for attempt in range(self._max_attempts):
             if not self._alive:
                 return False
@@ -404,6 +414,10 @@ class MqttClient:
                                                     qos=1, packet_id=pid,
                                                     dup=True))
                 except OSError:
+                    try:
+                        sock.close()  # don't leak the half-set-up socket
+                    except OSError:
+                        pass
                     continue
             self.reconnects += 1
             log.info("mqtt: reconnected to %s:%d (attempt %d, %d subs, "
@@ -418,7 +432,8 @@ class MqttClient:
             return False
         if self._reconnect and self._recover():
             return True
-        self.failed.set()
+        if self._alive:  # a close() mid-recovery is not a failure
+            self.failed.set()
         return False
 
     def _ping_loop(self, interval: float):
@@ -482,6 +497,10 @@ class MqttClient:
             deadline = time.monotonic() + timeout
             while not evt.wait(0.25):
                 if time.monotonic() > deadline:
+                    with self._lock:
+                        # the caller is told delivery failed — stop
+                        # retransmitting a message they will re-send
+                        self._unacked.pop(pid, None)
                     raise TimeoutError(
                         f"mqtt: no PUBACK for packet {pid} within "
                         f"{timeout}s")
@@ -749,8 +768,10 @@ class MqttBroker:
                 if q:
                     self._next_pid = self._next_pid % 0xFFFF + 1
                     pid = self._next_pid
+                    # live deliveries carry retain=0 [MQTT-3.3.1-9];
+                    # only _send_retained sets the flag
                     self._inflight.setdefault(s, {})[pid] = \
-                        (topic, payload, retain)
+                        (topic, payload, False)
                     qos1.append((s, pid))
         pkt0 = publish_packet(topic, payload)
         for s, q in targets:
@@ -762,7 +783,7 @@ class MqttBroker:
                 pass
         for s, pid in qos1:
             try:
-                self._send(s, publish_packet(topic, payload, retain,
+                self._send(s, publish_packet(topic, payload, retain=False,
                                              qos=1, packet_id=pid))
             except OSError:
                 pass  # the sweep retries until the reader reaps the sock

@@ -141,3 +141,62 @@ def test_scaffold_edge_names(tmp_path):
         cls_names = [n.name for n in ast.walk(tree)
                      if isinstance(n, ast.ClassDef)]
         assert cls_names and cls_names[0] not in ("None", "Caps")
+
+
+def test_bounding_boxes_ov_face_is_ov_person_codepath():
+    """The reference routes ov-face-detection through the IDENTICAL code
+    path as ov-person-detection — one branch for both modes at caps
+    check (tensordec-boundingbox.c:793-794) and at decode (:1307-1308),
+    same [7,N,1,1] row format (image_id, label, conf, x_min, y_min,
+    x_max, y_max), same 0.8 confidence threshold, same early-exit at
+    image_id < 0. Our alias must therefore decode byte-identical
+    vectors identically under both mode names."""
+    from nnstreamer_tpu.registry import DECODER, get_subplugin
+
+    rows = np.array([
+        [0, 1, 0.95, 0.10, 0.20, 0.40, 0.60],
+        [0, 2, 0.81, 0.05, 0.05, 0.15, 0.25],
+        [0, 1, 0.79, 0.50, 0.50, 0.90, 0.90],   # below 0.8 threshold
+        [-1, 0, 0.0, 0, 0, 0, 0],                # end marker
+        [0, 1, 0.99, 0.0, 0.0, 1.0, 1.0],        # after end: ignored
+    ], np.float32).reshape(1, 1, 5, 7)
+    outs = {}
+    for mode in ("ov-person-detection", "ov-face-detection"):
+        dec = get_subplugin(DECODER, "bounding_boxes")()
+        out = dec.decode(TensorBuffer([rows.copy()]), None,
+                         {"option1": mode, "option4": "672:384",
+                          "option7": "meta"})
+        outs[mode] = out.meta["detections"]
+    assert outs["ov-face-detection"] == outs["ov-person-detection"]
+    dets = outs["ov-face-detection"]
+    assert len(dets) == 2  # threshold + early-exit applied
+
+    # cross-check against the reference's pixel math
+    # (_get_persons_ov, tensordec-boundingbox.c:1075-1112):
+    #   x = x_min*w, y = y_min*h, width = (x_max-x_min)*w,
+    #   height = (y_max-y_min)*h, for w=672 h=384
+    y1, x1, y2, x2 = dets[0]["box"]
+    assert (int(x1 * 672), int(y1 * 384)) == (67, 76)
+    assert (int((x2 - x1) * 672), int((y2 - y1) * 384)) == (201, 153)
+
+
+def test_config_allowed_elements_api(monkeypatch):
+    """Conf.allowed_elements: off -> None; on -> parsed set, accepting
+    the reference's space-separated allowed-elements format."""
+    from nnstreamer_tpu.config import ENV_PREFIX, get_conf
+
+    assert get_conf(refresh=True).allowed_elements() is None
+    monkeypatch.setenv(f"{ENV_PREFIX}ELEMENT-RESTRICTION_ENABLE", "true")
+    monkeypatch.setenv(
+        f"{ENV_PREFIX}ELEMENT-RESTRICTION_ALLOWED_ELEMENTS",
+        "videotestsrc tensor_converter tee,queue")  # mixed separators
+    try:
+        allowed = get_conf(refresh=True).allowed_elements()
+        assert allowed == {"videotestsrc", "tensor_converter", "tee",
+                           "queue"}
+        with pytest.raises(ValueError, match="allowlist"):
+            parse_launch("videotestsrc ! tensor_transform mode=typecast "
+                         "option=float32 ! fakesink")
+    finally:
+        monkeypatch.delenv(f"{ENV_PREFIX}ELEMENT-RESTRICTION_ENABLE")
+        get_conf(refresh=True)
