@@ -19,7 +19,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
-from nnstreamer_tpu.pipeline.element import Element, FlowReturn
+from nnstreamer_tpu.pipeline.element import Element, FlowError, FlowReturn
 from nnstreamer_tpu.pipeline.pipeline import SourceElement
 from nnstreamer_tpu.registry import ELEMENT, subplugin
 from nnstreamer_tpu.tensors.buffer import TensorBuffer
@@ -155,10 +155,20 @@ class TensorRepoSrc(SourceElement):
                           info.type.np_dtype)
             self.i += 1
             return TensorBuffer([arr], pts=0)
-        buf = GLOBAL_REPO.get(self._slot(),
-                              timeout=float(self.get_property("timeout")),
-                              consume=True)
+        t = float(self.get_property("timeout"))
+        buf = GLOBAL_REPO.get(self._slot(), timeout=t, consume=True)
         if buf is None:
-            return None  # loop source starved → EOS
+            # (the guard at the top already returned for i >= n)
+            if n >= 0 and not self._stop_evt.is_set():
+                # the pipeline promised n iterations and the loop state
+                # vanished mid-count: that is a WEDGED loop (producer
+                # died / reposink unlinked), not a drain — fail loudly
+                # so failure detection sees it instead of a clean EOS.
+                # A deliberate stop() mid-wait is NOT a wedge.
+                raise FlowError(
+                    f"tensor_reposrc: slot {self._slot()!r} starved "
+                    f"after {self.i}/{n} iterations (timeout {t}s) — "
+                    "repo loop wedged")
+            return None  # endless loop drained / pipeline stopping → EOS
         self.i += 1
         return buf.replace(pts=self.i)

@@ -498,6 +498,8 @@ class MqttClient:
             while not evt.wait(0.25):
                 if time.monotonic() > deadline:
                     with self._lock:
+                        if evt.is_set():  # PUBACK landed in the gap
+                            return
                         # the caller is told delivery failed — stop
                         # retransmitting a message they will re-send
                         self._unacked.pop(pid, None)
