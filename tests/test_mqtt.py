@@ -442,3 +442,60 @@ class TestQoS1:
         broker.close()
         assert c.failed.wait(15), "failed never latched"
         c.close()
+
+
+class TestGstMqttHeaderCtypesOracle:
+    def test_byte_identity_vs_c_struct(self):
+        """Independent oracle: mirror the C struct (mqttcommon.h:49-63)
+        with ctypes — the compiler's own offset/alignment rules — fill
+        it the way mqttsink does, and require byte identity with our
+        packer in both directions."""
+        import ctypes as C
+
+        GST_MQTT_MAX_NUM_MEMS = 16
+        GST_MQTT_MAX_LEN_GST_CAPS_STR = 512
+        GST_MQTT_LEN_MSG_HDR = 1024
+
+        class Hdr(C.Structure):
+            _fields_ = [
+                ("num_mems", C.c_uint),
+                ("size_mems", C.c_size_t * GST_MQTT_MAX_NUM_MEMS),
+                ("base_time_epoch", C.c_int64),
+                ("sent_time_epoch", C.c_int64),
+                ("duration", C.c_uint64),   # GstClockTime
+                ("dts", C.c_uint64),
+                ("pts", C.c_uint64),
+                ("gst_caps_str",
+                 C.c_char * GST_MQTT_MAX_LEN_GST_CAPS_STR),
+            ]
+
+        class Msg(C.Union):
+            _fields_ = [("s", Hdr),
+                        ("_reserved_hdr", C.c_uint8 * GST_MQTT_LEN_MSG_HDR)]
+
+        assert C.sizeof(Msg) == GST_MQTT_LEN_MSG_HDR
+
+        m = Msg()
+        m.s.num_mems = 2
+        m.s.size_mems[0] = 4
+        m.s.size_mems[1] = 2
+        m.s.base_time_epoch = 111
+        m.s.sent_time_epoch = 222
+        m.s.duration = 555
+        m.s.dts = 444
+        m.s.pts = 333
+        m.s.gst_caps_str = b"other/tensors,num_tensors=2"
+        golden = bytes(m) + b"abcdxy"
+
+        ours = M.pack_gst_mqtt_message(
+            [b"abcd", b"xy"], "other/tensors,num_tensors=2",
+            base_time_epoch=111, sent_time_epoch=222,
+            pts=333, dts=444, duration=555)
+        assert ours == golden  # byte-for-byte
+
+        out = M.parse_gst_mqtt_message(golden)  # and we parse theirs
+        assert out["mems"] == [b"abcd", b"xy"]
+        assert out["caps_str"] == "other/tensors,num_tensors=2"
+        assert (out["base_time_epoch"], out["sent_time_epoch"]) == (111,
+                                                                    222)
+        assert (out["pts"], out["dts"], out["duration"]) == (333, 444, 555)
