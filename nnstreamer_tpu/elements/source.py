@@ -369,6 +369,14 @@ class IIOChannel:
                 "the kernel in_*_type format)") from e
         if self.storage_bits % 8 or self.storage_bits not in (8, 16, 32, 64):
             raise ValueError(f"iio: unsupported storage {fmt!r}")
+        if not (0 < self.bits <= self.storage_bits and
+                0 <= self.shift < self.storage_bits and
+                self.bits + self.shift <= self.storage_bits):
+            # bits/shift outside the storage word would decode silently
+            # wrong (sign bit unreachable, or data shifted away)
+            raise ValueError(
+                f"iio: inconsistent type descriptor {fmt!r} for channel "
+                f"{name!r}: BITS+SHIFT must fit in STORAGE")
 
     @property
     def storage_bytes(self) -> int:
