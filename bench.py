@@ -263,11 +263,16 @@ def ingest_probe(batch: int = BATCH) -> dict:
                 [jnp.sum(x, axis=(1, 2, 3)).astype(jnp.float32)] * 16,
                 axis=1),),
             None)
-    # the EXACT flagship topology (build_pipeline), model swapped only
-    pipe = build_pipeline(batch, n_frames=min(N_FRAMES, 400),
-                          model_override="bench_ingest_probe")
-    frame_t = _collect(pipe)
-    fps = _steady_fps(frame_t, frames_per_buffer=batch)
+    # the EXACT flagship topology (build_pipeline), model swapped only.
+    # A ceiling estimate must not read LOW on a volatile link (that
+    # would put the flagship "above" its own ceiling): take the best of
+    # two runs.
+    fps = 0.0
+    for _ in range(2):
+        pipe = build_pipeline(batch, n_frames=min(N_FRAMES, 400),
+                              model_override="bench_ingest_probe")
+        frame_t = _collect(pipe)
+        fps = max(fps, _steady_fps(frame_t, frames_per_buffer=batch))
     return dict(ingest_bound_fps=round(fps, 1))
 
 

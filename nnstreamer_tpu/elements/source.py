@@ -65,6 +65,27 @@ class VideoTestSrc(SourceElement):
         self.srcpad.set_caps(self._caps())
 
     def _frame(self, i: int) -> np.ndarray:
+        pattern = self.get_property("pattern")
+        key = (pattern, self.get_property("width"),
+               self.get_property("height"), self.get_property("format"))
+        if pattern != "ball":
+            # every pattern except ball is frame-independent: synthesize
+            # once per (pattern, size, format) and reuse (buffers are
+            # immutable once pushed, so the shared array is safe
+            # downstream) — at high fps the per-frame synthesis otherwise
+            # costs real host bandwidth. Keyed so property changes
+            # invalidate the cache.
+            cached_key, cached = getattr(self, "_static_frame",
+                                         (None, None))
+            if cached is not None and cached_key == key:
+                return cached
+        img = self._synthesize(i)
+        if pattern != "ball":
+            img.setflags(write=False)
+            self._static_frame = (key, img)
+        return img
+
+    def _synthesize(self, i: int) -> np.ndarray:
         w = int(self.get_property("width"))
         h = int(self.get_property("height"))
         fmt = self.get_property("format")
