@@ -578,6 +578,10 @@ class ContinuousBatchingEngine:
                 c, u.astype(c.dtype), (0,) * c.ndim), cache1, kv)
 
     def _admit(self, req: _PendingRequest, slot: int):
+        """Device phase of one admission: prefill (or prefix reuse) and
+        first-token sampling DISPATCH. Returns the activation record for
+        :meth:`_activate_commit` — the loop commits a whole admission
+        wave with one host sync instead of one round trip per prompt."""
         jnp = self._jnp
         prompt = req.prompt
         n = prompt.size
@@ -587,8 +591,7 @@ class ContinuousBatchingEngine:
             self.stats["prefix_hits"] += 1
             self.stats["prefix_tokens_reused"] += p
             cache1 = self._place_prefix_kv(self._init_cache1(), kv)
-            self._activate(req, slot, cached_logits, cache1)
-            return
+            return self._activate_begin(req, slot, cached_logits, cache1)
         if (p >= self.PREFIX_MIN_REUSE
                 and p + self._bucket(n - p) <= self.S):
             # prefill only the remainder through the chunk program. The
@@ -610,8 +613,7 @@ class ContinuousBatchingEngine:
                 jnp.asarray(p, jnp.int32))
             logits = logits[:, rem - 1]
             self._prefix_store(prompt, cache1, logits)
-            self._activate(req, slot, logits, cache1)
-            return
+            return self._activate_begin(req, slot, logits, cache1)
         bucket = self._bucket(n)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :n] = prompt
@@ -619,7 +621,7 @@ class ContinuousBatchingEngine:
             self.params, jnp.asarray(padded),
             lengths=jnp.asarray([n], jnp.int32))
         self._prefix_store(prompt, cache1, logits)
-        self._activate(req, slot, logits, cache1)
+        return self._activate_begin(req, slot, logits, cache1)
 
     def _init_cache1(self):
         from nnstreamer_tpu.models.transformer import init_cache
@@ -686,37 +688,54 @@ class ContinuousBatchingEngine:
             self._slots[slot] = None
             req.stream._finish(f"error: {e}")
 
-    def _activate(self, req: _PendingRequest, slot: int, logits, cache1):
-        """Common admission tail: seed the first token, install the
-        stream's cache into its batch slot.
-
-        Syncs host mirrors FIRST: this is the one place per-slot host
-        state is written, and doing the drain here (not at a
-        check-then-act distance from the pending queue) closes the race
-        where a submit() lands after the loop's emptiness check — the
-        dispatch that follows any activation always rebuilds its device
-        state from the mirrors."""
-        self._sync_host_state()
+    def _activate_begin(self, req: _PendingRequest, slot: int, logits,
+                        cache1):
+        """Device half of an activation: dispatch the first-token sample
+        and the cache insert, CLAIM the slot, and return the record
+        ``(req, slot, first_d, key_d, lp_d)`` whose device handles
+        :meth:`_activate_commit` materializes. Splitting lets an
+        admission wave share one host sync (grouped fetch) instead of
+        paying a full link round trip per prompt."""
         jnp = self._jnp
-        n = req.prompt.size
-        self.stats["prefills"] += 1
         key = np.asarray(
             [self.seed & 0xFFFFFFFF, req.stream.stream_id & 0xFFFFFFFF],
             np.uint32)[None]
-        first, key, first_lp = self._sample_first(logits, jnp.asarray(key))
-        first = int(np.asarray(first)[0])
-        first_lp = float(np.asarray(first_lp)[0])
+        first_d, key_d, lp_d = self._sample_first(logits,
+                                                  jnp.asarray(key))
         # dtype alignment happens inside the tree-aware _insert
         self._cache = self._insert(self._cache, cache1, slot)
-        self._slots[slot] = req.stream
+        self._slots[slot] = req.stream  # claimed; mirrors land at commit
+        return (req, slot, first_d, key_d, lp_d)
+
+    def _activate_commit(self, rec) -> None:
+        """Host half: materialize the sampled first token and install
+        the per-slot host mirrors. Callers must run
+        :meth:`_sync_host_state` after the begins and before the first
+        commit — this is the one place per-slot host state is written,
+        and syncing at commit time (not at a check-then-act distance
+        from the pending queue) closes the race where a submit() lands
+        after the loop's emptiness check; the dispatch that follows any
+        activation always rebuilds its device state from the mirrors."""
+        req, slot, first_d, key_d, lp_d = rec
+        n = req.prompt.size
+        self.stats["prefills"] += 1
+        first = int(np.asarray(first_d)[0])
+        first_lp = float(np.asarray(lp_d)[0])
         self._pos[slot] = n
         self._last[slot] = first
-        self._keys[slot] = np.asarray(key)[0]
+        self._keys[slot] = np.asarray(key_d)[0]
         # cap generation so cache writes stay inside the slot's S window
         self._budget[slot] = min(req.max_new, self.S - n)
         req.stream._emit(first, first_lp)
         self.stats["tokens_generated"] += 1
         self._post_emit(slot, first)
+
+    def _activate(self, req: _PendingRequest, slot: int, logits, cache1):
+        """Single-admission tail (chunked-prefill path): begin + one
+        host sync + commit."""
+        rec = self._activate_begin(req, slot, logits, cache1)
+        self._sync_host_state()
+        self._activate_commit(rec)
 
     def _post_emit(self, slot: int, tok: int):
         """Budget/EOS bookkeeping after a token reaches its stream. The
@@ -818,8 +837,12 @@ class ContinuousBatchingEngine:
             if self._partial is not None:
                 self._advance_partial()
                 progressed = True
-            # admission: fill free slots from the pending queue
+            # admission: fill free slots from the pending queue. The
+            # device work (prefill + first-token sample) dispatches per
+            # request; the host fetches commit as ONE grouped wave below,
+            # so a burst of N prompts costs ~1 link round trip, not N.
             queue_dry = False
+            admitted = []
             for slot in range(self.B):
                 if queue_dry or self._slots[slot] is not None \
                         or self._partial is not None:
@@ -839,7 +862,7 @@ class ContinuousBatchingEngine:
                         if self.prefill_chunk is not None:
                             self._begin_partial(req, slot)
                         else:
-                            self._admit(req, slot)
+                            admitted.append(self._admit(req, slot))
                         progressed = True
                         break  # slot filled
                     except Exception as e:  # noqa: BLE001 — a bad request
@@ -850,6 +873,30 @@ class ContinuousBatchingEngine:
                             self._slots[slot] = None
                         self._partial = None
                         req.stream._finish(f"error: {e}")
+            if admitted:
+                try:
+                    self._sync_host_state()
+                except Exception as e:  # noqa: BLE001 — deferred device
+                    # errors surface at the drain. _recover already
+                    # failed every admitted stream and freed the slots:
+                    # committing the wave now would write mirrors into
+                    # freed slots and emit ghost tokens
+                    self._recover(e)
+                    admitted = []
+                for rec in admitted:  # start all fetches before blocking
+                    for d in (rec[2], rec[3], rec[4]):
+                        start_async = getattr(d, "copy_to_host_async",
+                                              None)
+                        if start_async is not None:
+                            start_async()
+                for rec in admitted:
+                    try:
+                        self._activate_commit(rec)
+                    except Exception as e:  # noqa: BLE001 — fail only
+                        # this stream; the slot frees for the next prompt
+                        log.warning("serving: activate failed: %s", e)
+                        self._slots[rec[1]] = None
+                        rec[0].stream._finish(f"error: {e}")
             if self.active_streams == 0:
                 try:
                     self._sync_host_state()  # late EOS frees the last slot
