@@ -690,42 +690,40 @@ class TestEngineRestartAfterStuckStop:
 
 
 def test_dp_slot_scaling_throughput():
-    """Aggregate tokens/s must scale with dp-sharded batch slots,
+    """Aggregate throughput must scale with dp-sharded batch slots,
     holding the mesh fixed: a dp4×tp2 engine with 8 slots vs the SAME
-    mesh with 4 slots (VERDICT r3 item 6: prove the dp4 gain). On the
-    virtual CPU mesh every device shares one host, so absolute GSPMD
-    cost is inflated equally in both arms and the measured win isolates
-    tokens-per-dispatch — which is exactly what slot scaling buys on
-    real chips."""
-    import time
-
+    mesh with 4 slots (VERDICT r3 item 6: prove the dp4 gain). The
+    asserted quantity is tokens per decode dispatch — the structural
+    win slot scaling buys (on real chips each dispatch costs roughly
+    the same wall time, so tokens/dispatch IS the throughput gain);
+    wall-clock ratios on a shared CI host are too noisy to gate on."""
     from nnstreamer_tpu.parallel.mesh import make_mesh
 
     rng = np.random.default_rng(0)
     prompts = [rng.integers(1, CFG.vocab, 6).tolist() for _ in range(8)]
     mesh = make_mesh([("dp", 4), ("tp", 2)])
 
-    def tps(streams):
+    def tokens_per_dispatch(streams):
         eng = ContinuousBatchingEngine(
             CFG, PARAMS, max_streams=streams, steps_per_dispatch=8,
             temperature=0.0, mesh=mesh).start()
         try:
             # compile off the clock (each engine has its own batch shape)
             eng.generate(prompts[0], max_new_tokens=8, timeout=240)
-            t0 = time.monotonic()
+            d0 = eng.stats["dispatches"]
+            t0 = eng.stats["tokens_generated"]
             ss = [eng.submit(p, max_new_tokens=24) for p in prompts]
             total = sum(len(s.result(timeout=240)) for s in ss)
-            return total / (time.monotonic() - t0)
+            assert total == 8 * 24
+            d = eng.stats["dispatches"] - d0
+            t = eng.stats["tokens_generated"] - t0
+            return t / max(d, 1)
         finally:
             eng.stop()
 
-    # 2x the dp-sharded slots: the 8 concurrent streams finish in one
-    # admission wave instead of two, so aggregate throughput must rise.
-    # Wall-clock ratios on a loaded CI host are noisy — retry once
-    # before declaring the scaling broken.
-    for attempt in range(2):
-        slots4 = tps(4)
-        slots8 = tps(8)
-        if slots8 > 1.25 * slots4:
-            break
-    assert slots8 > 1.25 * slots4, (slots8, slots4)
+    slots4 = tokens_per_dispatch(4)
+    slots8 = tokens_per_dispatch(8)
+    # 2x the dp-sharded slots → the 8 concurrent streams run in one
+    # admission wave instead of two, roughly doubling the tokens each
+    # dispatch delivers (tail effects eat a little of the 2x)
+    assert slots8 > 1.5 * slots4, (slots8, slots4)
