@@ -269,8 +269,7 @@ def ingest_probe(batch: int = BATCH) -> dict:
     # two runs.
     fps = 0.0
     for _ in range(2):
-        pipe = build_pipeline(batch, n_frames=min(N_FRAMES, 400),
-                              model_override="bench_ingest_probe")
+        pipe = build_pipeline(batch, model_override="bench_ingest_probe")
         frame_t = _collect(pipe)
         fps = max(fps, _steady_fps(frame_t, frames_per_buffer=batch))
     return dict(ingest_bound_fps=round(fps, 1))
