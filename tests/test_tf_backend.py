@@ -143,3 +143,28 @@ def test_custom_multi_names_survive_parsing():
 
     opts = parse_custom("inputname:x1;x2,outputname:y")
     assert opts == {"inputname": "x1;x2", "outputname": "y"}
+
+
+def test_dynamic_batch_pinned_by_input_info(tmp_path):
+    """A SavedModel with a dynamic batch dim needs static shapes for
+    XLA: the tensor_filter input property (innermost-first dims) pins
+    it; without pinning the error names the remedy."""
+
+    class Dyn(tf.Module):
+        @tf.function(input_signature=[tf.TensorSpec([None, 3],
+                                                    tf.float32)])
+        def __call__(self, x):
+            return {"y": x * 3.0}
+
+    sm = tmp_path / "dyn_sm"
+    tf.saved_model.save(Dyn(), str(sm))
+
+    with pytest.raises(ValueError, match="dynamic|static"):
+        tf_model_entry(str(sm))
+
+    from nnstreamer_tpu.tensors.types import TensorsInfo
+
+    e = tf_model_entry(str(sm),
+                       props_in_info=TensorsInfo.from_str("3:2", "float32"))
+    x = np.ones((2, 3), np.float32)
+    np.testing.assert_allclose(np.asarray(e["fn"](x)[0]), x * 3.0)
