@@ -13,6 +13,27 @@ Prints ONE JSON line:
 
 ``vs_baseline``: ratio vs the reference's TFLite CPU path on this host if
 tflite is importable, else vs the driver-recorded baseline constant.
+
+How to read the bound fields (the report's own limiter analysis):
+
+- ``value`` is the steady-state (warm) median; ``fps_cold`` and the
+  chronological ``fps_runs`` expose compile/tunnel warm-up separately.
+- ``device_fps_ceiling`` (model dispatch alone) bounds what the CHIP
+  sustains; ``pipeline_efficiency = value/ceiling``.
+- ``ingest_bound_fps`` re-runs the IDENTICAL topology with a free
+  model: the ceiling the host+link+framework impose with zero model
+  cost. ``vs_ingest_bound`` near 1 is the written proof that a wall
+  number is transfer/framework-bound, not model- or scheduler-bound;
+  above 1 means the link was slower in the probe's windows than across
+  the flagship's median-of-N (volatile link, treat the bound as
+  inconclusive for that session). On a tunneled dev chip the link is
+  usually the governor; on-host PCIe deployments sit near
+  ``device_fps_ceiling`` instead.
+- ``latency_p50/p99_ms`` is end-to-end per-frame latency under 30 fps
+  realtime pacing (create→sink materialization, batch-window wait
+  included); ``latency_sat_*`` is the same stat inside the saturated
+  throughput runs, where deep-queue wait dominates by design.
+- ``mfu_*`` use XLA's own flop count over the chip's public bf16 peak.
 """
 
 from __future__ import annotations
