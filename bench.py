@@ -312,12 +312,11 @@ def measure_latency_live(batch: int = BATCH, fps: int = 30,
     _collect(pipe)
     # drop the first two batch windows: they carry one-time pipeline
     # warm-up (first dispatch, tunnel stream setup), not steady service
-    lats = list(pipe.get("sink").latencies)[2 * batch:]
-    if not lats:
+    lat = pipe.get("sink").latency_percentiles(50, 99, skip=2 * batch)
+    if lat is None:
         return dict(latency_p50_ms=None, latency_p99_ms=None)
-    vals = np.asarray(lats) * 1e3
-    return dict(latency_p50_ms=round(float(np.percentile(vals, 50)), 2),
-                latency_p99_ms=round(float(np.percentile(vals, 99)), 2))
+    return dict(latency_p50_ms=round(lat[0], 2),
+                latency_p99_ms=round(lat[1], 2))
 
 
 def measure_pipeline(batch: int = BATCH) -> dict:
@@ -436,25 +435,44 @@ def measure_pose_mux() -> dict:
         return heat, offs
 
     register_jax_model("pose4_bench", batched4, params)
+
+    def desc(n, live=""):
+        srcs = " ".join(
+            f"videotestsrc num-buffers={n} width=257 height=257 "
+            f"pattern=gradient {live}! tensor_converter ! mux. "
+            for _ in range(4))
+        return (
+            "tensor_mux name=mux sync-mode=slowest ! "
+            "tensor_filter framework=jax model=pose4_bench name=filter ! "
+            # keypoint decode fuses onto the device: [K,3] rows cross
+            # the link, not full heatmaps; completion-proven via the
+            # host sink
+            "tensor_decoder mode=pose_estimation option2=meta ! "
+            "queue max-size-buffers=64 materialize-host=true ! "
+            "tensor_sink name=sink to-host=true " + srcs)
+
     n = max(N_FRAMES // 4, 30)
-    srcs = " ".join(
-        f"videotestsrc num-buffers={n} width=257 height=257 "
-        "pattern=gradient ! tensor_converter ! mux. "
-        for _ in range(4))
-    pipe = parse_launch(
-        f"tensor_mux name=mux sync-mode=slowest ! "
-        "tensor_filter framework=jax model=pose4_bench name=filter ! "
-        # keypoint decode fuses onto the device: [K,3] rows cross the
-        # link, not full heatmaps; completion-proven via the host sink
-        "tensor_decoder mode=pose_estimation option2=meta ! "
-        "queue max-size-buffers=64 materialize-host=true ! "
-        "tensor_sink name=sink to-host=true " + srcs)
+    pipe = parse_launch(desc(n))
     frame_t = _collect(pipe)
-    lat = pipe.get("sink").latency_percentiles(50, 99)
+    sat = pipe.get("sink").latency_percentiles(50, 99)
+    # realtime-paced latency (the saturated run's latency is deep-queue
+    # wait by design): 15 fps per source (60 fps offered across 4) stays
+    # under even bad-link capacity so the stat is service latency, not
+    # overload queueing. A fresh pipeline re-traces its fused region on
+    # the first buffer (~1-2 s) — frames paced in behind it queue up —
+    # so run ~8 s and score only the steady second half
+    n_srcs, live_n = 4, 120
+    live_pipe = parse_launch(desc(live_n,
+                                  live="is-live=true framerate=15/1 "))
+    _collect(live_pipe)
+    lat = live_pipe.get("sink").latency_percentiles(
+        50, 99, skip=live_n // 2 * n_srcs)
     return dict(metric="posenet_mux4_batched_fps",
                 fps=_steady_fps(frame_t, frames_per_buffer=4),
                 latency_p50_ms=round(lat[0], 2) if lat else None,
                 latency_p99_ms=round(lat[1], 2) if lat else None,
+                latency_sat_p50_ms=round(sat[0], 2) if sat else None,
+                latency_sat_p99_ms=round(sat[1], 2) if sat else None,
                 frames=len(frame_t) * 4)
 
 

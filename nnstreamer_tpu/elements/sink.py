@@ -82,14 +82,16 @@ class TensorSink(Element):
             cb(buf)
         return FlowReturn.OK
 
-    def latency_percentiles(self, *qs: float):
+    def latency_percentiles(self, *qs: float, skip: int = 0):
         """End-to-end frame latency percentiles in ms (create→sink), the
         queryable pipeline stat counterpart of the per-element
-        InvokeStats. Default (p50, p99)."""
-        if not self.latencies:
+        InvokeStats. Default (p50, p99). ``skip`` drops the first N
+        frames (warm-up exclusion for paced measurements)."""
+        vals = list(self.latencies)[skip:]
+        if not vals:
             return None
         qs = qs or (50.0, 99.0)
-        vals = np.asarray(self.latencies, dtype=np.float64) * 1e3
+        vals = np.asarray(vals, dtype=np.float64) * 1e3
         return tuple(float(np.percentile(vals, q)) for q in qs)
 
     def sink_event(self, pad, event):

@@ -49,6 +49,15 @@ class VideoTestSrc(SourceElement):
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self.i = 0
+        self._live_t0 = None
+
+    def start(self):
+        super().start()
+        # restart semantics (gst NULL→PLAYING): frame count and the
+        # live-pacing epoch reset, else a stopped-and-restarted live
+        # source sees a schedule T seconds in the past and floods
+        self.i = 0
+        self._live_t0 = None
 
     def _caps(self) -> Caps:
         return Caps(
@@ -135,7 +144,18 @@ class VideoTestSrc(SourceElement):
         buf = TensorBuffer([self._frame(self.i)], pts=self.i * dur,
                            duration=dur)
         if self.get_property("is_live") and dur:
-            time.sleep(dur / 1e9)
+            # pace against the WALL CLOCK (gst live-source semantics),
+            # not sleep-per-frame: a source stalled in a downstream
+            # block (e.g. the first dispatch's trace/compile) catches
+            # back up to schedule instead of lagging its siblings
+            # forever — which would make every slowest-sync mux row
+            # wait the full stall for this pad
+            if self._live_t0 is None:
+                self._live_t0 = time.monotonic()
+            target = self._live_t0 + (self.i + 1) * dur / 1e9
+            delay = target - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
         self.i += 1
         return buf
 
