@@ -798,11 +798,14 @@ def measure_serve() -> dict:
 
     from nnstreamer_tpu.models.transformer import init_cache
 
-    n_params = sum(int(np.prod(v.shape))
-                   for v in jax.tree_util.tree_leaves(
-                       jax.eval_shape(lambda: init_params(cfg))))
-    itemsize = np.dtype(jnp.bfloat16).itemsize
-    params_bytes = n_params * itemsize
+    # bytes from the ACTUAL leaf dtypes (init_params stores f32 master
+    # weights; assuming cfg.dtype here would halve params_bytes and
+    # inflate the ceiling)
+    param_leaves = jax.tree_util.tree_leaves(
+        jax.eval_shape(lambda: init_params(cfg)))
+    n_params = sum(int(np.prod(v.shape)) for v in param_leaves)
+    params_bytes = sum(int(np.prod(v.shape)) * v.dtype.itemsize
+                       for v in param_leaves)
     cache_bytes = sum(
         int(np.prod(a.shape)) * a.dtype.itemsize
         for a in jax.tree_util.tree_leaves(

@@ -67,6 +67,17 @@ class TensorAggregator(Element):
         if self._pts is None:
             self._pts = buf.pts
         n = max(fin, 1)
+        # validate every tensor BEFORE mutating windows or stamps: a
+        # mid-loop failure would leave them desynchronized for any caller
+        # that catches the error and keeps streaming
+        for arr in buf.tensors:
+            axis = self._axis(arr)
+            if arr.shape[axis] % n:
+                raise ValueError(
+                    f"tensor_aggregator: dim "
+                    f"{self.get_property('frames_dim')} size "
+                    f"{arr.shape[axis]} not divisible by frames-in {n}"
+                )
         stamps = buf.create_stamps()
         if stamps:
             # exactly one stamp per unit frame keeps the stamp list in
@@ -76,16 +87,18 @@ class TensorAggregator(Element):
             # of them — conservative (reports the longest latency)
             if len(stamps) != n:
                 stamps = [min(stamps)] * n
-            self._create_ts.extend(stamps)
+        if stamps or self._create_ts:
+            # mixed stamped/unstamped upstreams (frames pushed straight
+            # into srcpad.push interleaved with SourceElement frames)
+            # must not shift stamp→window attribution: pad any historical
+            # deficit and this buffer's missing stamps with None
+            # placeholders so indices stay aligned (filtered at emit)
+            deficit = max(0, len(self._windows[0]) - len(self._create_ts))
+            self._create_ts.extend([None] * deficit)
+            self._create_ts.extend(stamps if stamps else [None] * n)
         for ti, arr in enumerate(buf.tensors):
             axis = self._axis(arr)
             # split the incoming tensor into its `frames_in` unit frames
-            if arr.shape[axis] % n:
-                raise ValueError(
-                    f"tensor_aggregator: dim "
-                    f"{self.get_property('frames_dim')} size "
-                    f"{arr.shape[axis]} not divisible by frames-in {n}"
-                )
             per = arr.shape[axis] // n
             for k in range(n):
                 sl = [slice(None)] * arr.ndim
@@ -116,7 +129,10 @@ class TensorAggregator(Element):
                 )
             meta = {}
             if self._create_ts:
-                meta["create_ts"] = list(self._create_ts[:fout])
+                out_ts = [s for s in self._create_ts[:fout]
+                          if s is not None]
+                if out_ts:
+                    meta["create_ts"] = out_ts
             ret = self.srcpad.push(
                 TensorBuffer(outs, pts=self._pts, meta=meta)
             )

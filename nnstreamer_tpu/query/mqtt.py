@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import socket
 import struct
+import sys
 import threading
 import time
 import uuid
@@ -315,6 +316,13 @@ class MqttClient:
     within 1.5x the ping interval marks the connection dead
     [MQTT-3.1.2-24]."""
 
+    #: QoS1 in-flight cap: past this, the oldest unacked message is
+    #: abandoned (logged) rather than the map growing without bound
+    MAX_UNACKED = 256
+    #: keepalive-tick retransmits per message before giving up on a
+    #: peer that never PUBACKs
+    MAX_RETRANSMITS = 16
+
     def __init__(self, host: str = "127.0.0.1", port: int = 1883,
                  client_id: Optional[str] = None, keepalive: int = 60,
                  timeout: float = 10.0, reconnect: bool = True,
@@ -329,10 +337,21 @@ class MqttClient:
         self._subs: List[Tuple[str, Callable[[str, bytes], None], int]] = []
         self._lock = threading.Lock()
         self._pid = 0
-        self._suback = threading.Event()
-        self._suback_codes: Optional[bytes] = None
-        #: QoS1 in flight: pid → (topic, payload, retain, acked-event)
-        self._unacked: Dict[int, tuple] = {}
+        #: pid → (done-event, one-slot codes list, topic filter) per
+        #: subscribe() awaiting its own SUBACK — correlated by packet id
+        #: so the N resubscribe SUBACKs emitted during _recover can't
+        #: satisfy a concurrent subscribe() or leak another
+        #: subscription's return codes; the filter lets a successful
+        #: resubscribe complete a waiter whose own SUBSCRIBE was lost to
+        #: the link drop
+        self._pending_subacks: Dict[int, tuple] = {}
+        #: pid → topic filter for _recover resubscribes (failure logging)
+        self._resub_pids: Dict[int, str] = {}
+        #: QoS1 in flight: pid → [topic, payload, retain, done-event,
+        #: retransmit-count, status("pending"/"acked"/"abandoned")];
+        #: bounded so fire-and-forget publishes against a never-PUBACKing
+        #: peer can't grow memory forever
+        self._unacked: Dict[int, list] = {}
         self._cid = client_id or f"nnstpu-{uuid.uuid4().hex[:12]}"
         self._pong_at = time.monotonic()
         self._ping_at = 0.0
@@ -367,10 +386,16 @@ class MqttClient:
         sock.settimeout(None)
         # bounded SENDS without touching recv: a half-open peer whose
         # window closed must fail a sendall (freeing self._lock) instead
-        # of wedging the pinger/publishers forever
-        tv = struct.pack("ll", int(self._timeout),
-                         int(self._timeout % 1 * 1e6))
-        sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO, tv)
+        # of wedging the pinger/publishers forever. "ll" matches struct
+        # timeval only where the kernel reads two native-long-sized
+        # fields (Linux; LP64 little-endian macOS reads tv_usec from the
+        # low half of the second long, which also works); on platforms
+        # where the layout is unknown, skip the option rather than pack
+        # garbage into setsockopt
+        if sys.platform.startswith(("linux", "darwin")):
+            tv = struct.pack("ll", int(self._timeout),
+                             int(self._timeout % 1 * 1e6))
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO, tv)
         self._pong_at = time.monotonic()
         self._ping_at = 0.0
         return sock
@@ -405,11 +430,14 @@ class MqttClient:
                 subs = list(self._subs)
                 unacked = list(self._unacked.items())
                 try:
+                    self._resub_pids.clear()
                     for filt, _cb, qos in subs:
                         self._pid = self._pid % 0xFFFF + 1
+                        self._resub_pids[self._pid] = filt
                         sock.sendall(subscribe_packet(self._pid, filt,
                                                       qos=qos))
-                    for pid, (topic, payload, retain, _evt) in unacked:
+                    for pid, (topic, payload, retain,
+                              *_rest) in unacked:
                         sock.sendall(publish_packet(topic, payload, retain,
                                                     qos=1, packet_id=pid,
                                                     dup=True))
@@ -462,14 +490,26 @@ class MqttClient:
             except OSError:
                 pass  # reader sees the dead socket and recovers
             # background at-least-once: resend unacked QoS1 with DUP each
-            # keepalive tick (covers fire-and-forget publishes too)
+            # keepalive tick (covers fire-and-forget publishes too), but
+            # give up after MAX_RETRANSMITS — a peer that never PUBACKs
+            # must not cost bandwidth forever
             with self._lock:
-                unacked = list(self._unacked.items())
-                for pid, (topic, payload, retain, _evt) in unacked:
+                for pid in list(self._unacked):
+                    entry = self._unacked[pid]
+                    if entry[4] >= self.MAX_RETRANSMITS:
+                        del self._unacked[pid]
+                        entry[5] = "abandoned"
+                        entry[3].set()  # wake a blocked publish() waiter
+                        log.warning(
+                            "mqtt: abandoning QoS1 packet %d to %r after "
+                            "%d retransmits without PUBACK", pid, entry[0],
+                            entry[4])
+                        continue
+                    entry[4] += 1
                     try:
                         self._sock.sendall(publish_packet(
-                            topic, payload, retain, qos=1, packet_id=pid,
-                            dup=True))
+                            entry[0], entry[1], entry[2], qos=1,
+                            packet_id=pid, dup=True))
                     except OSError:
                         break
 
@@ -488,9 +528,19 @@ class MqttClient:
             raise ValueError("mqtt: only QoS 0/1 supported")
         evt = threading.Event()
         with self._lock:
+            if len(self._unacked) >= self.MAX_UNACKED:
+                old_pid = next(iter(self._unacked))
+                old = self._unacked.pop(old_pid)
+                old[5] = "abandoned"
+                old[3].set()  # wake a blocked publish() waiter
+                log.warning(
+                    "mqtt: QoS1 backlog full (%d); abandoning oldest "
+                    "unacked packet %d to %r", self.MAX_UNACKED, old_pid,
+                    old[0])
             self._pid = self._pid % 0xFFFF + 1
             pid = self._pid
-            self._unacked[pid] = (topic, payload, retain, evt)
+            entry = [topic, payload, retain, evt, 0, "pending"]
+            self._unacked[pid] = entry
             self._sock.sendall(publish_packet(topic, payload, retain,
                                               qos=1, packet_id=pid))
         if timeout is not None:
@@ -499,7 +549,7 @@ class MqttClient:
                 if time.monotonic() > deadline:
                     with self._lock:
                         if evt.is_set():  # PUBACK landed in the gap
-                            return
+                            break
                         # the caller is told delivery failed — stop
                         # retransmitting a message they will re-send
                         self._unacked.pop(pid, None)
@@ -507,28 +557,43 @@ class MqttClient:
                         f"mqtt: no PUBACK for packet {pid} within "
                         f"{timeout}s")
                 with self._lock:
-                    try:  # retransmit with DUP while waiting
-                        self._sock.sendall(publish_packet(
-                            topic, payload, retain, qos=1, packet_id=pid,
-                            dup=True))
-                    except OSError:
-                        pass
+                    # retransmit only while still in flight: an entry
+                    # the keepalive loop abandoned must stop costing
+                    # bandwidth here too
+                    if pid in self._unacked:
+                        try:  # retransmit with DUP while waiting
+                            self._sock.sendall(publish_packet(
+                                topic, payload, retain, qos=1,
+                                packet_id=pid, dup=True))
+                        except OSError:
+                            pass
+            if entry[5] != "acked":
+                raise ConnectionError(
+                    f"mqtt: QoS1 packet {pid} abandoned after "
+                    f"{entry[4]} retransmits without PUBACK")
 
     def subscribe(self, topic_filter: str,
                   cb: Callable[[str, bytes], None],
                   timeout: float = 10.0, qos: int = 0) -> None:
         """Subscribe. Tensor streams default to QoS0 (latest-wins, no
         broker-side tracking); pass ``qos=1`` for control topics."""
+        evt = threading.Event()
+        slot: list = [None]  # SUBACK return codes land here, by pid
         with self._lock:
             self._pid = self._pid % 0xFFFF + 1
+            pid = self._pid
             self._subs.append((topic_filter, cb, qos))
-            self._suback.clear()
-            self._suback_codes = None
-            self._sock.sendall(subscribe_packet(self._pid, topic_filter,
+            self._pending_subacks[pid] = (evt, slot, topic_filter)
+            self._sock.sendall(subscribe_packet(pid, topic_filter,
                                                 qos=qos))
-        if not self._suback.wait(timeout):
-            raise ConnectionError(f"mqtt: no SUBACK for {topic_filter!r}")
-        codes = self._suback_codes or b""
+        try:
+            if not evt.wait(timeout):
+                raise ConnectionError(
+                    f"mqtt: no SUBACK for {topic_filter!r}")
+        finally:
+            with self._lock:
+                self._pending_subacks.pop(pid, None)
+        codes = slot[0] or b""
         if any(c == 0x80 for c in codes):  # spec 3.9.3: 0x80 = failure
             with self._lock:
                 self._subs.remove((topic_filter, cb, qos))
@@ -564,10 +629,32 @@ class MqttClient:
                     with self._lock:
                         entry = self._unacked.pop(pid, None)
                     if entry is not None:
+                        entry[5] = "acked"
                         entry[3].set()
                 elif ptype == SUBACK:
-                    self._suback_codes = body[2:]  # skip packet id
-                    self._suback.set()
+                    (pid,) = struct.unpack_from(">H", body)
+                    codes = body[2:]
+                    with self._lock:
+                        waiters = []
+                        w = self._pending_subacks.get(pid)
+                        if w is not None:
+                            waiters.append(w)
+                        refilt = self._resub_pids.pop(pid, None)
+                        if refilt is not None:
+                            # a subscribe() whose own SUBSCRIBE was lost
+                            # to the link drop is satisfied by _recover's
+                            # resubscribe of the same filter
+                            waiters.extend(
+                                pw for pw in
+                                self._pending_subacks.values()
+                                if pw[2] == refilt and pw is not w)
+                    for evt_, slot_, _filt in waiters:
+                        slot_[0] = codes
+                        evt_.set()
+                    if refilt is not None and not waiters and \
+                            any(c == 0x80 for c in codes):
+                        log.warning("mqtt: broker rejected resubscription"
+                                    " to %r", refilt)
                 elif ptype == PINGRESP:
                     self._pong_at = time.monotonic()
                 elif ptype == PINGREQ:

@@ -253,6 +253,42 @@ class TestEndToEndLatency:
         assert len(sink.latencies) == 8  # per frame, not per buffer
         assert sink.latency_percentiles() is not None
 
+    def test_mixed_stamped_unstamped_frames_stay_aligned(self):
+        """Frames pushed without create stamps interleaved with stamped
+        ones must not shift stamp→frame attribution: the aggregator pads
+        placeholders so each emitted window reports only its own frames'
+        stamps (ADVICE r4: aggregator.py stamp/window lockstep)."""
+        import time
+
+        from nnstreamer_tpu.elements.aggregator import TensorAggregator
+        from nnstreamer_tpu.elements.sink import TensorSink
+        from nnstreamer_tpu.tensors.buffer import TensorBuffer
+
+        agg = TensorAggregator("agg")
+        agg.set_property("frames_in", 1)
+        agg.set_property("frames_out", 2)
+        agg.set_property("frames_flush", 2)
+        agg.set_property("frames_dim", 0)
+        agg.set_property("concat", True)
+        sink = TensorSink("out")
+        agg.srcpad.link(sink.sinkpad)
+        arr = np.zeros((1, 4), np.float32)
+        t0 = time.time() - 5.0  # distinctively old stamp
+        # window 1: unstamped + stamped(t0); window 2: stamped(now) x2
+        agg.chain(agg.sinkpad, TensorBuffer([arr], pts=0))
+        agg.chain(agg.sinkpad,
+                  TensorBuffer([arr], pts=1, meta={"create_t": t0}))
+        now = time.time()
+        agg.chain(agg.sinkpad,
+                  TensorBuffer([arr], pts=2, meta={"create_t": now}))
+        agg.chain(agg.sinkpad,
+                  TensorBuffer([arr], pts=3, meta={"create_t": now}))
+        assert len(sink.buffers) == 2
+        w1 = sink.buffers[0].meta.get("create_ts")
+        w2 = sink.buffers[1].meta.get("create_ts")
+        assert w1 == [t0]          # placeholder filtered, stamp not shifted
+        assert w2 == [now, now]    # second window owns only its stamps
+
     def test_mux_latency_spans_all_streams(self):
         from nnstreamer_tpu import parse_launch
 
