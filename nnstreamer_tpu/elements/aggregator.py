@@ -6,10 +6,26 @@ buffer, emits ``frames-out`` frames per output, advancing by
 ``frames-flush`` (sliding window when flush < out), concatenating along
 ``frames-dim``. This is the stream-side micro-batching / sequence-window
 primitive (SURVEY §2.4.3) — e.g. windowing audio for a sequence model.
+
+``latency-budget-ms`` adds latency-budget adaptive batching on top: a
+window that would otherwise hold frames past the budget waiting to fill
+is flushed EARLY, padded to ``frames-out`` by repeating the last frame so
+the downstream jitted program keeps its single compiled shape (no
+per-partial-size recompiles). The padded output carries
+``meta["valid_frames"]=k``; ``tensor_sink`` slices the padding off at
+materialization and latency stamps cover only the real frames. This is
+the per-frame-latency half of the north-star metric: the reference's
+per-frame path (tensor_filter.c:349-423) never batches, so its p50 is
+one service time — budget mode bounds the admission wait a micro-batched
+stream adds while keeping the batched throughput path intact (full
+windows are never padded, and a saturated stream fills windows faster
+than any budget fires).
 """
 
 from __future__ import annotations
 
+import threading
+import time
 from typing import List, Optional
 
 import numpy as np
@@ -29,6 +45,22 @@ class TensorAggregator(Element):
         "frames_flush": 0,   # 0 → == frames_out (no overlap)
         "frames_dim": 0,     # innermost-first dim index to aggregate along
         "concat": True,
+        # >0: flush a PARTIAL window (padded to frames-out, with
+        # meta["valid_frames"]) once the oldest queued frame has waited
+        # this many ms — latency-budget adaptive batching. A budget
+        # flush emits everything queued (sliding-window overlap does not
+        # apply to it) and the remaining tail is flushed at EOS.
+        "latency_budget_ms": 0,
+        # partial-flush padding placement: false (default) pads on host
+        # to frames-out — universal, but the pad rows cross the H2D link
+        # too. true emits only the k real frames plus
+        # meta["pad_rows"]; a downstream prefetch-device queue applies
+        # the zero-pad ON DEVICE (tensors/buffer.py pad_rows_device), so
+        # the wire carries k frames while the jitted filter still sees
+        # its one compiled frames-out shape. Requires such a queue
+        # downstream — without one the filter sees [k] and recompiles
+        # per distinct k.
+        "pad_device": False,
     }
 
     def __init__(self, name=None, **props):
@@ -43,6 +75,31 @@ class TensorAggregator(Element):
         #: windows — emitted as meta["create_ts"] so end-to-end latency
         #: under micro-batching includes each frame's batch-window wait
         self._create_ts: List[float] = []
+        #: budget clock per queued unit frame: its create stamp when one
+        #: flowed (end-to-end budget), else its aggregator arrival time
+        self._held_since: List[float] = []
+        #: serializes chain() with the budget flusher thread — both push
+        #: downstream, and a flush must not interleave with window append
+        self._lock = threading.RLock()
+        self._flusher: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+
+    def start(self):
+        super().start()
+        budget = float(self.get_property("latency_budget_ms"))
+        if budget > 0:
+            self._stop_evt.clear()
+            self._flusher = threading.Thread(
+                target=self._flush_loop, args=(budget / 1e3,),
+                daemon=True, name=f"{self.name}-budget")
+            self._flusher.start()
+
+    def stop(self):
+        self._stop_evt.set()
+        if self._flusher is not None:
+            self._flusher.join(timeout=5)
+            self._flusher = None
+        super().stop()
 
     def transform_caps(self, pad, caps):
         return None  # announced from the first output (shape changes)
@@ -51,6 +108,10 @@ class TensorAggregator(Element):
         return arr.ndim - 1 - int(self.get_property("frames_dim"))
 
     def chain(self, pad, buf):
+        with self._lock:
+            return self._chain_locked(pad, buf)
+
+    def _chain_locked(self, pad, buf):
         fin = int(self.get_property("frames_in"))
         fout = int(self.get_property("frames_out"))
         flush = int(self.get_property("frames_flush")) or fout
@@ -96,6 +157,12 @@ class TensorAggregator(Element):
             deficit = max(0, len(self._windows[0]) - len(self._create_ts))
             self._create_ts.extend([None] * deficit)
             self._create_ts.extend(stamps if stamps else [None] * n)
+        budget = float(self.get_property("latency_budget_ms"))
+        if budget > 0:
+            now = time.monotonic()
+            self._held_since.extend(
+                (stamps[i] if stamps and stamps[i] is not None else now)
+                for i in range(n))
         for ti, arr in enumerate(buf.tensors):
             axis = self._axis(arr)
             # split the incoming tensor into its `frames_in` unit frames
@@ -106,27 +173,9 @@ class TensorAggregator(Element):
                 self._windows[ti].append(arr[tuple(sl)])
         ret = None
         while all(len(w) >= fout for w in self._windows):
-            outs = []
-            for w in self._windows:
-                chunk = w[:fout]
-                axis = self._axis(chunk[0])
-                if self.get_property("concat"):
-                    if is_device_array(chunk[0]):
-                        import jax.numpy as jnp
-
-                        outs.append(jnp.concatenate(chunk, axis=axis))
-                    else:
-                        outs.append(np.concatenate(chunk, axis=axis))
-                else:
-                    # concat=false: collected frames stay separate tensors
-                    # (reference tensor_aggregator concat property)
-                    outs.extend(chunk)
-            if self.srcpad.caps is None:
-                from nnstreamer_tpu.tensors.types import TensorsConfig
-
-                self.srcpad.set_caps(
-                    TensorsConfig.from_arrays(outs).to_caps()
-                )
+            outs = self._concat_windows(
+                [w[:fout] for w in self._windows])
+            self._announce_caps(outs)
             meta = {}
             if self._create_ts:
                 out_ts = [s for s in self._create_ts[:fout]
@@ -138,10 +187,114 @@ class TensorAggregator(Element):
             )
             self._windows = [w[flush:] for w in self._windows]
             self._create_ts = self._create_ts[flush:]
+            self._held_since = self._held_since[flush:]
             self._pts = buf.pts
+        if budget > 0 and self._held_since and \
+                time.monotonic() - self._held_since[0] >= budget / 1e3 \
+                and self._downstream_ready():
+            ret = self._emit_partial() or ret
+        return ret
+
+    def _downstream_ready(self) -> bool:
+        """Backpressure gate for budget flushes: a partial flush is a
+        latency optimization, and it only helps while the downstream can
+        absorb the extra dispatch. When the link/device is saturated
+        (the downstream queue is full), flushing MORE, SMALLER windows
+        compounds the backlog — measured 13x worse p50 on a degraded
+        tunnel. Holding instead lets the window fill toward a full
+        batch, i.e. budget mode degrades gracefully to plain batching
+        under overload. Full windows are exempt: they flush through the
+        normal (blocking) path regardless."""
+        peer = self.srcpad.peer
+        ready = getattr(getattr(peer, "element", None), "accepts_now",
+                        None)
+        return True if ready is None else bool(ready())
+
+    def _flush_loop(self, budget_s: float):
+        """Budget watchdog: chain() only runs on arrivals, so a stalled
+        upstream would otherwise hold queued frames past the budget
+        forever. Ticks at budget/4 → a frame overstays by at most ~25%."""
+        tick = max(budget_s / 4, 0.005)
+        while not self._stop_evt.wait(tick):
+            with self._lock:
+                if self._held_since and \
+                        time.monotonic() - self._held_since[0] >= budget_s \
+                        and self._downstream_ready():
+                    self._emit_partial()
+
+    def _concat_windows(self, chunks):
+        """Emit-side payload assembly shared by the full-window and
+        budget-flush paths: one concatenated tensor per window
+        (concat=true) or the unit frames as separate tensors."""
+        outs = []
+        for chunk in chunks:
+            if self.get_property("concat"):
+                axis = self._axis(chunk[0])
+                if is_device_array(chunk[0]):
+                    import jax.numpy as jnp
+
+                    outs.append(jnp.concatenate(chunk, axis=axis))
+                else:
+                    outs.append(np.concatenate(chunk, axis=axis))
+            else:
+                # concat=false: collected frames stay separate tensors
+                # (reference tensor_aggregator concat property)
+                outs.extend(chunk)
+        return outs
+
+    def _announce_caps(self, outs):
+        if self.srcpad.caps is None:
+            from nnstreamer_tpu.tensors.types import TensorsConfig
+
+            self.srcpad.set_caps(TensorsConfig.from_arrays(outs).to_caps())
+
+    def _emit_partial(self):
+        """Flush the queued k < frames-out frames. With concat=true on a
+        leading (axis-0) frame axis the window is padded to frames-out
+        (one compiled downstream shape) and ``meta["valid_frames"]=k``
+        lets the sink trim the padding; ``pad-device`` defers that pad
+        to a downstream prefetch-device queue so only the k real frames
+        cross the H2D link. Non-leading concat axes and concat=false
+        emit the k real frames UNPADDED (self-describing shapes — the
+        sink's axis-0 trim cannot apply there). Caller holds
+        ``self._lock``."""
+        fout = int(self.get_property("frames_out"))
+        k = len(self._windows[0]) if self._windows else 0
+        if not k:
+            return None
+        pad_ok = (self.get_property("concat") and k < fout and
+                  self._axis(self._windows[0][0]) == 0)
+        # the device-pad path needs announced caps (set below from a
+        # host-padded first window)
+        on_device_pad = (pad_ok and bool(self.get_property("pad_device"))
+                         and self.srcpad.caps is not None)
+        pad_n = (fout - k) if (pad_ok and not on_device_pad) else 0
+        outs = self._concat_windows(
+            [list(w) + [w[-1]] * pad_n for w in self._windows])
+        if not on_device_pad:
+            self._announce_caps(outs)
+        meta = {}
+        if pad_ok:
+            meta["valid_frames"] = k
+            if on_device_pad:
+                meta["pad_rows"] = fout - k
+        out_ts = [s for s in self._create_ts[:k] if s is not None]
+        if out_ts:
+            meta["create_ts"] = out_ts
+        ret = self.srcpad.push(TensorBuffer(outs, pts=self._pts, meta=meta))
+        self._windows = [[] for _ in self._windows]
+        self._create_ts = []
+        self._held_since = []
+        self._pts = None
         return ret
 
     def handle_eos(self):
-        self._windows.clear()
-        self._create_ts.clear()
-        self._pts = None
+        with self._lock:
+            if float(self.get_property("latency_budget_ms")) > 0:
+                # budget mode promises every frame a bounded exit: the
+                # partial tail flushes instead of being dropped
+                self._emit_partial()
+            self._windows.clear()
+            self._create_ts.clear()
+            self._held_since.clear()
+            self._pts = None

@@ -60,6 +60,15 @@ class TensorSink(Element):
         # stage's tensors, e.g. two scalars, never full frames)
         if self.get_property("to_host") or buf.finalize is not None:
             buf = buf.to_host()
+            # a latency-budget partial window (aggregator
+            # latency-budget-ms) was padded to the compiled batch shape;
+            # trim each tensor back to its k valid leading rows so the
+            # app never sees the padding frames
+            k = buf.meta.get("valid_frames")
+            if k:
+                buf = buf.with_tensors([
+                    t[:k] if getattr(t, "ndim", 0) and t.shape[0] > k
+                    else t for t in buf.tensors])
         # end-to-end frame latency: source create() → here (payload is
         # host-materialized above). Under micro-batching meta carries one
         # capture stamp per constituent frame, so each frame's latency

@@ -160,6 +160,17 @@ class Queue(Element):
             self._worker = None
         super().stop()
 
+    def accepts_now(self) -> bool:
+        """True when a push would be absorbed without blocking/dropping.
+        Latency-budget upstreams (aggregator latency-budget-ms) poll
+        this before flushing a partial window early: when the pipeline
+        is backed up, holding the window (letting it fill toward a full
+        batch) beats stacking more dispatches onto a saturated link."""
+        if self._worker is None:
+            return True
+        maxsize = self._q.maxsize
+        return maxsize <= 0 or self._q.qsize() < maxsize
+
     def chain(self, pad, buf):
         if self.get_property("prefetch_host") and \
                 not self.get_property("materialize_host"):
@@ -171,13 +182,20 @@ class Queue(Element):
                 start_async = getattr(t, "copy_to_host_async", None)
                 if start_async is not None:
                     start_async()
-        if self.get_property("prefetch_device") and not buf.on_device():
-            # mirror image of prefetch_host: start H2D for host tensors NOW
-            # so the downstream jitted consumer dispatches against device
-            # arrays (transfer overlaps the previous frame's compute; on a
-            # tunneled chip the per-call transfer RPC otherwise serializes
-            # into every dispatch)
-            buf = buf.to_device()
+        if self.get_property("prefetch_device"):
+            if not buf.on_device():
+                # mirror image of prefetch_host: start H2D for host
+                # tensors NOW so the downstream jitted consumer
+                # dispatches against device arrays (transfer overlaps
+                # the previous frame's compute; on a tunneled chip the
+                # per-call transfer RPC otherwise serializes into every
+                # dispatch)
+                buf = buf.to_device()
+            # a latency-budget partial window deferred its padding here
+            # (aggregator pad-device): only the real frames crossed the
+            # link; the zero rows are synthesized on device now
+            if buf.meta.get("pad_rows"):
+                buf = buf.pad_rows_device()
         if self._worker is None:  # not started: degenerate passthrough
             return self.srcpad.push(buf)
         if self.get_property("leaky") == "downstream":

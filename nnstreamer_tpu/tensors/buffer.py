@@ -31,6 +31,25 @@ from nnstreamer_tpu.tensors.types import (
 CLOCK_NONE: Optional[int] = None
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=256)
+def _pad_rows_fn(r: int, shape: tuple, dtype: str):
+    """Jitted axis-0 zero-pad, cached per (pad, shape, dtype) so each
+    partial-window size costs one small compile, then one fused device
+    dispatch per tensor (see TensorBuffer.pad_rows_device)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        return jnp.concatenate(
+            [x, jnp.zeros((r,) + tuple(shape[1:]), x.dtype)], axis=0)
+
+    return f
+
+
 def is_device_array(x) -> bool:
     """True if ``x`` is a jax.Array (device-resident)."""
     import jax
@@ -132,6 +151,22 @@ class TensorBuffer:
         out = [jax.device_put(t, tgt) if tgt is not None else jax.device_put(t)
                for t in self.tensors]
         return self.replace(tensors=out)
+
+    def pad_rows_device(self) -> "TensorBuffer":
+        """Apply a deferred partial-window pad (aggregator
+        ``pad-device``): zero-pad ``meta["pad_rows"]`` leading-axis rows
+        onto each (device-resident) tensor with one tiny jitted program
+        per (shape, pad) — the pad rows never cross the H2D link, and
+        the downstream jitted consumer keeps its single full-window
+        compiled shape. No-op without the meta key."""
+        r = self.meta.get("pad_rows")
+        if not r:
+            return self
+        out = [_pad_rows_fn(int(r), t.shape, str(t.dtype))(t)
+               for t in self.tensors]
+        meta = dict(self.meta)
+        del meta["pad_rows"]
+        return self.replace(tensors=out, meta=meta)
 
     def block_until_ready(self) -> "TensorBuffer":
         for t in self.tensors:
