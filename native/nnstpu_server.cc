@@ -20,6 +20,14 @@
 //
 // Framing (little-endian, shared with nnstpu.cc / query/protocol.py):
 //   u32 magic 'NTQ1'  u32 command  u64 payload_len  payload…
+//
+// Wire modes (nnstpu_server_start2): 0 = NTQ1 above; 1/2 = the
+// REFERENCE query wire (tensor_query_common.c:320-450 raw host
+// structs: i32 cmd, then u64 size+bytes / 176-byte DataInfo / i64
+// client id). Mode 1 plays the server-src port (CLIENT_ID on accept,
+// REQUEST_INFO→APPROVE, TRANSFER_START/DATA/END assembly → queue);
+// mode 2 plays the server-sink port (CLIENT_ID claim remaps the
+// connection so nnstpu_server_send_raw routes results by claimed id).
 
 #include <arpa/inet.h>
 #include <netdb.h>
@@ -64,10 +72,27 @@ struct Frame {
   std::vector<uint8_t> payload;
 };
 
+// reference TensorQueryCommand values (tensor_query_common.h:46-56)
+enum RefCmd : int32_t {
+  kRefRequestInfo = 0,
+  kRefApprove = 1,
+  kRefDeny = 2,
+  kRefTransferStart = 3,
+  kRefTransferData = 4,
+  kRefTransferEnd = 5,
+  kRefClientId = 6,
+};
+constexpr size_t kRefDataInfoSize = 176;  // sizeof(TensorQueryDataInfo)
+
 struct Conn {
   int fd = -1;
   uint32_t id = 0;
   std::vector<uint8_t> inbuf;
+  // reference-wire TRANSFER assembly (wire mode 1): DataInfo + mems
+  // accumulated until TRANSFER_END completes the buffer
+  std::vector<uint8_t> ref_asm;
+  uint32_t ref_mems_left = 0;
+  bool ref_in_transfer = false;
   // serializes writers to this socket: epoll-thread replies vs Python-
   // thread result sends (shared_ptr: senders may outlive the Conn)
   std::shared_ptr<std::mutex> wmu = std::make_shared<std::mutex>();
@@ -121,6 +146,7 @@ struct Server {
   uint16_t port = 0;
   std::string caps;
   size_t max_queue = 64;
+  int wire = 0;  // 0 NTQ1, 1 reference src-port, 2 reference sink-port
 
   std::thread loop;
   std::atomic<bool> stopping{false};
@@ -142,7 +168,8 @@ struct Server {
   void run();
   void close_conn_locked(int fd);
   void handle_readable(int fd);
-  bool parse_frames(Conn& c);  // false → close the connection
+  bool parse_frames(Conn& c);      // false → close the connection
+  bool parse_ref_frames(Conn& c);  // reference-wire parser (modes 1/2)
   void set_reads_enabled_locked(bool on);
   void wake() {
     uint64_t v = 1;
@@ -154,7 +181,10 @@ struct Server {
 void Server::close_conn_locked(int fd) {
   auto it = conns.find(fd);
   if (it == conns.end()) return;
-  by_id.erase(it->second.id);
+  // erase the routing entry only if it still points at THIS socket — a
+  // reconnecting client may have re-claimed the id onto a new fd
+  auto bi = by_id.find(it->second.id);
+  if (bi != by_id.end() && bi->second.first == fd) by_id.erase(bi);
   conns.erase(it);
   epoll_ctl(epoll_fd, EPOLL_CTL_DEL, fd, nullptr);
   close(fd);
@@ -219,6 +249,114 @@ bool Server::parse_frames(Conn& c) {
   return true;
 }
 
+// raw (unframed) blocking send; caller holds the write mutex. Used for
+// reference-wire replies/results whose framing Python (or this parser)
+// already laid out byte-exactly.
+int send_raw_all(int fd, const uint8_t* data, uint64_t len,
+                 int stall_ms = 10000) {
+  size_t off = 0;
+  while (off < len) {
+    ssize_t n = send(fd, data + off, len - off, MSG_NOSIGNAL);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        struct pollfd p = {fd, POLLOUT, 0};
+        if (poll(&p, 1, stall_ms) <= 0) return -1;
+        continue;
+      }
+      return -1;
+    }
+    off += (size_t)n;
+  }
+  return 0;
+}
+
+// Incremental parser for the reference query wire
+// (tensor_query_common.c:320-391 receive logic, byte-for-byte). Every
+// message: i32 cmd, then a cmd-specific body. Wire mode 1 (src port)
+// accepts REQUEST_INFO + TRANSFER sequences; mode 2 (sink port)
+// accepts only the CLIENT_ID claim.
+bool Server::parse_ref_frames(Conn& c) {
+  size_t off = 0;
+  for (;;) {
+    if (c.inbuf.size() - off < 4) break;
+    int32_t cmd;
+    memcpy(&cmd, c.inbuf.data() + off, 4);
+    size_t pos = off + 4;
+    if (cmd == kRefRequestInfo || cmd == kRefTransferData) {
+      if (c.inbuf.size() - pos < 8) break;
+      uint64_t len;
+      memcpy(&len, c.inbuf.data() + pos, 8);
+      if (len > (1ULL << 33)) return false;
+      pos += 8;
+      if (c.inbuf.size() - pos < len) break;
+      const uint8_t* body = c.inbuf.data() + pos;
+      pos += len;
+      if (cmd == kRefRequestInfo) {
+        if (wire != 1) return false;
+        // client caps in body (ignored: the server pipeline's caps
+        // gate); reply APPROVE with our caps, NUL-terminated
+        std::lock_guard<std::mutex> w(*c.wmu);
+        uint8_t hdr[12];
+        int32_t ap = kRefApprove;
+        uint64_t clen = caps.size() + 1;
+        memcpy(hdr, &ap, 4);
+        memcpy(hdr + 4, &clen, 8);
+        if (send_raw_all(c.fd, hdr, 12, kLoopSendStallMs) != 0 ||
+            send_raw_all(c.fd, (const uint8_t*)caps.c_str(), clen,
+                         kLoopSendStallMs) != 0)
+          return false;
+      } else {  // TRANSFER_DATA
+        if (wire != 1 || !c.ref_in_transfer || c.ref_mems_left == 0)
+          return false;
+        c.ref_asm.insert(c.ref_asm.end(), body, body + len);
+        c.ref_mems_left--;
+      }
+    } else if (cmd == kRefTransferStart || cmd == kRefTransferEnd) {
+      if (c.inbuf.size() - pos < kRefDataInfoSize) break;
+      const uint8_t* info = c.inbuf.data() + pos;
+      pos += kRefDataInfoSize;
+      if (wire != 1) return false;
+      if (cmd == kRefTransferStart) {
+        if (c.ref_in_transfer) return false;
+        uint32_t num_mems;
+        memcpy(&num_mems, info + 40, 4);
+        if (num_mems > 16) return false;
+        c.ref_asm.assign(info, info + kRefDataInfoSize);
+        c.ref_mems_left = num_mems;
+        c.ref_in_transfer = true;
+      } else {  // TRANSFER_END completes the buffer
+        if (!c.ref_in_transfer || c.ref_mems_left != 0) return false;
+        c.ref_in_transfer = false;
+        std::lock_guard<std::mutex> g(mu);
+        queue.push_back({c.id, std::move(c.ref_asm)});
+        c.ref_asm = {};
+        if (queue.size() >= max_queue) set_reads_enabled_locked(false);
+        cv.notify_all();
+      }
+    } else if (cmd == kRefClientId) {
+      if (c.inbuf.size() - pos < 8) break;
+      int64_t claimed;
+      memcpy(&claimed, c.inbuf.data() + pos, 8);
+      pos += 8;
+      if (wire != 2) return false;
+      // sink-port claim: route results for `claimed` to this socket
+      // (ids are assigned by our src-port server, so they fit u32).
+      // The accept-order id was never registered (see accept), so this
+      // cannot clobber another client's routing entry; a re-claim of
+      // the same id (client reconnect) replaces the stale entry.
+      std::lock_guard<std::mutex> g(mu);
+      c.id = (uint32_t)claimed;
+      by_id[c.id] = {c.fd, c.wmu};
+    } else {
+      return false;  // unknown command: drop the connection
+    }
+    off = pos;
+  }
+  if (off) c.inbuf.erase(c.inbuf.begin(), c.inbuf.begin() + off);
+  return true;
+}
+
 void Server::handle_readable(int fd) {
   Conn* c;
   {
@@ -232,7 +370,7 @@ void Server::handle_readable(int fd) {
     ssize_t n = recv(fd, tmp, sizeof(tmp), 0);
     if (n > 0) {
       c->inbuf.insert(c->inbuf.end(), tmp, tmp + n);
-      if (!parse_frames(*c)) {
+      if (!(wire == 0 ? parse_frames(*c) : parse_ref_frames(*c))) {
         std::lock_guard<std::mutex> g(mu);
         close_conn_locked(fd);
         return;
@@ -284,16 +422,39 @@ void Server::run() {
           set_nonblock(cfd);
           int one = 1;
           setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-          std::lock_guard<std::mutex> g(mu);
-          Conn c;
-          c.fd = cfd;
-          c.id = next_id++;
-          by_id[c.id] = {cfd, c.wmu};
-          conns.emplace(cfd, std::move(c));
-          struct epoll_event ev {};
-          ev.data.fd = cfd;
-          ev.events = paused ? 0u : (uint32_t)EPOLLIN;
-          epoll_ctl(epoll_fd, EPOLL_CTL_ADD, cfd, &ev);
+          uint32_t cid;
+          std::shared_ptr<std::mutex> cwmu;
+          {
+            std::lock_guard<std::mutex> g(mu);
+            Conn c;
+            c.fd = cfd;
+            c.id = cid = next_id++;
+            cwmu = c.wmu;
+            // a sink-port (wire 2) connection routes by the id it CLAIMS,
+            // not its accept-order id — registering the auto id here
+            // would collide with another client's claimed id and
+            // misroute its results
+            if (wire != 2) by_id[c.id] = {cfd, c.wmu};
+            conns.emplace(cfd, std::move(c));
+            struct epoll_event ev {};
+            ev.data.fd = cfd;
+            ev.events = paused ? 0u : (uint32_t)EPOLLIN;
+            epoll_ctl(epoll_fd, EPOLL_CTL_ADD, cfd, &ev);
+          }
+          if (wire == 1) {
+            // reference serversrc sends the assigned client id
+            // immediately on accept (tensor_query_client.c:393-401)
+            uint8_t msg[12];
+            int32_t cc = kRefClientId;
+            int64_t cid64 = (int64_t)cid;
+            memcpy(msg, &cc, 4);
+            memcpy(msg + 4, &cid64, 8);
+            std::lock_guard<std::mutex> w(*cwmu);
+            if (send_raw_all(cfd, msg, 12, kLoopSendStallMs) != 0) {
+              std::lock_guard<std::mutex> g(mu);
+              close_conn_locked(cfd);
+            }
+          }
         }
         continue;
       }
@@ -311,11 +472,12 @@ void Server::run() {
 
 extern "C" {
 
-void* nnstpu_server_start(const char* host, int port, const char* caps,
-                          int max_queue) {
+void* nnstpu_server_start2(const char* host, int port, const char* caps,
+                           int max_queue, int wire) {
   auto* s = new Server();
   s->caps = caps ? caps : "";
   if (max_queue > 0) s->max_queue = (size_t)max_queue;
+  s->wire = (wire >= 0 && wire <= 2) ? wire : 0;
   s->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
   if (s->listen_fd < 0) {
     delete s;
@@ -379,6 +541,11 @@ void* nnstpu_server_start(const char* host, int port, const char* caps,
   }
   s->loop = std::thread([s] { s->run(); });
   return s;
+}
+
+void* nnstpu_server_start(const char* host, int port, const char* caps,
+                          int max_queue) {
+  return nnstpu_server_start2(host, port, caps, max_queue, 0);
 }
 
 int nnstpu_server_port(void* h) {
@@ -449,6 +616,30 @@ int nnstpu_server_send(void* h, uint32_t client_id, uint32_t cmd,
   {
     std::lock_guard<std::mutex> w(*wmu);
     rc = send_frame_all(dupfd, cmd, payload, len);
+  }
+  close(dupfd);
+  return rc == 0 ? 0 : -2;
+}
+
+// Send pre-framed raw bytes to one client (reference-wire results whose
+// framing Python laid out). 0 ok, -1 unknown client, -2 error.
+int nnstpu_server_send_raw(void* h, uint32_t client_id,
+                           const uint8_t* payload, uint64_t len) {
+  auto* s = (Server*)h;
+  int dupfd;
+  std::shared_ptr<std::mutex> wmu;
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    auto it = s->by_id.find(client_id);
+    if (it == s->by_id.end()) return -1;
+    dupfd = dup(it->second.first);
+    if (dupfd < 0) return -2;
+    wmu = it->second.second;
+  }
+  int rc;
+  {
+    std::lock_guard<std::mutex> w(*wmu);
+    rc = send_raw_all(dupfd, payload, len);
   }
   close(dupfd);
   return rc == 0 ? 0 : -2;

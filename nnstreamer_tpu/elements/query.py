@@ -58,6 +58,13 @@ class TensorQueryClient(Element):
         # log scraping — a flaky link can otherwise drop a large fraction
         # of the stream while still ending in a clean EOS.
         "frames_dropped": 0,
+        # "nnstpu" = NTQ1 framing; "nnstreamer" = the reference's
+        # raw-struct wire (query/refwire.py) — offload to an UNMODIFIED
+        # reference tensor_query_serversrc/serversink pair
+        "wire": "nnstpu",
+        # refwire result connection (reference server-sink port);
+        # 0 → src port + 1 (the reference's usual pairing)
+        "sink_port": 0,
     }
 
     def __init__(self, name=None, **props):
@@ -65,6 +72,8 @@ class TensorQueryClient(Element):
         self.add_sink_pad("sink")
         self.add_src_pad("src")
         self._sock = None
+        self._refclient = None      # refwire transport when wire=nnstreamer
+        self._server_config = None  # refwire: server caps → TensorsConfig
         self._client_id = None
         self._server_idx = 0
         self._lock = threading.Lock()
@@ -115,6 +124,51 @@ class TensorQueryClient(Element):
         port = int(self.get_property("dest_port") or self.get_property("port"))
         return [(host, port)]
 
+    def _refwire(self) -> bool:
+        return str(self.get_property("wire")) == "nnstreamer"
+
+    def _connect_one(self, host: str, port: int) -> None:
+        """One connection attempt on the configured wire."""
+        caps_repr = repr(self.sinkpad.caps) if self.sinkpad.caps else ""
+        timeout = float(self.get_property("timeout"))
+        if self._refwire():
+            from nnstreamer_tpu.query import refwire as R
+
+            # gst-style caps text — what a real reference server parses
+            in_caps = (self.sinkpad.caps.to_string()
+                       if self.sinkpad.caps else "")
+            sink_port = int(self.get_property("sink_port") or 0) or None
+            rc = R.RefWireClient(host, port, sink_port=sink_port,
+                                 in_caps=in_caps, timeout=timeout)
+            self._refclient = rc
+            self._client_id = rc.client_id
+            self._server_config = None
+            if rc.server_caps:
+                try:
+                    from nnstreamer_tpu.pipeline.parse import (
+                        parse_caps_string,
+                    )
+
+                    self._server_config = TensorsConfig.from_caps(
+                        parse_caps_string(rc.server_caps))
+                except Exception:  # noqa: BLE001 — results stay u8
+                    self.log.info("server caps %r not parseable; "
+                                  "results surface as u8",
+                                  rc.server_caps)
+            self._sock = rc  # truthy connection marker for chain()
+            return
+        sock = P.connect(host, port, timeout=timeout)
+        P.send_msg(sock, P.Cmd.REQUEST_INFO, caps_repr.encode())
+        cmd, payload = P.recv_msg(sock)
+        if cmd is P.Cmd.DENY:
+            raise P.QueryProtocolError(f"server {host}:{port} denied")
+        if cmd is not P.Cmd.APPROVE:
+            raise P.QueryProtocolError(f"bad handshake reply {cmd}")
+        cmd, payload = P.recv_msg(sock)
+        if cmd is P.Cmd.CLIENT_ID:
+            self._client_id = int(payload.decode())
+        self._sock = sock
+
     def _connect(self):
         """Connect with failover across the server list (reference
         _client_retry_connection)."""
@@ -124,19 +178,7 @@ class TensorQueryClient(Element):
                              len(servers)):
             host, port = servers[self._server_idx % len(servers)]
             try:
-                sock = P.connect(host, port,
-                                 timeout=float(self.get_property("timeout")))
-                caps_repr = repr(self.sinkpad.caps) if self.sinkpad.caps else ""
-                P.send_msg(sock, P.Cmd.REQUEST_INFO, caps_repr.encode())
-                cmd, payload = P.recv_msg(sock)
-                if cmd is P.Cmd.DENY:
-                    raise P.QueryProtocolError(f"server {host}:{port} denied")
-                if cmd is not P.Cmd.APPROVE:
-                    raise P.QueryProtocolError(f"bad handshake reply {cmd}")
-                cmd, payload = P.recv_msg(sock)
-                if cmd is P.Cmd.CLIENT_ID:
-                    self._client_id = int(payload.decode())
-                self._sock = sock
+                self._connect_one(host, port)
                 return
             except (OSError, P.QueryProtocolError) as e:
                 last_err = e
@@ -149,7 +191,11 @@ class TensorQueryClient(Element):
 
     def stop(self):
         with self._lock:
-            if self._sock is not None:
+            if self._refclient is not None:
+                self._refclient.close()
+                self._refclient = None
+                self._sock = None
+            elif self._sock is not None:
                 try:
                     P.send_msg(self._sock, P.Cmd.BYE)
                     self._sock.close()
@@ -164,7 +210,34 @@ class TensorQueryClient(Element):
     def transform_caps(self, pad, caps):
         return None  # output caps come from the first result buffer
 
+    def _send_buf(self, buf):
+        if self._refclient is not None:
+            from nnstreamer_tpu.query import refwire as R
+
+            self._refclient.send(R.buffer_to_mems(buf.to_host()),
+                                 pts=buf.pts)
+        else:
+            P.send_buffer(self._sock, buf)
+
+    def _disconnect_locked(self):
+        if self._refclient is not None:
+            self._refclient.close()
+            self._refclient = None
+        self._sock = None
+
     def _recv_result(self):
+        if self._refclient is not None:
+            from nnstreamer_tpu.query import refwire as R
+            from nnstreamer_tpu.tensors.buffer import TensorBuffer
+
+            info, mems = self._refclient.recv_result()
+            if self._server_config is not None:
+                return R.mems_to_buffer(mems, self._server_config, info)
+            import numpy as np
+
+            return TensorBuffer(
+                [np.frombuffer(m, dtype=np.uint8) for m in mems],
+                pts=info.get("pts"))
         cmd, payload = P.recv_msg(self._sock)
         if cmd is not P.Cmd.RESULT:
             raise P.QueryProtocolError(f"expected RESULT, got {cmd}")
@@ -187,12 +260,12 @@ class TensorQueryClient(Element):
                     if self._sock is None:
                         self._connect()
                     try:
-                        P.send_buffer(self._sock, buf)
+                        self._send_buf(buf)
                         result = self._recv_result()
                         break
                     except (OSError, P.QueryProtocolError) as e:
                         self.log.warning("query round-trip failed: %s", e)
-                        self._sock = None
+                        self._disconnect_locked()
                         if attempt == 2:
                             raise
             return self._push_result(result, buf.pts, buf.meta)
@@ -208,14 +281,14 @@ class TensorQueryClient(Element):
                 if self._sock is None:
                     self._connect()
                 try:
-                    P.send_buffer(self._sock, buf)
+                    self._send_buf(buf)
                     self._pending.append((buf.pts, buf.meta))
                     break
                 except (OSError, P.QueryProtocolError) as e:
                     n = self._drop_pending_locked()
                     self.log.warning("pipelined send failed: %s; dropped %d "
                                      "in-flight frame(s)", e, n)
-                    self._sock = None
+                    self._disconnect_locked()
                     if attempt == 2:
                         raise
             done, err = self._drain_locked(min_pending=window)
@@ -244,13 +317,13 @@ class TensorQueryClient(Element):
                 done.append((result, pts, meta))
         except TimeoutError as e:
             self._drop_pending_locked()
-            self._sock = None
+            self._disconnect_locked()
             err = e
         except (OSError, P.QueryProtocolError) as e:
             n = self._drop_pending_locked()
             self.log.warning("pipelined receive failed (%s); dropped %d "
                              "in-flight frame(s)", e, n)
-            self._sock = None
+            self._disconnect_locked()
         return done, err
 
     def handle_eos(self):
@@ -285,6 +358,16 @@ class TensorQueryServerSrc(SourceElement):
         "broker_host": "127.0.0.1",
         "broker_port": 1883,
         "advertise_host": "127.0.0.1",
+        # "nnstreamer" speaks the reference's raw-struct query wire on
+        # TWO ports (src=port, sink=sink-port) so unmodified reference
+        # clients can offload to this server (query/refwire.py)
+        "wire": "nnstpu",
+        "sink_port": 0,
+        # refwire carries no per-tensor meta: a caps string here (e.g.
+        # "other/tensors,num_tensors=1,dimensions=3:4,types=float32")
+        # reconstructs typed tensors from the raw mems and is announced
+        # to clients in the APPROVE reply
+        "caps": None,
     }
 
     _SERVERS = {}
@@ -301,6 +384,9 @@ class TensorQueryServerSrc(SourceElement):
         self.server = QueryServer(
             host=self.get_property("host"),
             port=int(self.get_property("port")),
+            caps_str=str(self.get_property("caps") or ""),
+            wire=str(self.get_property("wire")),
+            sink_port=int(self.get_property("sink_port") or 0),
         ).start()
         with self._SERVERS_LOCK:
             self._SERVERS[int(self.get_property("id"))] = self.server
@@ -342,7 +428,19 @@ class TensorQueryServerSrc(SourceElement):
         return self.server.port if self.server else \
             int(self.get_property("port"))
 
+    @property
+    def result_port(self) -> int:
+        """Refwire sink (result) port once bound."""
+        return self.server.sink_port if self.server else \
+            int(self.get_property("sink_port"))
+
     def negotiate(self):
+        caps_prop = self.get_property("caps")
+        if caps_prop:
+            from nnstreamer_tpu.pipeline.parse import parse_caps_string
+
+            self.srcpad.set_caps(parse_caps_string(str(caps_prop)))
+            return
         self.srcpad.set_caps(
             TensorsConfig(format=TensorFormat.FLEXIBLE).to_caps()
         )
@@ -352,7 +450,10 @@ class TensorQueryServerSrc(SourceElement):
         if 0 <= n <= self.i:
             return None
         while not self._stop_evt.is_set():
-            buf = self.server.get_buffer(timeout=0.1)
+            server = self.server  # stop() nulls the attribute concurrently
+            if server is None:
+                return None
+            buf = server.get_buffer(timeout=0.1)
             if buf is not None:
                 self.i += 1
                 return buf

@@ -143,6 +143,16 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_char_p, ctypes.c_uint64]
     lib.nnstpu_server_kick.restype = ctypes.c_int
     lib.nnstpu_server_kick.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    # reference-wire extensions (absent in older .so builds — probed)
+    if hasattr(lib, "nnstpu_server_start2"):
+        lib.nnstpu_server_start2.restype = ctypes.c_void_p
+        lib.nnstpu_server_start2.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_int]
+        lib.nnstpu_server_send_raw.restype = ctypes.c_int
+        lib.nnstpu_server_send_raw.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_char_p,
+            ctypes.c_uint64]
     lib.nnstpu_server_signal_stop.restype = None
     lib.nnstpu_server_signal_stop.argtypes = [ctypes.c_void_p]
     lib.nnstpu_server_stop.restype = None
@@ -252,21 +262,30 @@ class NativeServerCore:
     _INITIAL_CAP = 1 << 16
 
     def __init__(self, host: str, port: int, caps_str: str = "",
-                 max_queue: int = 64):
+                 max_queue: int = 64, wire: int = 0):
         import threading
 
         lib = get_lib()
         if lib is None:
             raise OSError("native library unavailable")
+        if wire and not hasattr(lib, "nnstpu_server_start2"):
+            raise OSError("native library predates wire modes; rebuild")
         self._lib = lib
         self._cv = threading.Condition()
         self._inflight = 0
         #: per-thread reusable take buffer — idle polls (10/s in the
         #: serversrc loop) must not churn 64 KiB allocations
         self._tls = threading.local()
-        self._h = lib.nnstpu_server_start(
-            (host or "").encode(), int(port), caps_str.encode(),
-            int(max_queue))
+        if wire:
+            # 1 = reference src port, 2 = reference sink port
+            # (tensor_query_common.c framing — see nnstpu_server.cc)
+            self._h = lib.nnstpu_server_start2(
+                (host or "").encode(), int(port), caps_str.encode(),
+                int(max_queue), int(wire))
+        else:
+            self._h = lib.nnstpu_server_start(
+                (host or "").encode(), int(port), caps_str.encode(),
+                int(max_queue))
         if not self._h:
             raise OSError(f"nnstpu_server: cannot bind {host}:{port}")
         self.port = int(lib.nnstpu_server_port(self._h))
@@ -327,6 +346,18 @@ class NativeServerCore:
         try:
             rc = self._lib.nnstpu_server_send(
                 h, int(client_id), int(cmd), payload, len(payload))
+            return rc == 0
+        finally:
+            self._exit()
+
+    def send_raw(self, client_id: int, payload: bytes) -> bool:
+        """Send pre-framed bytes (reference-wire results) to a client."""
+        h = self._enter()
+        if h is None:
+            return False
+        try:
+            rc = self._lib.nnstpu_server_send_raw(
+                h, int(client_id), payload, len(payload))
             return rc == 0
         finally:
             self._exit()

@@ -90,6 +90,15 @@ class Caps:
         f.update(kw)
         return Caps(self.name, f)
 
+    def to_string(self) -> str:
+        """GStreamer-style textual caps ("name,k=v,..."), the inverse of
+        :func:`~nnstreamer_tpu.pipeline.parse.parse_caps_string` — the
+        form caps travel in on query/MQTT wires (reference
+        gst_caps_to_string)."""
+        parts = [self.name]
+        parts.extend(f"{k}={v}" for k, v in self.fields.items())
+        return ",".join(parts)
+
     # -- negotiation ---------------------------------------------------------
     def intersect(self, other: "Caps") -> Optional["Caps"]:
         if self.name != other.name:

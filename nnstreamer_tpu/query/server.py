@@ -36,11 +36,18 @@ class QueryServer:
     """
 
     def __init__(self, host: str = "0.0.0.0", port: int = 3000,
-                 caps_str: str = "", max_queue: int = 64):
+                 caps_str: str = "", max_queue: int = 64,
+                 wire: str = "nnstpu", sink_port: int = 0):
         self.host = host
         self.port = port
         self.caps_str = caps_str
         self.max_queue = max_queue
+        #: "nnstpu" = NTQ1 framing (self-describing tensors); "nnstreamer"
+        #: = the reference's raw-struct wire (query/refwire.py) on TWO
+        #: ports (src=port, sink=sink_port) so reference edge peers can
+        #: offload to us unmodified
+        self.wire = wire
+        self.sink_port = sink_port
         self.incoming: _queue.Queue = _queue.Queue(maxsize=max_queue)
         self._clients: Dict[int, socket.socket] = {}
         self._clients_lock = threading.Lock()
@@ -49,6 +56,19 @@ class QueryServer:
         self._accept_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._core = None  # NativeServerCore when the native path is live
+        self._sink_core = None  # refwire: native sink-port core
+        self._refwire = None    # refwire: pure-Python two-port server
+        self._config = None     # refwire: TensorsConfig for reconstruction
+        if caps_str and wire == "nnstreamer":
+            try:
+                from nnstreamer_tpu.pipeline.parse import parse_caps_string
+                from nnstreamer_tpu.tensors.types import TensorsConfig
+
+                self._config = TensorsConfig.from_caps(
+                    parse_caps_string(caps_str))
+            except Exception as e:  # noqa: BLE001 — caps stay advisory
+                log.info("refwire caps %r not parseable (%s); "
+                         "mems surface as u8", caps_str, e)
 
     @property
     def native(self) -> bool:
@@ -56,6 +76,8 @@ class QueryServer:
 
     def start(self) -> "QueryServer":
         self._stop.clear()
+        if self.wire == "nnstreamer":
+            return self._start_refwire()
         if not os.environ.get("NNSTPU_PURE_PY_SERVER"):
             try:
                 from nnstreamer_tpu.native import NativeServerCore
@@ -80,8 +102,50 @@ class QueryServer:
         self._accept_thread.start()
         return self
 
+    def _start_refwire(self) -> "QueryServer":
+        """Reference-wire transport: native epoll cores when available
+        (wire mode 1 = src port, 2 = sink port), else the pure-Python
+        two-port server (query/refwire.py)."""
+        if not os.environ.get("NNSTPU_PURE_PY_SERVER"):
+            try:
+                from nnstreamer_tpu.native import NativeServerCore
+
+                self._core = NativeServerCore(
+                    self.host, self.port, self.caps_str, self.max_queue,
+                    wire=1)
+                try:
+                    self._sink_core = NativeServerCore(
+                        self.host, self.sink_port, "", self.max_queue,
+                        wire=2)
+                except OSError:
+                    self._core.stop()
+                    self._core = None
+                    raise
+                self.port = self._core.port
+                self.sink_port = self._sink_core.port
+                return self
+            except OSError as e:
+                log.info("native refwire cores unavailable (%s); "
+                         "using pure-Python transport", e)
+                self._core = self._sink_core = None
+        from nnstreamer_tpu.query.refwire import RefWireQueryServer
+
+        self._refwire = RefWireQueryServer(
+            host=self.host, src_port=self.port, sink_port=self.sink_port,
+            caps_str=self.caps_str, max_queue=self.max_queue).start()
+        self.port = self._refwire.src_port
+        self.sink_port = self._refwire.sink_port
+        return self
+
     def stop(self) -> None:
         self._stop.set()
+        if self._refwire is not None:
+            self._refwire.stop()
+            self._refwire = None
+            return
+        if self._sink_core is not None:
+            self._sink_core.stop()
+            self._sink_core = None
         if self._core is not None:
             self._core.stop()
             self._core = None
@@ -159,8 +223,50 @@ class QueryServer:
             except OSError:
                 pass
 
+    # -- reference-wire reconstruction --------------------------------------
+    def _refwire_buf(self, client_id: int, info: dict,
+                     mems) -> Optional[TensorBuffer]:
+        """None on a mem/caps mismatch — the serving loop must survive
+        one client's malformed buffer (drop the frame, not the
+        pipeline)."""
+        from nnstreamer_tpu.query import refwire as R
+
+        try:
+            if self._config is not None:
+                buf = R.mems_to_buffer(mems, self._config, info)
+            else:
+                import numpy as np
+
+                buf = TensorBuffer(
+                    [np.frombuffer(m, dtype=np.uint8) for m in mems],
+                    pts=info.get("pts"), dts=info.get("dts"),
+                    duration=info.get("duration"))
+        except ValueError as e:
+            log.warning("refwire buffer from client %d does not match "
+                        "the configured caps (%s); dropping it",
+                        client_id, e)
+            return None
+        buf.meta["query_client_id"] = client_id
+        return buf
+
     # -- results -------------------------------------------------------------
     def send_result(self, client_id: int, buf: TensorBuffer) -> bool:
+        if self.wire == "nnstreamer":
+            from nnstreamer_tpu.query import refwire as R
+
+            mems = R.buffer_to_mems(buf.to_host())
+            refsrv = self._refwire
+            if refsrv is not None:
+                return refsrv.send_result(client_id, mems, pts=buf.pts)
+            sink_core = self._sink_core
+            if sink_core is None:
+                return False
+            raw = R.pack_buffer_frames(mems, pts=buf.pts)
+            ok = sink_core.send_raw(client_id, raw)
+            if not ok:
+                log.warning("refwire result for client %d not deliverable",
+                            client_id)
+            return ok
         core = self._core  # capture once: stop() nulls the attribute
         if core is not None:
             ok = core.send(client_id, int(P.Cmd.RESULT),
@@ -183,6 +289,31 @@ class QueryServer:
 
     def get_buffer(self, timeout: Optional[float] = None
                    ) -> Optional[TensorBuffer]:
+        if self.wire == "nnstreamer":
+            from nnstreamer_tpu.query import refwire as R
+
+            refsrv = self._refwire
+            if refsrv is not None:
+                got = refsrv.get(timeout=timeout)
+                if got is None:
+                    return None
+                cid, info, mems = got
+                return self._refwire_buf(cid, info, mems)
+            core = self._core
+            if core is None:
+                return None
+            got = core.wait_pop(timeout)
+            if got is None:
+                return None
+            cid, payload = got
+            try:
+                info, mems = R.split_assembled(payload)
+            except R.RefWireError as e:
+                log.warning("bad refwire frame from client %d (%s); "
+                            "disconnecting it", cid, e)
+                core.kick(cid)
+                return None
+            return self._refwire_buf(cid, info, mems)
         core = self._core  # capture once: stop() nulls the attribute
         if core is not None:
             import time as _time

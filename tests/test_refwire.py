@@ -1,0 +1,279 @@
+"""Reference-wire tensor_query protocol (wire=nnstreamer) — byte-level
+interop with ``tensor_query_common.c``'s framed TCP.
+
+The oracle class below is a ctypes replica of the C structs
+(``tensor_query_common.h:60-92``, ``tensor_meta.h:21``): every offset,
+size, and padding hole the compiler would produce is asserted against
+our struct codec, the MQTT-header-proof pattern applied to the query
+wire. The loopback tests then drive a hand-rolled "reference client"
+(raw struct bytes only — none of our helpers) through the full
+REQUEST_INFO → APPROVE → TRANSFER → result round trip against both the
+pure-Python and the native-epoll servers.
+"""
+
+import ctypes
+import os
+import socket
+import struct
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.query import refwire as R
+
+CAPS = "other/tensors,format=static,num_tensors=1,dimensions=4:3,types=float32"
+
+
+class RefDataInfo(ctypes.Structure):
+    """ctypes oracle for TensorQueryDataInfo (tensor_query_common.h:60-71):
+    the compiler computes the layout; we assert ours matches."""
+
+    _fields_ = [
+        ("base_time", ctypes.c_int64),
+        ("sent_time", ctypes.c_int64),
+        ("duration", ctypes.c_uint64),
+        ("dts", ctypes.c_uint64),
+        ("pts", ctypes.c_uint64),
+        ("num_mems", ctypes.c_uint32),
+        ("mem_sizes", ctypes.c_uint64 * 16),
+    ]
+
+
+class TestCtypesOracle:
+    def test_data_info_layout_matches_compiler(self):
+        assert ctypes.sizeof(RefDataInfo) == R.DATA_INFO_SIZE == 176
+        assert RefDataInfo.num_mems.offset == 40
+        # the compiler inserts a 4-byte hole before the u64 array
+        assert RefDataInfo.mem_sizes.offset == 48
+
+    def test_data_info_bytes_identical_to_ctypes(self):
+        c = RefDataInfo(base_time=123456789, sent_time=-42,
+                        duration=R.CLOCK_NONE, dts=R.CLOCK_NONE,
+                        pts=777, num_mems=2)
+        c.mem_sizes[0] = 48
+        c.mem_sizes[1] = 1024
+        ours = R.pack_data_info(2, [48, 1024], pts=777, dts=None,
+                                duration=None, base_time=123456789,
+                                sent_time=-42)
+        assert ours == bytes(c)
+
+    def test_data_info_unpack_from_ctypes_bytes(self):
+        c = RefDataInfo(base_time=1, sent_time=2, duration=3, dts=4,
+                        pts=5, num_mems=1)
+        c.mem_sizes[0] = 99
+        info = R.unpack_data_info(bytes(c))
+        assert info == dict(base_time=1, sent_time=2, duration=3, dts=4,
+                            pts=5, num_mems=1, mem_sizes=[99])
+
+    def test_client_id_is_int64(self):
+        # query_client_id_t = int64_t (tensor_meta.h:21)
+        assert R._CLIENT_ID.size == ctypes.sizeof(ctypes.c_int64)
+
+    def test_cmd_is_c_enum_int(self):
+        # TensorQueryCommand is a plain C enum — 4-byte int on this ABI
+        assert R._CMD.size == ctypes.sizeof(ctypes.c_int)
+
+
+def _ref_send(sock, cmd, body=b"", sized=False):
+    """Reference-client sender built from raw structs only (the wire a
+    compiled tensor_query_client.c emits)."""
+    msg = struct.pack("<i", cmd)
+    if sized:
+        msg += struct.pack("<Q", len(body))
+    sock.sendall(msg + body)
+
+
+def _ref_recv_exact(sock, n):
+    out = b""
+    while len(out) < n:
+        part = sock.recv(n - len(out))
+        assert part, "server closed early"
+        out += part
+    return out
+
+
+def _reference_client_roundtrip(src_port, sink_port, frame):
+    """The exact conversation of tensor_query_client.c:377-445 +
+    send/receive_buffer, framed by hand."""
+    src = socket.create_connection(("127.0.0.1", src_port), timeout=10)
+    # server sends CLIENT_ID first
+    (cmd,) = struct.unpack("<i", _ref_recv_exact(src, 4))
+    assert cmd == 6
+    (client_id,) = struct.unpack("<q", _ref_recv_exact(src, 8))
+    # REQUEST_INFO with our caps, NUL-terminated
+    _ref_send(src, 0, CAPS.encode() + b"\0", sized=True)
+    (cmd,) = struct.unpack("<i", _ref_recv_exact(src, 4))
+    assert cmd == 1, f"expected APPROVE, got {cmd}"
+    (clen,) = struct.unpack("<Q", _ref_recv_exact(src, 8))
+    server_caps = _ref_recv_exact(src, clen).split(b"\0")[0].decode()
+    # second connection: sink port claims the client id
+    sink = socket.create_connection(("127.0.0.1", sink_port), timeout=10)
+    _ref_send(sink, 6, struct.pack("<q", client_id))
+    # TRANSFER the frame: START + DATA + END with the raw DataInfo struct
+    c = RefDataInfo(base_time=0, sent_time=0, duration=R.CLOCK_NONE,
+                    dts=R.CLOCK_NONE, pts=31337, num_mems=1)
+    c.mem_sizes[0] = len(frame)
+    _ref_send(src, 3, bytes(c))
+    _ref_send(src, 4, frame, sized=True)
+    _ref_send(src, 5, bytes(c))
+    # result comes back on the sink connection, same framing
+    (cmd,) = struct.unpack("<i", _ref_recv_exact(sink, 4))
+    assert cmd == 3, f"expected TRANSFER_START, got {cmd}"
+    rinfo = R.unpack_data_info(_ref_recv_exact(sink, 176))
+    mems = []
+    for i in range(rinfo["num_mems"]):
+        (cmd,) = struct.unpack("<i", _ref_recv_exact(sink, 4))
+        assert cmd == 4
+        (sz,) = struct.unpack("<Q", _ref_recv_exact(sink, 8))
+        mems.append(_ref_recv_exact(sink, sz))
+    (cmd,) = struct.unpack("<i", _ref_recv_exact(sink, 4))
+    assert cmd == 5
+    _ref_recv_exact(sink, 176)
+    src.close()
+    sink.close()
+    return client_id, server_caps, rinfo, mems
+
+
+def _serve_double(server, n=1):
+    """Echo server loop: result = input * 2 (host math)."""
+    for _ in range(n):
+        buf = server.get_buffer(timeout=10)
+        assert buf is not None
+        cid = buf.meta["query_client_id"]
+        doubled = buf.with_tensors(
+            [np.asarray(t) * 2 for t in buf.tensors])
+        assert server.send_result(cid, doubled)
+
+
+@pytest.mark.parametrize("pure", [True, False],
+                         ids=["pure-python", "native-epoll"])
+def test_reference_client_full_roundtrip(pure, monkeypatch):
+    """A hand-framed reference client offloads through our server on
+    both transports; tensors reconstruct per the announced caps."""
+    import threading
+
+    from nnstreamer_tpu.query.server import QueryServer
+
+    if pure:
+        monkeypatch.setenv("NNSTPU_PURE_PY_SERVER", "1")
+    else:
+        from nnstreamer_tpu import native
+
+        if native.get_lib() is None:
+            pytest.skip("native library unavailable")
+    server = QueryServer(host="127.0.0.1", port=0, caps_str=CAPS,
+                         wire="nnstreamer").start()
+    if not pure:
+        assert server.native, "native refwire core did not come up"
+    try:
+        t = threading.Thread(target=_serve_double, args=(server,),
+                             daemon=True)
+        t.start()
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        cid, server_caps, rinfo, mems = _reference_client_roundtrip(
+            server.port, server.sink_port, x.tobytes())
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert server_caps == CAPS
+        assert len(mems) == 1
+        got = np.frombuffer(mems[0], np.float32).reshape(3, 4)
+        np.testing.assert_array_equal(got, x * 2)
+    finally:
+        server.stop()
+
+
+def test_server_reconstructs_typed_tensors(monkeypatch):
+    """With caps configured, raw mems surface as shaped/typed arrays
+    (reference serversrc trusting its caps), not u8 blobs."""
+    import threading
+
+    from nnstreamer_tpu.query.server import QueryServer
+
+    monkeypatch.setenv("NNSTPU_PURE_PY_SERVER", "1")
+    server = QueryServer(host="127.0.0.1", port=0, caps_str=CAPS,
+                         wire="nnstreamer").start()
+    seen = []
+    try:
+        def grab():
+            buf = server.get_buffer(timeout=10)
+            seen.append(buf)
+            server.send_result(buf.meta["query_client_id"], buf)
+
+        t = threading.Thread(target=grab, daemon=True)
+        t.start()
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        _reference_client_roundtrip(server.port, server.sink_port,
+                                    x.tobytes())
+        t.join(timeout=10)
+    finally:
+        server.stop()
+    assert seen and seen[0].tensors[0].shape == (3, 4)
+    assert seen[0].tensors[0].dtype == np.float32
+    assert seen[0].pts == 31337
+
+
+class TestElementsRefwire:
+    """Full pipeline loopback: our client element offloading over
+    wire=nnstreamer to our serversrc/serversink pair."""
+
+    @pytest.fixture
+    def triple_model(self):
+        from nnstreamer_tpu.filters.jax_backend import (
+            register_jax_model,
+            unregister_jax_model,
+        )
+
+        register_jax_model("refwire_triple",
+                           lambda x: (x * 3.0,), None)
+        yield "refwire_triple"
+        unregister_jax_model("refwire_triple")
+
+    @pytest.mark.parametrize("pure", [True, False],
+                             ids=["pure-python", "native-epoll"])
+    def test_offload_pipeline(self, triple_model, pure, monkeypatch):
+        import time
+
+        from nnstreamer_tpu import parse_launch
+
+        if pure:
+            monkeypatch.setenv("NNSTPU_PURE_PY_SERVER", "1")
+        else:
+            from nnstreamer_tpu import native
+
+            if native.get_lib() is None:
+                pytest.skip("native library unavailable")
+        server = parse_launch(
+            "tensor_query_serversrc name=ssrc port=0 wire=nnstreamer "
+            f"caps={CAPS} ! "
+            f"tensor_filter framework=jax model={triple_model} ! "
+            "queue max-size-buffers=8 materialize-host=true ! "
+            "tensor_query_serversink id=0")
+        server.start()
+        try:
+            ssrc = server.get("ssrc")
+            deadline = time.monotonic() + 5
+            while ssrc.server is None and time.monotonic() < deadline:
+                time.sleep(0.01)
+            client = parse_launch(
+                "appsrc name=src ! tensor_query_client name=c "
+                f"port={ssrc.port} sink-port={ssrc.result_port} "
+                "wire=nnstreamer ! tensor_sink name=out")
+            frames = [np.full((3, 4), i, np.float32) for i in range(4)]
+            client.start()
+            try:
+                src = client.get("src")
+                for f in frames:
+                    src.push([f])
+                src.end_of_stream()
+                msg = client.wait(timeout=30)
+                assert msg is not None and msg.kind == "eos", msg
+                out = client.get("out").buffers
+                assert len(out) == 4
+                for i, b in enumerate(out):
+                    np.testing.assert_array_equal(
+                        np.asarray(b.tensors[0]), frames[i] * 3)
+                    assert b.tensors[0].dtype == np.float32
+            finally:
+                client.stop()
+        finally:
+            server.stop()
