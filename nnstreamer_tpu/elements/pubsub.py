@@ -47,22 +47,7 @@ from nnstreamer_tpu.tensors.buffer import TensorBuffer
 from nnstreamer_tpu.tensors.types import TensorFormat, TensorsConfig
 
 
-def _parse_broker(spec: Optional[str], host: str,
-                  port: int) -> Tuple[str, str, int]:
-    """``broker`` property → (kind, host, port). ``mqtt://h:p`` overrides
-    the host/port properties; bare ``mqtt`` uses them."""
-    s = (spec or "shim").strip()
-    if s in ("", "shim", "native"):
-        return "shim", host, port
-    if s == "mqtt":
-        return "mqtt", host, port
-    if s.startswith("mqtt://"):
-        rest = s[len("mqtt://"):]
-        if rest:
-            h, _, p = rest.partition(":")
-            return "mqtt", h or host, int(p) if p else port
-        return "mqtt", host, port
-    raise ValueError(f"pubsub: unknown broker {spec!r} (shim|mqtt[://h:p])")
+from nnstreamer_tpu.query.pubsub import parse_broker_spec as _parse_broker
 
 
 def _ntp_servers(spec: Optional[str]):

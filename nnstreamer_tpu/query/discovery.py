@@ -5,9 +5,13 @@ servers publish their endpoint under an MQTT topic named after the
 ``operation`` they serve; clients subscribe, collect the candidate server
 list, and fail over through it (tensor_query_hybrid.h:49-116).
 
-Here the broker is ``query.pubsub``; endpoints are JSON
-``{"host": ..., "port": ..., "ts": ...}`` retained under
-``nns-query/<operation>/<host>:<port>``.
+Endpoints are JSON ``{"host": ..., "port": ..., "ts": ...}`` retained
+under ``nns-query/<operation>/<host>:<port>``. The broker transport is
+selected by the ``broker_host`` spelling: a plain host speaks the
+in-process shim protocol (``query.pubsub``); ``mqtt://host[:port]``
+speaks real MQTT 3.1.1 (``query.mqtt.MqttClient``) so discovery works
+through any conformant broker and interops with reference query-hybrid
+peers (tensor_query_hybrid.c publishes through paho the same way).
 """
 
 from __future__ import annotations
@@ -25,13 +29,30 @@ log = get_logger("discovery")
 TOPIC_PREFIX = "nns-query/"
 
 
+def make_broker_client(broker_host: str, broker_port: int):
+    """Broker transport factory: ``mqtt`` / ``mqtt://h[:p]`` → real MQTT
+    client, anything else is a plain shim-broker host. The mqtt dialect
+    is parsed by the shared :func:`~nnstreamer_tpu.query.pubsub.
+    parse_broker_spec` (same spelling as the pubsub elements' ``broker``
+    property); both transports expose the same publish/subscribe/close
+    surface, retain included."""
+    spec = str(broker_host or "").strip()
+    if spec == "mqtt" or spec.startswith("mqtt://"):
+        from nnstreamer_tpu.query.mqtt import MqttClient
+        from nnstreamer_tpu.query.pubsub import parse_broker_spec
+
+        _, h, p = parse_broker_spec(spec, "127.0.0.1", int(broker_port))
+        return MqttClient(h, p)
+    return Client(spec or "127.0.0.1", int(broker_port))
+
+
 class ServerAdvertiser:
     """Server side: publish (retained) this server's endpoint for an
     operation (reference tensor_query_hybrid_publish)."""
 
     def __init__(self, broker_host: str, broker_port: int, operation: str,
                  host: str, port: int):
-        self.client = Client(broker_host, broker_port)
+        self.client = make_broker_client(broker_host, broker_port)
         self.topic = f"{TOPIC_PREFIX}{operation}/{host}:{port}"
         self.endpoint = {"host": host, "port": port, "ts": time.time()}
 
@@ -50,7 +71,7 @@ class ServerDiscovery:
     _get_server_info)."""
 
     def __init__(self, broker_host: str, broker_port: int, operation: str):
-        self.client = Client(broker_host, broker_port)
+        self.client = make_broker_client(broker_host, broker_port)
         self._servers: Dict[str, Tuple[str, int]] = {}
         self._lock = threading.Lock()
         self._seen = threading.Event()

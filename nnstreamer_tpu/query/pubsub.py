@@ -197,6 +197,27 @@ class Broker:
                     self._wlocks.pop(s, None)
 
 
+def parse_broker_spec(spec: Optional[str], host: str = "127.0.0.1",
+                      port: int = 1883) -> Tuple[str, str, int]:
+    """THE broker-spelling parser (one source of truth for the pubsub
+    elements' ``broker`` property and discovery's ``broker_host``):
+    ``shim``/``native``/empty → in-process shim at (host, port);
+    ``mqtt`` → real MQTT at (host, port); ``mqtt://h[:p]`` → real MQTT
+    with the URL overriding host/port."""
+    s = (spec or "shim").strip()
+    if s in ("", "shim", "native"):
+        return "shim", host, port
+    if s == "mqtt":
+        return "mqtt", host, port
+    if s.startswith("mqtt://"):
+        rest = s[len("mqtt://"):]
+        if rest:
+            h, _, p = rest.partition(":")
+            return "mqtt", h or host, int(p) if p else port
+        return "mqtt", host, port
+    raise ValueError(f"pubsub: unknown broker {spec!r} (shim|mqtt[://h:p])")
+
+
 class Client:
     """Pub/sub client: publish + callback-based subscribe."""
 

@@ -106,6 +106,93 @@ class TestDiscovery:
         disco.close()
         adv.retract()
 
+    def test_advertise_and_discover_over_real_mqtt(self):
+        """Discovery through a real MQTT 3.1.1 broker: broker_host
+        spelled mqtt://h:p routes the advertiser/discovery through
+        MqttClient (reference tensor_query_hybrid publishes via paho to
+        exactly such a broker)."""
+        from nnstreamer_tpu.query.mqtt import MqttBroker
+
+        b = MqttBroker(port=0)
+        try:
+            adv = ServerAdvertiser("mqtt://127.0.0.1", b.port, "seg",
+                                   "10.0.0.9", 7777)
+            adv.publish()
+            time.sleep(0.1)
+            # late subscriber: the RETAINED endpoint must reach it
+            disco = ServerDiscovery("mqtt://127.0.0.1", b.port, "seg")
+            servers = disco.wait_servers(timeout=5)
+            assert ("10.0.0.9", 7777) in servers
+            # tombstone retracts the endpoint for new subscribers
+            adv.retract()
+            time.sleep(0.1)
+            disco2 = ServerDiscovery("mqtt://127.0.0.1", b.port, "seg")
+            assert disco2.wait_servers(timeout=0.5) == []
+            disco.close()
+            disco2.close()
+        finally:
+            b.close()
+
+    def test_mqtt_discovery_failover_to_live_server(self):
+        """Server dies (endpoint retracted / unreachable) → the client
+        walks the discovered list to the live candidate, all through the
+        real MQTT broker (VERDICT r4 #3 done-criterion)."""
+        from nnstreamer_tpu.filters import register_custom_easy
+        from nnstreamer_tpu.query.mqtt import MqttBroker
+        from nnstreamer_tpu.tensors.types import TensorsInfo
+
+        info = TensorsInfo.from_str("4", "float32")
+        register_custom_easy("mq5", lambda ins: [np.asarray(ins[0]) * 5],
+                             info, info)
+        b = MqttBroker(port=0)
+        server = None
+        ghost = None
+        try:
+            # candidate 1: advertised but DEAD (listener closed right
+            # away — connect must fail and the client must advance)
+            import socket as _s
+
+            probe = _s.socket(_s.AF_INET, _s.SOCK_STREAM)
+            probe.bind(("127.0.0.1", 0))
+            dead_port = probe.getsockname()[1]
+            probe.close()
+            ghost = ServerAdvertiser("mqtt://127.0.0.1", b.port, "five",
+                                     "127.0.0.1", dead_port)
+            ghost.publish()
+            # candidate 2: live server pipeline advertising over MQTT
+            server = parse_launch(
+                "tensor_query_serversrc name=s port=0 operation=five "
+                f"broker-host=mqtt://127.0.0.1 broker-port={b.port} ! "
+                "tensor_filter framework=custom-easy model=mq5 ! "
+                "tensor_query_serversink")
+            server.start()
+            time.sleep(0.3)
+            from nnstreamer_tpu.elements.sink import TensorSink
+            from nnstreamer_tpu.elements.source import AppSrc
+
+            client = parse_launch(
+                "tensor_query_client name=c operation=five "
+                f"broker-host=mqtt://127.0.0.1 broker-port={b.port} "
+                "timeout=5 max-retry=2")
+            src, sink = AppSrc(name="src"), TensorSink(name="out")
+            client.add(src, sink)
+            src.link(client.get("c"))
+            client.get("c").link(sink)
+            client.start()
+            src.push([np.arange(4, dtype=np.float32)], pts=0)
+            src.end_of_stream()
+            msg = client.wait(timeout=30)
+            client.stop()
+            assert msg is not None and msg.kind == "eos", str(msg)
+            np.testing.assert_array_equal(
+                sink.buffers[0][0], np.arange(4, dtype=np.float32) * 5)
+        finally:
+            if ghost is not None:
+                ghost.retract()  # also closes its MqttClient
+            if server is not None:
+                server.stop()
+            b.close()
+
     def test_query_client_discovers_live_server(self, broker):
         from nnstreamer_tpu.filters import register_custom_easy
         from nnstreamer_tpu.tensors.types import TensorsInfo
