@@ -235,6 +235,15 @@ class ContinuousBatchingEngine:
         tokens, so reused entries are the same arrays a cold prefill
         would produce. HBM cost ≈ N × prompt_len × per-token kv bytes
         (LRU-evicted). 0 (default) disables.
+    attention: prefill attention backend. "auto" (default) runs the
+        Pallas flash kernel (ops/flash_attention.py) for the O(s²)
+        prompt pass on TPU when the shapes tile (seq divisible by the
+        block, head_dim ≤ 256), falling back to XLA attention
+        elsewhere — long prompts stop materializing [s,s] score tiles
+        in HBM. "reference" forces XLA attention everywhere. Decode and
+        chunked ingestion keep the masked cache form (`_attend_cache`):
+        their attention is over dynamically-positioned cache slots,
+        which the causal-only kernel does not express.
     """
 
     def __init__(self, cfg, params, max_streams: int = 4,
@@ -246,7 +255,8 @@ class ContinuousBatchingEngine:
                  min_bucket: int = 16, mesh=None,
                  prefill_chunk: Optional[int] = None,
                  kv_quant: Optional[str] = None,
-                 prefix_cache: int = 0):
+                 prefix_cache: int = 0,
+                 attention: str = "auto"):
         import jax
         import jax.numpy as jnp
 
@@ -277,8 +287,22 @@ class ContinuousBatchingEngine:
                 f"serving: prefill_chunk must be in (0, {self.S}), got "
                 f"{prefill_chunk}")
         self.kv_quant = kv_quant
+        if attention not in ("auto", "reference"):
+            raise ValueError(
+                f"serving: attention must be 'auto' or 'reference', got "
+                f"{attention!r}")
+        attention_fn = None
+        if attention == "auto" and mesh is None:
+            # single-chip only: pallas_call does not carry GSPMD
+            # partitioning rules, so the meshed engine keeps XLA
+            # attention (which GSPMD shards like the rest of prefill)
+            from nnstreamer_tpu.ops import flash_attention
+
+            attention_fn = flash_attention  # causal=True is its default
         self._decode = build_decode_step(cfg, self.S, kv_codec=kv_quant)
-        self._prefill_fn = build_prefill(cfg, self.S, kv_codec=kv_quant)
+        self._prefill_fn = build_prefill(cfg, self.S,
+                                         attention_fn=attention_fn,
+                                         kv_codec=kv_quant)
         self._chunk_fn = build_chunk_decode(cfg, self.S, kv_codec=kv_quant)
         #: in-progress chunked admission: (request, slot, cache1, k) with
         #: k = next chunk index; one at a time, advanced between dispatches

@@ -1,0 +1,152 @@
+"""Flash attention wired into prefill (round-5 VERDICT #4).
+
+The Pallas kernel (ops/flash_attention.py) now backs the O(s²) prompt
+pass: the serving engine's ``attention="auto"`` builds prefill with the
+kernel (TPU, tileable shapes) and XLA attention elsewhere. Off-TPU the
+kernel runs in interpret mode when forced — these tests pin exactness
+against the materialized math, including a ≥2k-token prompt, so the
+TPU fast path computes the same function the fallback does.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.models.transformer import (
+    TransformerConfig,
+    build_decode_step,
+    build_prefill,
+    init_params,
+)
+from nnstreamer_tpu.ops import flash_attention
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+def _flash_forced(q, k, v):
+    # force="pallas" runs the REAL kernel (interpret mode off-TPU), so
+    # CPU CI exercises the exact program the TPU fast path compiles
+    return flash_attention(q, k, v, causal=True, force="pallas")
+
+
+CFG = TransformerConfig(vocab=256, d_model=64, n_heads=2, n_layers=2,
+                        d_ff=128, max_seq=64, dtype=jnp.float32)
+
+
+class TestPrefillExactness:
+    def test_flash_prefill_matches_reference_math(self):
+        params = init_params(CFG, seed=0)
+        toks = jnp.asarray(
+            np.random.default_rng(0).integers(1, CFG.vocab, (2, 32)),
+            jnp.int32)
+        ref_logits, ref_cache = build_prefill(CFG)(params, toks)
+        fl_logits, fl_cache = build_prefill(
+            CFG, attention_fn=_flash_forced)(params, toks)
+        np.testing.assert_allclose(np.asarray(fl_logits),
+                                   np.asarray(ref_logits),
+                                   rtol=2e-4, atol=2e-4)
+        for a, b in zip(jax.tree_util.tree_leaves(fl_cache),
+                        jax.tree_util.tree_leaves(ref_cache)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_flash_prefill_greedy_continuation_token_exact(self):
+        """The whole point of the numeric contract: greedy decode seeded
+        by a flash prefill emits the same tokens as one seeded by the
+        reference prefill."""
+        params = init_params(CFG, seed=1)
+        toks = jnp.asarray(
+            np.random.default_rng(1).integers(1, CFG.vocab, (1, 16)),
+            jnp.int32)
+        step = jax.jit(build_decode_step(CFG))
+
+        def rollout(prefill_fn, n=12):
+            logits, cache = prefill_fn(params, toks)
+            last = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            pos = jnp.full((1,), toks.shape[1], jnp.int32)
+            out = [int(last[0])]
+            for _ in range(n - 1):
+                logits, cache = step(params, last, cache, pos)
+                last = jnp.argmax(logits[:, :], axis=-1).astype(jnp.int32)
+                pos = pos + 1
+                out.append(int(last[0]))
+            return out
+
+        ref = rollout(jax.jit(build_prefill(CFG)))
+        fl = rollout(jax.jit(build_prefill(CFG,
+                                           attention_fn=_flash_forced)))
+        assert fl == ref
+
+    def test_flash_prefill_right_padded_lengths(self):
+        """Bucket padding contract survives the kernel: padded rows'
+        logits come from the true last position and match the unpadded
+        prefill."""
+        params = init_params(CFG, seed=2)
+        rng = np.random.default_rng(2)
+        true = rng.integers(1, CFG.vocab, (1, 11))
+        padded = np.zeros((1, 16), np.int64)
+        padded[:, :11] = true
+        # s=11 does not tile — the reference path scores the exact
+        # prompt; the PADDED s=16 call runs through the kernel
+        exact_logits, _ = build_prefill(CFG)(
+            params, jnp.asarray(true, jnp.int32))
+        pad_logits, _ = build_prefill(CFG, attention_fn=_flash_forced)(
+            params, jnp.asarray(padded, jnp.int32),
+            jnp.asarray([11], jnp.int32))
+        np.testing.assert_allclose(np.asarray(pad_logits),
+                                   np.asarray(exact_logits),
+                                   rtol=2e-4, atol=2e-4)
+
+
+class TestLongPrompt:
+    def test_2k_token_prefill_through_the_kernel(self):
+        """≥2k-token prompt through the REAL kernel (interpret off-TPU):
+        the long-context path the kernel exists for, verified against
+        materialized attention."""
+        cfg = TransformerConfig(vocab=128, d_model=64, n_heads=2,
+                                n_layers=1, d_ff=64, max_seq=2048,
+                                dtype=jnp.float32)
+        params = init_params(cfg, seed=3)
+        toks = jnp.asarray(
+            np.random.default_rng(3).integers(1, cfg.vocab, (1, 2048)),
+            jnp.int32)
+        fl_logits, fl_cache = build_prefill(
+            cfg, attention_fn=_flash_forced)(params, toks)
+        ref_logits, ref_cache = build_prefill(cfg)(params, toks)
+        np.testing.assert_allclose(np.asarray(fl_logits),
+                                   np.asarray(ref_logits),
+                                   rtol=5e-4, atol=5e-4)
+        ck_fl = jax.tree_util.tree_leaves(fl_cache)[0]
+        ck_ref = jax.tree_util.tree_leaves(ref_cache)[0]
+        np.testing.assert_allclose(np.asarray(ck_fl), np.asarray(ck_ref),
+                                   rtol=5e-4, atol=5e-4)
+
+
+class TestEngineAuto:
+    def test_engine_auto_equals_reference_attention(self):
+        """attention='auto' (kernel on TPU, XLA fallback here) generates
+        the same tokens as attention='reference'."""
+        from nnstreamer_tpu.serving import ContinuousBatchingEngine
+
+        params = init_params(CFG, seed=4)
+        prompt = np.random.default_rng(4).integers(
+            1, CFG.vocab, 12).tolist()
+        outs = {}
+        for mode in ("auto", "reference"):
+            eng = ContinuousBatchingEngine(
+                CFG, params, max_streams=2, steps_per_dispatch=4,
+                temperature=0.0, attention=mode).start()
+            try:
+                outs[mode] = eng.generate(prompt, max_new_tokens=16,
+                                          timeout=120)
+            finally:
+                eng.stop()
+        assert outs["auto"] == outs["reference"]
+
+    def test_engine_rejects_unknown_attention(self):
+        from nnstreamer_tpu.serving import ContinuousBatchingEngine
+
+        with pytest.raises(ValueError, match="attention"):
+            ContinuousBatchingEngine(CFG, init_params(CFG),
+                                     attention="fast")
