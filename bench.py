@@ -813,12 +813,14 @@ def measure_serve() -> dict:
 
     cfg = TransformerConfig(vocab=32000, d_model=512, n_heads=8, n_layers=8,
                             d_ff=2048, max_seq=512, dtype=jnp.bfloat16)
-    # K=32: one host sync serves up to 256 tokens across the batch — on a
-    # tunneled chip the per-dispatch sync is the bottleneck, and these
-    # length-bound greedy streams never waste steps on early EOS
+    # steps_per_dispatch="auto": the engine measures the link RTT and
+    # per-step decode time at start() and sizes K so the per-dispatch
+    # sync amortizes (engine._calibrate_k) — on the tunnel it lands
+    # 32-128, on PCIe it would land small; these length-bound greedy
+    # streams never waste steps on early EOS
     serve_params = init_params(cfg)
     engine = ContinuousBatchingEngine(
-        cfg, serve_params, max_streams=8, steps_per_dispatch=32,
+        cfg, serve_params, max_streams=8, steps_per_dispatch="auto",
         temperature=0.0).start()
     try:
         rng = np.random.default_rng(0)

@@ -203,7 +203,11 @@ class ContinuousBatchingEngine:
     cfg, params: a ``models.transformer`` config + param pytree.
     max_streams: batch slots (B). Static — sizes the cache and programs.
     max_seq: cache length S (defaults to ``cfg.max_seq``).
-    steps_per_dispatch: decode steps fused into one device dispatch (K).
+    steps_per_dispatch: decode steps fused into one device dispatch (K),
+        or "auto" — start() measures the per-dispatch sync round trip
+        and per-step decode time and picks K so the fixed dispatch cost
+        amortizes to ≤~20% of a block (small on PCIe, large over a
+        high-RTT link; see _calibrate_k).
     temperature / top_k / min_p: sampling config (``temperature<=0`` →
         greedy; see ``models.transformer.make_sampler``).
     eos_id: generation stops when the model emits this id (None → length
@@ -271,7 +275,12 @@ class ContinuousBatchingEngine:
         self.params = params
         self.B = int(max_streams)
         self.S = int(max_seq or cfg.max_seq)
-        self.K = int(steps_per_dispatch)
+        #: steps_per_dispatch="auto": start() measures the per-dispatch
+        #: sync round trip and the per-step decode time, then picks K so
+        #: the fixed dispatch cost amortizes (see _calibrate_k) — on a
+        #: PCIe-attached chip that lands small, on a high-RTT link large
+        self._auto_k = steps_per_dispatch == "auto"
+        self.K = 8 if self._auto_k else int(steps_per_dispatch)
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self.min_p = float(min_p)
@@ -401,7 +410,6 @@ class ContinuousBatchingEngine:
 
         from nnstreamer_tpu.models.transformer import make_sampler
 
-        K = self.K
         decode = self._decode
         # the ONE sampling function (shared with the repo-loop sampled
         # step) — seeds the first token and every dispatch-loop draw with
@@ -409,27 +417,32 @@ class ContinuousBatchingEngine:
         sample = make_sampler(cfg.vocab, self.temperature, self.top_k,
                               self.min_p, with_logprobs=True)
 
-        def dispatch(params, token, cache, pos, keys):
-            """K decode steps in one program: ([B],cache,[B],[B,2]) →
-            ([B,K] tokens, [B,K] logprobs, cache, keys, last, pos').
+        def build_dispatch(K):
+            def dispatch(params, token, cache, pos, keys):
+                """K decode steps in one program: ([B],cache,[B],[B,2]) →
+                ([B,K] tokens, [B,K] logprobs, cache, keys, last, pos').
 
-            The final carry (last token, advanced pos) comes back as
-            DEVICE arrays so the next dispatch can chain off them without
-            waiting for the token fetch — the loop pipelines the host
-            materialization one block behind the device (engine _loop)."""
+                The final carry (last token, advanced pos) comes back as
+                DEVICE arrays so the next dispatch can chain off them
+                without waiting for the token fetch — the loop pipelines
+                the host materialization one block behind the device
+                (engine _loop)."""
 
-            def body(carry, _):
-                token, cache, pos, keys = carry
-                logits, cache = decode(params, token, cache, pos)
-                nxt, keys, lp = sample(logits, keys)
-                return (nxt, cache, pos + 1, keys), (nxt, lp)
+                def body(carry, _):
+                    token, cache, pos, keys = carry
+                    logits, cache = decode(params, token, cache, pos)
+                    nxt, keys, lp = sample(logits, keys)
+                    return (nxt, cache, pos + 1, keys), (nxt, lp)
 
-            (token, cache, pos, keys), (toks, lps) = jax.lax.scan(
-                body, (token, cache, pos, keys), None, length=K)
-            return (jnp.transpose(toks), jnp.transpose(lps), cache, keys,
-                    token, pos)
+                (token, cache, pos, keys), (toks, lps) = jax.lax.scan(
+                    body, (token, cache, pos, keys), None, length=K)
+                return (jnp.transpose(toks), jnp.transpose(lps), cache,
+                        keys, token, pos)
 
-        self._dispatch = jax.jit(dispatch, donate_argnums=(2,))
+            return jax.jit(dispatch, donate_argnums=(2,))
+
+        self._build_dispatch = build_dispatch
+        self._dispatch = build_dispatch(self.K)
         self._sample_first = jax.jit(sample)
 
         def insert(cache, cache1, slot):
@@ -450,6 +463,51 @@ class ContinuousBatchingEngine:
         self._jnp = jnp
         self._jax = jax
 
+    def _calibrate_k(self) -> None:
+        """steps_per_dispatch="auto": pick K from MEASURED costs.
+
+        A decode block costs ``rtt + K·s`` wall time for ``rtt`` = the
+        fixed dispatch+sync overhead (dominated by the host↔device link;
+        ~0.1 ms on PCIe, tens of ms through a tunnel) and ``s`` = one
+        batched decode step. ``rtt`` is timed with a trivial synced
+        device program; ``s`` falls out of one timed block at the
+        initial K. K is then chosen so the fixed cost is ≤ ~20% of the
+        block (K ≥ 4·rtt/s), clamped to [8, 128] and rounded down to a
+        power of two (bucketed executables). Runs once, on a throwaway
+        cache, before the engine loop starts."""
+        import numpy as _np
+        import time as _time
+
+        jax, jnp = self._jax, self._jnp
+        tiny = jax.jit(lambda x: x + 1)
+        x = jnp.zeros((8,), jnp.int32)
+        _np.asarray(tiny(x))  # compile off the clock
+        rtt = min(
+            (lambda t0: (_np.asarray(tiny(x)), _time.monotonic() - t0)[1])(
+                _time.monotonic()) for _ in range(3))
+        # calibrate on the LIVE cache (no streams are active before
+        # start(), and every slot is fully overwritten at admission by
+        # _insert) — a throwaway cache would transiently double KV HBM
+        # and OOM exactly the memory-tight configs auto-K serves
+        token = jnp.zeros((self.B,), jnp.int32)
+        pos = jnp.zeros((self.B,), jnp.int32)
+        keys = jnp.zeros((self.B, 2), jnp.uint32)
+        out = self._dispatch(self.params, token, self._cache, pos, keys)
+        _np.asarray(out[0])  # compile + warm
+        t0 = _time.monotonic()
+        out = self._dispatch(self.params, token, out[2], pos, keys)
+        _np.asarray(out[0])
+        block = _time.monotonic() - t0
+        self._cache = out[2]  # dispatch donates its cache argument
+        step = max((block - rtt) / self.K, 1e-5)
+        k = max(8, min(128, int(4 * rtt / step)))
+        k = 1 << (k.bit_length() - 1)  # round down to a power of two
+        log.info("serving: auto K — rtt %.2f ms, step %.3f ms → K=%d",
+                 rtt * 1e3, step * 1e3, k)
+        if k != self.K:
+            self.K = k
+            self._dispatch = self._build_dispatch(k)
+
     # -- public API -----------------------------------------------------------
     def start(self) -> "ContinuousBatchingEngine":
         if self._thread is not None and not self._thread.is_alive():
@@ -463,6 +521,14 @@ class ContinuousBatchingEngine:
                     "serving: previous engine loop is still shutting "
                     "down; retry start() after it exits")
             return self  # already running
+        if self._auto_k:
+            self._auto_k = False  # calibrate once, not per restart
+            try:
+                self._calibrate_k()
+            except Exception as e:  # noqa: BLE001 — auto-tune is an
+                # optimization; the initial K always works
+                log.warning("serving: K auto-calibration failed (%s); "
+                            "keeping K=%d", e, self.K)
         self._stop_evt.clear()
         self._thread = threading.Thread(target=self._loop,
                                         name="cb-engine", daemon=True)
@@ -856,7 +922,15 @@ class ContinuousBatchingEngine:
                 self._partial = None
             # in-flight chunked prefill: ONE chunk per iteration, so the
             # decode dispatch below keeps running streams moving while a
-            # long prompt ingests
+            # long prompt ingests.
+            # (A dispatch-FIRST reordering — decode block issued before
+            # admissions so its compute "overlaps" the admission's host
+            # work — was tried and reverted: the chip executes queued
+            # programs serially, so it bought no measured throughput and
+            # cost new streams up to a full K-step block of
+            # time-to-first-token, since the wave commit then had to
+            # drain a block issued microseconds earlier instead of one
+            # nearly done from the previous iteration.)
             progressed = False
             if self._partial is not None:
                 self._advance_partial()
