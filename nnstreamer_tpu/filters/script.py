@@ -14,10 +14,31 @@ script assigns to ``y0..yN`` (or ``y``)::
     tensor_filter framework=script model="y = jnp.tanh(x) * 2.0"
     tensor_filter framework=script model=my_filter.jaxs   # same, from file
 
-The script runs under jit tracing: no data-dependent Python control flow
-(use ``lax.cond``/``lax.select``), static shapes — the same rules as any
-jitted function. One specialization is compiled per negotiated input
-shape-set and cached.
+**Data-dependent control flow** (reference lua scripts branch per frame)
+has two homes:
+
+- *structured ops, jitted* (default mode): ``cond`` / ``while_loop`` /
+  ``fori_loop`` / ``switch`` / ``select`` are pre-bound in the script
+  namespace (``lax.*``), so a per-frame branch compiles into the XLA
+  program::
+
+      y = cond(jnp.mean(x) > 0.5, lambda a: a * 2.0,
+               lambda a: a * 0.5, x.astype(jnp.float32))
+
+- ``custom=mode:host`` — *interpreted per frame on the host*, the
+  reference's lua semantics exactly: arbitrary imperative Python
+  (``if float(np.mean(x)) > 0.5: ...``) over numpy arrays, no tracing
+  rules. The same structured-ops names are bound to host shims with
+  identical semantics, and 64-bit numpy promotions are narrowed back to
+  the 32-bit widths jax produces, so a script written with
+  ``cond``/``while_loop`` produces identical outputs AND negotiates the
+  same output dtypes in both modes
+  (``tests/test_filter_backends_extra.py``). Caps negotiation executes
+  a host-mode script once on an all-ones probe frame.
+
+Default mode runs under jit tracing: no raw Python control flow on traced
+values, static shapes — the same rules as any jitted function. One
+specialization is compiled per negotiated input shape-set and cached.
 """
 
 from __future__ import annotations
@@ -30,7 +51,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from nnstreamer_tpu.filters.api import FilterFramework, FilterProperties
+from nnstreamer_tpu.filters.api import (
+    FilterFramework,
+    FilterProperties,
+    parse_custom,
+)
 from nnstreamer_tpu.registry import FILTER, subplugin
 from nnstreamer_tpu.tensors.types import TensorInfo, TensorsInfo, TensorType
 
@@ -38,9 +63,57 @@ from nnstreamer_tpu.tensors.types import TensorInfo, TensorsInfo, TensorType
 _Y_RE = re.compile(r"^y(\d+)$")
 
 
+def _host_cond(pred, true_fn, false_fn, *operands):
+    return true_fn(*operands) if pred else false_fn(*operands)
+
+
+def _host_while(cond_fn, body_fn, init):
+    val = init
+    while cond_fn(val):
+        val = body_fn(val)
+    return val
+
+
+def _host_fori(lo, hi, body_fn, init):
+    val = init
+    for i in range(int(lo), int(hi)):
+        val = body_fn(i, val)
+    return val
+
+
+def _host_switch(index, branches, *operands):
+    i = min(max(int(index), 0), len(branches) - 1)  # lax.switch clamps
+    return branches[i](*operands)
+
+
+#: structured control-flow surface bound into every script namespace —
+#: lax ops under jit (device mode), semantically-identical host shims in
+#: mode=host, so one script runs in both modes with the same outputs
+_DEVICE_OPS = dict(cond=jax.lax.cond, while_loop=jax.lax.while_loop,
+                   fori_loop=jax.lax.fori_loop, switch=jax.lax.switch,
+                   select=jnp.where)
+_HOST_OPS = dict(cond=_host_cond, while_loop=_host_while,
+                 fori_loop=_host_fori, switch=_host_switch,
+                 select=np.where)
+
+#: numpy promotes to 64-bit where jax (x64 disabled) stays 32-bit; host
+#: outputs are narrowed to the device-mode widths so one script
+#: negotiates the SAME output dtypes in both modes
+_HOST_DTYPE_NARROW = {np.dtype(np.float64): np.float32,
+                      np.dtype(np.int64): np.int32,
+                      np.dtype(np.uint64): np.uint32,
+                      np.dtype(np.complex128): np.complex64}
+
+
+def _narrow_host(arr: np.ndarray) -> np.ndarray:
+    tgt = _HOST_DTYPE_NARROW.get(arr.dtype)
+    return arr.astype(tgt) if tgt is not None else arr
+
+
 @subplugin(FILTER, "script")
 class ScriptFilter(FilterFramework):
-    """Jit-compiled expression/script filters."""
+    """Jit-compiled expression/script filters (``custom=mode:host`` for
+    per-frame interpreted execution, lua-parity semantics)."""
 
     NAME = "script"
     KEEP_ON_DEVICE = True
@@ -50,6 +123,7 @@ class ScriptFilter(FilterFramework):
         self._src: Optional[str] = None
         self._code = None
         self._jitted = None
+        self._host_mode = False
         self._in_info: Optional[TensorsInfo] = None
 
     # -- vtable --------------------------------------------------------------
@@ -61,20 +135,37 @@ class ScriptFilter(FilterFramework):
                 src = f.read()
         if not src.strip():
             raise ValueError("script: empty script (model property)")
+        mode = parse_custom(props.custom).get("mode", "device")
+        if mode not in ("device", "host"):
+            raise ValueError(
+                f"script: mode must be 'device' or 'host', got {mode!r}")
+        self._host_mode = mode == "host"
+        # set on BOTH branches: a reused instance re-opened in device
+        # mode must win back the on-device fast path
+        self.KEEP_ON_DEVICE = not self._host_mode
         self._src = src
         self._code = compile(src, "<tensor_filter_script>", "exec")
 
         def run(*inputs):
-            ns: Dict[str, Any] = {
-                "jnp": jnp, "jax": jax, "lax": jax.lax, "np": jnp,
-            }
+            if self._host_mode:
+                # per-frame interpreter: plain numpy + host control-flow
+                # shims; jnp aliases numpy so device-flavored scripts run
+                ns: Dict[str, Any] = {"np": np, "jnp": np, **_HOST_OPS}
+            else:
+                ns = {"jnp": jnp, "jax": jax, "lax": jax.lax, "np": jnp,
+                      **_DEVICE_OPS}
             for i, x in enumerate(inputs):
                 ns[f"x{i}"] = x
             ns["x"] = inputs[0]
             ns["n_inputs"] = len(inputs)
-            exec(self._code, ns)  # traced once under jit, not per frame
+            exec(self._code, ns)  # device mode: traced once under jit
+            if self._host_mode:
+                def asarray(v):
+                    return _narrow_host(np.asarray(v))
+            else:
+                asarray = jnp.asarray
             if "y" in ns and not any(_Y_RE.match(k) for k in ns):
-                return [jnp.asarray(ns["y"])]
+                return [asarray(ns["y"])]
             outs = sorted(
                 ((int(_Y_RE.match(k).group(1)), v) for k, v in ns.items()
                  if _Y_RE.match(k)),
@@ -84,10 +175,11 @@ class ScriptFilter(FilterFramework):
                 raise ValueError(
                     "script: script must assign y (or y0..yN)"
                 )
-            return [jnp.asarray(v) for _, v in outs]
+            return [asarray(v) for _, v in outs]
 
         self._run = run
-        self._jitted = jax.jit(lambda *xs: tuple(run(*xs)))
+        self._jitted = None if self._host_mode else \
+            jax.jit(lambda *xs: tuple(run(*xs)))
 
     def close(self) -> None:
         self._src = self._code = self._jitted = None
@@ -95,10 +187,21 @@ class ScriptFilter(FilterFramework):
 
     def set_input_info(self, in_info: TensorsInfo) -> TensorsInfo:
         self._in_info = in_info
-        dummies = [
-            jax.ShapeDtypeStruct(t.shape, t.type.np_dtype) for t in in_info
-        ]
-        outs = jax.eval_shape(lambda *xs: tuple(self._run(*xs)), *dummies)
+        if self._host_mode:
+            # the interpreter has no tracer: probe shapes with one real
+            # execution. Ones, not zeros — value-dependent loops whose
+            # progress rides on nonzero data (doubling until a bound,
+            # mean-gated branches) must not spin forever on an all-zero
+            # probe. Negotiation DOES run the script once in this mode.
+            dummies = [np.ones(t.shape, t.type.np_dtype) for t in in_info]
+            outs = self._run(*dummies)
+        else:
+            specs = [
+                jax.ShapeDtypeStruct(t.shape, t.type.np_dtype)
+                for t in in_info
+            ]
+            outs = jax.eval_shape(lambda *xs: tuple(self._run(*xs)),
+                                  *specs)
         return TensorsInfo([
             TensorInfo(dim=tuple(reversed(o.shape)),
                        type=TensorType.from_any(np.dtype(o.dtype)))
@@ -107,4 +210,6 @@ class ScriptFilter(FilterFramework):
 
     def invoke(self, inputs: Sequence[Any]) -> List[Any]:
         with self.global_stats().measure():
+            if self._host_mode:
+                return list(self._run(*[np.asarray(x) for x in inputs]))
             return list(self._jitted(*[jnp.asarray(x) for x in inputs]))

@@ -473,8 +473,9 @@ class ContinuousBatchingEngine:
         device program; ``s`` falls out of one timed block at the
         initial K. K is then chosen so the fixed cost is ≤ ~20% of the
         block (K ≥ 4·rtt/s), clamped to [8, 128] and rounded down to a
-        power of two (bucketed executables). Runs once, on a throwaway
-        cache, before the engine loop starts."""
+        power of two (bucketed executables). Runs once, before the
+        engine loop starts, on the LIVE cache (safe because _insert
+        fully overwrites a slot's KV at admission — see below)."""
         import numpy as _np
         import time as _time
 
@@ -492,13 +493,17 @@ class ContinuousBatchingEngine:
         token = jnp.zeros((self.B,), jnp.int32)
         pos = jnp.zeros((self.B,), jnp.int32)
         keys = jnp.zeros((self.B, 2), jnp.uint32)
+        # dispatch DONATES the cache: reassign self._cache immediately
+        # after each call so a failure mid-calibration never leaves it
+        # pointing at deleted buffers (start() also reinits on error)
         out = self._dispatch(self.params, token, self._cache, pos, keys)
+        self._cache = out[2]
         _np.asarray(out[0])  # compile + warm
         t0 = _time.monotonic()
-        out = self._dispatch(self.params, token, out[2], pos, keys)
+        out = self._dispatch(self.params, token, self._cache, pos, keys)
+        self._cache = out[2]
         _np.asarray(out[0])
         block = _time.monotonic() - t0
-        self._cache = out[2]  # dispatch donates its cache argument
         step = max((block - rtt) / self.K, 1e-5)
         k = max(8, min(128, int(4 * rtt / step)))
         k = 1 << (k.bit_length() - 1)  # round down to a power of two
@@ -529,6 +534,9 @@ class ContinuousBatchingEngine:
                 # optimization; the initial K always works
                 log.warning("serving: K auto-calibration failed (%s); "
                             "keeping K=%d", e, self.K)
+                # the failed dispatch may have donated (deleted) the
+                # live cache's buffers or left error arrays in it
+                self._cache = self._init_cache()
         self._stop_evt.clear()
         self._thread = threading.Thread(target=self._loop,
                                         name="cb-engine", daemon=True)
