@@ -33,7 +33,11 @@ How to read the bound fields (the report's own limiter analysis):
   score. Each flagship repeat is paired with an ingest-ceiling sample
   from the same weather window; the ratio fps/ceiling cancels tunnel
   drift, so round-over-round comparisons should use ``value_norm``
-  (spread target <0.2 where raw fps can spread 0.5+).
+  (spread target <0.2 where raw fps can spread 0.5+). Caveat: when the
+  link flips WITHIN a pair (~10 s apart) individual ratios can exceed 1
+  and ``spread_norm`` blows up — that is the honest signal that the
+  session's weather was oscillating faster than any pairing can cancel;
+  the ``value_norm`` median is still the most comparable number.
 - ``latency_p50/p99_ms`` is end-to-end per-frame latency under 30 fps
   realtime pacing (create→sink materialization, window wait included)
   with the ``latency_budget_ms`` adaptive-batching budget active: the
@@ -353,18 +357,28 @@ def measure_latency_live(batch: int = BATCH, fps: int = 30,
     # compilation to first execution — without this, frames queue behind
     # the first dispatch and the percentiles measure the backlog drain)
     _collect(build_pipeline(batch, n_frames=2 * batch))
-    pipe = build_pipeline(batch, live_fps=fps, n_frames=fps * seconds,
-                          latency_budget_ms=budget_ms)
-    _collect(pipe)
-    # drop the first two batch windows: they carry one-time pipeline
-    # warm-up (first dispatch, tunnel stream setup), not steady service
-    lat = pipe.get("sink").latency_percentiles(50, 99, skip=2 * batch)
-    if lat is None:
-        return dict(latency_p50_ms=None, latency_p99_ms=None,
-                    latency_budget_ms=budget_ms)
-    return dict(latency_p50_ms=round(lat[0], 2),
-                latency_p99_ms=round(lat[1], 2),
-                latency_budget_ms=budget_ms)
+    attempts = 0
+    while True:
+        attempts += 1
+        pipe = build_pipeline(batch, live_fps=fps, n_frames=fps * seconds,
+                              latency_budget_ms=budget_ms)
+        _collect(pipe)
+        # drop the first two batch windows: they carry one-time pipeline
+        # warm-up (first dispatch, tunnel stream setup), not steady service
+        lat = pipe.get("sink").latency_percentiles(50, 99, skip=2 * batch)
+        if lat is None:
+            return dict(latency_p50_ms=None, latency_p99_ms=None,
+                        latency_budget_ms=budget_ms,
+                        latency_reruns=attempts - 1)
+        # a p99 in the tens of seconds is a tunnel COLLAPSE (the link
+        # stalls for 15-30 s mid-run), not a property of the pipeline:
+        # one rerun, flagged so the JSON shows the measurement was
+        # repeated rather than silently cherry-picked
+        if lat[1] < 10_000 or attempts >= 2:
+            return dict(latency_p50_ms=round(lat[0], 2),
+                        latency_p99_ms=round(lat[1], 2),
+                        latency_budget_ms=budget_ms,
+                        latency_reruns=attempts - 1)
 
 
 def measure_pipeline(batch: int = BATCH) -> dict:

@@ -125,6 +125,12 @@ class ScriptFilter(FilterFramework):
         self._jitted = None
         self._host_mode = False
         self._in_info: Optional[TensorsInfo] = None
+        #: host mode: negotiated output (shape, dtype) pairs — the
+        #: interpreter has no tracer to freeze shapes, so invoke()
+        #: validates each frame's outputs against what negotiation
+        #: announced (a data-dependent shape fails HERE, loudly, not in
+        #: a downstream element sized off stale caps)
+        self._out_spec = None
 
     # -- vtable --------------------------------------------------------------
     def open(self, props: FilterProperties) -> None:
@@ -149,8 +155,15 @@ class ScriptFilter(FilterFramework):
         def run(*inputs):
             if self._host_mode:
                 # per-frame interpreter: plain numpy + host control-flow
-                # shims; jnp aliases numpy so device-flavored scripts run
-                ns: Dict[str, Any] = {"np": np, "jnp": np, **_HOST_OPS}
+                # shims; jnp aliases numpy and `lax` exposes the same
+                # shims so device-flavored scripts (lax.cond spelling
+                # included) run unchanged
+                import types
+
+                ns: Dict[str, Any] = {
+                    "np": np, "jnp": np,
+                    "lax": types.SimpleNamespace(**_HOST_OPS),
+                    **_HOST_OPS}
             else:
                 ns = {"jnp": jnp, "jax": jax, "lax": jax.lax, "np": jnp,
                       **_DEVICE_OPS}
@@ -195,6 +208,8 @@ class ScriptFilter(FilterFramework):
             # probe. Negotiation DOES run the script once in this mode.
             dummies = [np.ones(t.shape, t.type.np_dtype) for t in in_info]
             outs = self._run(*dummies)
+            self._out_spec = [(tuple(o.shape), np.dtype(o.dtype))
+                              for o in outs]
         else:
             specs = [
                 jax.ShapeDtypeStruct(t.shape, t.type.np_dtype)
@@ -211,5 +226,21 @@ class ScriptFilter(FilterFramework):
     def invoke(self, inputs: Sequence[Any]) -> List[Any]:
         with self.global_stats().measure():
             if self._host_mode:
-                return list(self._run(*[np.asarray(x) for x in inputs]))
+                outs = self._run(*[np.asarray(x) for x in inputs])
+                if self._out_spec is not None:
+                    if len(outs) != len(self._out_spec):
+                        raise ValueError(
+                            f"script: host script produced {len(outs)} "
+                            f"outputs, negotiated "
+                            f"{len(self._out_spec)}")
+                    for i, (o, (shape, dt)) in enumerate(
+                            zip(outs, self._out_spec)):
+                        if tuple(o.shape) != shape or o.dtype != dt:
+                            raise ValueError(
+                                f"script: host output {i} is "
+                                f"{tuple(o.shape)}:{o.dtype}, caps "
+                                f"negotiated {shape}:{dt} — "
+                                f"data-dependent output shapes are not "
+                                f"streamable")
+                return list(outs)
             return list(self._jitted(*[jnp.asarray(x) for x in inputs]))

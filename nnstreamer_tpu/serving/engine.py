@@ -535,7 +535,10 @@ class ContinuousBatchingEngine:
                 log.warning("serving: K auto-calibration failed (%s); "
                             "keeping K=%d", e, self.K)
                 # the failed dispatch may have donated (deleted) the
-                # live cache's buffers or left error arrays in it
+                # live cache's buffers or left error arrays in it;
+                # release the old reference BEFORE reallocating so the
+                # two caches never coexist (HBM headroom)
+                self._cache = None
                 self._cache = self._init_cache()
         self._stop_evt.clear()
         self._thread = threading.Thread(target=self._loop,

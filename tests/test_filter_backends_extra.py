@@ -171,6 +171,29 @@ class TestScriptFilter:
         assert outs["mode:host"][1].dtype == np.float32
         np.testing.assert_allclose(outs[None][1], outs["mode:host"][1])
 
+    def test_host_mode_lax_spelling_works(self):
+        """Device scripts written as lax.cond(...) run unchanged in
+        mode=host (the shim namespace answers to both spellings)."""
+        f = get_subplugin(FILTER, "script")()
+        f.open(FilterProperties(
+            model="y = lax.cond(np.mean(x) > 0.5,"
+                  " lambda a: a * 2.0, lambda a: a * 0.5, x)",
+            custom="mode:host"))
+        (out,) = f.invoke([np.full((4,), 2.0, np.float32)])
+        np.testing.assert_allclose(out, np.full((4,), 4.0))
+        f.close()
+
+    def test_host_mode_rejects_shape_drift(self):
+        """A data-dependent output shape fails loudly at the filter, not
+        downstream: host outputs are validated against negotiated caps."""
+        f = get_subplugin(FILTER, "script")()
+        f.open(FilterProperties(model="y = x[x > 0.0]",
+                                custom="mode:host"))
+        f.set_input_info(TensorsInfo.from_str("4", "float32"))  # ones probe
+        with pytest.raises(ValueError, match="negotiated"):
+            f.invoke([np.asarray([1.0, 0.0, 2.0, 0.0], np.float32)])
+        f.close()
+
     def test_script_rejects_unknown_mode(self):
         f = get_subplugin(FILTER, "script")()
         with pytest.raises(ValueError, match="mode"):
