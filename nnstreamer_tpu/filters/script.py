@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import os
 import re
+import types
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -95,6 +96,9 @@ _DEVICE_OPS = dict(cond=jax.lax.cond, while_loop=jax.lax.while_loop,
 _HOST_OPS = dict(cond=_host_cond, while_loop=_host_while,
                  fori_loop=_host_fori, switch=_host_switch,
                  select=np.where)
+#: the `lax.cond` spelling of the same shims (device scripts written
+#: against lax run unchanged in mode=host)
+_HOST_LAX = types.SimpleNamespace(**_HOST_OPS)
 
 #: numpy promotes to 64-bit where jax (x64 disabled) stays 32-bit; host
 #: outputs are narrowed to the device-mode widths so one script
@@ -146,6 +150,9 @@ class ScriptFilter(FilterFramework):
             raise ValueError(
                 f"script: mode must be 'device' or 'host', got {mode!r}")
         self._host_mode = mode == "host"
+        # reset per open(): a reused instance must not validate frames
+        # against a PREVIOUS script's negotiated output spec
+        self._out_spec = None
         # set on BOTH branches: a reused instance re-opened in device
         # mode must win back the on-device fast path
         self.KEEP_ON_DEVICE = not self._host_mode
@@ -158,12 +165,8 @@ class ScriptFilter(FilterFramework):
                 # shims; jnp aliases numpy and `lax` exposes the same
                 # shims so device-flavored scripts (lax.cond spelling
                 # included) run unchanged
-                import types
-
                 ns: Dict[str, Any] = {
-                    "np": np, "jnp": np,
-                    "lax": types.SimpleNamespace(**_HOST_OPS),
-                    **_HOST_OPS}
+                    "np": np, "jnp": np, "lax": _HOST_LAX, **_HOST_OPS}
             else:
                 ns = {"jnp": jnp, "jax": jax, "lax": jax.lax, "np": jnp,
                       **_DEVICE_OPS}
