@@ -10,13 +10,9 @@ property declares how raw memories reconstruct into typed tensors (it
 is also what the APPROVE reply announces to clients).
 """
 
-import numpy as np
-
 from nnstreamer_tpu.utils.platform import ensure_jax_platform
 
 ensure_jax_platform()
-
-import time
 
 import nnstreamer_tpu as nt
 from nnstreamer_tpu.filters.jax_backend import register_jax_model
@@ -32,9 +28,7 @@ server = nt.parse_launch(
     "queue max-size-buffers=8 materialize-host=true ! "
     "tensor_query_serversink")
 server.start()
-ssrc = server.get("ssrc")
-while ssrc.server is None:
-    time.sleep(0.01)
+ssrc = server.get("ssrc")  # start() is synchronous: server is bound
 print(f"reference-wire server: src port {ssrc.port}, "
       f"sink (results) port {ssrc.result_port}")
 

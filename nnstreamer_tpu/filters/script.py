@@ -96,9 +96,13 @@ _DEVICE_OPS = dict(cond=jax.lax.cond, while_loop=jax.lax.while_loop,
 _HOST_OPS = dict(cond=_host_cond, while_loop=_host_while,
                  fori_loop=_host_fori, switch=_host_switch,
                  select=np.where)
-#: the `lax.cond` spelling of the same shims (device scripts written
-#: against lax run unchanged in mode=host)
-_HOST_LAX = types.SimpleNamespace(**_HOST_OPS)
+
+
+def _host_lax():
+    """Fresh `lax.*` shim namespace per filter open: a script that
+    rebinds a shim must not leak the mutation into every other
+    host-mode filter in the process."""
+    return types.SimpleNamespace(**_HOST_OPS)
 
 #: numpy promotes to 64-bit where jax (x64 disabled) stays 32-bit; host
 #: outputs are narrowed to the device-mode widths so one script
@@ -158,6 +162,7 @@ class ScriptFilter(FilterFramework):
         self.KEEP_ON_DEVICE = not self._host_mode
         self._src = src
         self._code = compile(src, "<tensor_filter_script>", "exec")
+        host_lax = _host_lax()
 
         def run(*inputs):
             if self._host_mode:
@@ -166,7 +171,7 @@ class ScriptFilter(FilterFramework):
                 # shims so device-flavored scripts (lax.cond spelling
                 # included) run unchanged
                 ns: Dict[str, Any] = {
-                    "np": np, "jnp": np, "lax": _HOST_LAX, **_HOST_OPS}
+                    "np": np, "jnp": np, "lax": host_lax, **_HOST_OPS}
             else:
                 ns = {"jnp": jnp, "jax": jax, "lax": jax.lax, "np": jnp,
                       **_DEVICE_OPS}

@@ -78,6 +78,11 @@ DATA_INFO_SIZE = _DATA_INFO.size  # 176
 CLOCK_NONE = 0xFFFFFFFFFFFFFFFF
 
 
+def _cstr(body: bytes) -> str:
+    """Decode a wire C-string: bytes up to the first NUL."""
+    return body.split(b"\0", 1)[0].decode(errors="replace")
+
+
 class RefWireError(QueryProtocolError):
     """Wire violation — subclasses QueryProtocolError so the query
     client's retry/failover paths treat both wires uniformly."""
@@ -277,11 +282,10 @@ class RefWireClient:
             cmd, body = recv_cmd(self._src)
             if cmd == CMD_RESPOND_DENY:
                 raise RefWireError(
-                    f"server denied caps: {body.rstrip(b'%c' % 0).decode(errors='replace')}")
+                    f"server denied caps: {_cstr(body)}")
             if cmd != CMD_RESPOND_APPROVE:
                 raise RefWireError(f"expected APPROVE, got {cmd}")
-            self.server_caps = body.split(b"\0", 1)[0].decode(
-                errors="replace")
+            self.server_caps = _cstr(body)
             self._sink = socket.create_connection(
                 (sink_host or src_host,
                  sink_port if sink_port is not None else src_port + 1),
@@ -410,6 +414,33 @@ class RefWireQueryServer:
                              daemon=True).start()
             log.info("refwire client %d connected from %s", cid, addr)
 
+    def _caps_acceptable(self, client_caps: str) -> bool:
+        """The reference's admission test (tensor_query_common.c:770-803):
+        the client's announced caps must config-equal or caps-intersect
+        the server's (framerate ignored — TensorsConfig.is_equal never
+        compares rate). Permissive when either side is unparseable: our
+        caps grammar must not reject a conformant reference peer over a
+        spelling it doesn't know."""
+        if not self.caps_str or not client_caps.strip():
+            # no server caps to gate on / client hasn't negotiated its
+            # own caps yet (our client announces "" pre-negotiation)
+            return True
+        try:
+            from nnstreamer_tpu.pipeline.parse import parse_caps_string
+            from nnstreamer_tpu.tensors.types import TensorsConfig
+
+            server = parse_caps_string(self.caps_str)
+            client = parse_caps_string(client_caps)
+        except Exception:  # noqa: BLE001 — be liberal in what we accept
+            return True
+        try:
+            if TensorsConfig.from_caps(server).is_equal(
+                    TensorsConfig.from_caps(client)):
+                return True
+        except Exception:  # noqa: BLE001 — not tensor caps on one side
+            pass
+        return server.intersect(client) is not None
+
     def _src_loop(self, cid: int, conn: socket.socket):
         try:
             # reference serversrc sends the client id immediately on
@@ -418,6 +449,16 @@ class RefWireQueryServer:
             while not self._stop.is_set():
                 cmd, body = recv_cmd(conn)
                 if cmd == CMD_REQUEST_INFO:
+                    client_caps = _cstr(body)
+                    if not self._caps_acceptable(client_caps):
+                        # reference replies DENY with its own caps
+                        # (tensor_query_common.c:801-803)
+                        log.warning(
+                            "refwire client %d caps %r rejected vs "
+                            "server %r", cid, client_caps, self.caps_str)
+                        send_cmd(conn, CMD_RESPOND_DENY,
+                                 self.caps_str.encode() + b"\0")
+                        continue
                     send_cmd(conn, CMD_RESPOND_APPROVE,
                              self.caps_str.encode() + b"\0")
                 elif cmd == CMD_TRANSFER_START:

@@ -212,6 +212,40 @@ def test_server_reconstructs_typed_tensors(monkeypatch):
     assert seen[0].pts == 31337
 
 
+def test_server_denies_incompatible_caps(monkeypatch):
+    """The reference admission test: a client announcing tensor caps
+    that neither config-equal nor intersect the server's gets DENY with
+    the server's caps (tensor_query_common.c:770-803); compatible and
+    unparseable (be-liberal) caps are approved. Pure-Python transport —
+    the native epoll core stays permissive by design."""
+    from nnstreamer_tpu.query.server import QueryServer
+
+    monkeypatch.setenv("NNSTPU_PURE_PY_SERVER", "1")
+    server = QueryServer(host="127.0.0.1", port=0, caps_str=CAPS,
+                         wire="nnstreamer").start()
+    try:
+        bad = ("other/tensors,format=static,num_tensors=1,"
+               "dimensions=8:8,types=uint8")
+        with pytest.raises(R.RefWireError, match="denied"):
+            R.RefWireClient("127.0.0.1", server.port,
+                            sink_port=server.sink_port, in_caps=bad)
+        ok = R.RefWireClient("127.0.0.1", server.port,
+                             sink_port=server.sink_port, in_caps=CAPS)
+        assert ok.server_caps == CAPS
+        ok.close()
+        # non-tensor media caps deny too (reference can_intersect=false)
+        with pytest.raises(R.RefWireError, match="denied"):
+            R.RefWireClient("127.0.0.1", server.port,
+                            sink_port=server.sink_port,
+                            in_caps="video/x-raw,width=8,height=8")
+        # an empty/unparseable announcement is approved (be liberal)
+        empty = R.RefWireClient("127.0.0.1", server.port,
+                                sink_port=server.sink_port, in_caps="")
+        empty.close()
+    finally:
+        server.stop()
+
+
 class TestElementsRefwire:
     """Full pipeline loopback: our client element offloading over
     wire=nnstreamer to our serversrc/serversink pair."""
