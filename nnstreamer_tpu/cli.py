@@ -213,6 +213,12 @@ def main(argv=None) -> int:
     ap.add_argument("--dot", metavar="FILE",
                     help="write the started pipeline graph (fused "
                          "regions included) as Graphviz dot to FILE")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    metavar="PORT",
+                    help="serve live Prometheus metrics on "
+                         "http://0.0.0.0:PORT/metrics (JSON at "
+                         "/metrics.json) while the pipeline runs; "
+                         "0 picks a free port (printed at startup)")
     ap.add_argument("--export", nargs=2, metavar=("MODEL", "OUT"),
                     help="export a model (.py with get_model() / "
                          ".msgpack) as a compiled StableHLO artifact "
@@ -273,32 +279,59 @@ def main(argv=None) -> int:
                 el.connect(lambda buf, name=el.name:
                            print(f"{name}: {buf!r}"))
 
+    metrics_srv = None
+    if args.metrics_port is not None:
+        from nnstreamer_tpu.obs import MetricsServer
+
+        metrics_srv = MetricsServer(port=args.metrics_port).start()
+        print(f"Serving metrics on "
+              f"http://0.0.0.0:{metrics_srv.port}/metrics")
+
     print(f"Setting pipeline to PLAYING ({len(pipe.elements)} elements)...")
     try:
-        if args.dot:
-            # open BEFORE start so a bad path fails with nothing running;
-            # fusion happens at start, so the dump shows the real graph
-            with open(args.dot, "w") as f:
-                pipe.start()
-                f.write(pipe.to_dot())
-            print(f"Wrote pipeline graph to {args.dot}")
-        msg = pipe.run(timeout=args.timeout)
-    except Exception as e:  # noqa: BLE001 — CLI reports any failure
-        pipe.stop()  # idempotent; reaps anything --dot start()ed
-        print(f"nns-launch: ERROR: {e}", file=sys.stderr)
-        return 1
-    if msg is None:
-        print("nns-launch: timeout waiting for EOS", file=sys.stderr)
-        return 3
-    print("Got EOS from pipeline.")
+        try:
+            if args.dot:
+                # open BEFORE start so a bad path fails with nothing
+                # running; fusion happens at start, so the dump shows the
+                # real graph
+                with open(args.dot, "w") as f:
+                    pipe.start()
+                    f.write(pipe.to_dot())
+                print(f"Wrote pipeline graph to {args.dot}")
+            msg = pipe.run(timeout=args.timeout)
+        except Exception as e:  # noqa: BLE001 — CLI reports any failure
+            pipe.stop()  # idempotent; reaps anything --dot start()ed
+            print(f"nns-launch: ERROR: {e}", file=sys.stderr)
+            return 1
+        if msg is None:
+            print("nns-launch: timeout waiting for EOS", file=sys.stderr)
+            return 3
+        print("Got EOS from pipeline.")
 
-    if not args.quiet:
-        print("-- element stats (latency µs / throughput milli-out/s / invokes)")
-        for el in pipe.elements:
-            s = el.stats.snapshot()
-            print(f"  {el.name:28s} {s['latency_us']:>8d}  "
-                  f"{s['throughput_milli']:>10d}  {s['total_invokes']:>8d}")
-    return 0
+        if not args.quiet:
+            _print_stats(pipe)
+        return 0
+    finally:
+        # the exporter outlives EOS so a scraper can collect the final
+        # counters; it stops only when the process is about to exit
+        if metrics_srv is not None:
+            metrics_srv.stop()
+
+
+def _print_stats(pipe) -> None:
+    """Post-EOS per-element table from the metrics snapshot: the
+    InvokeStats trio plus drops and end-to-end tail latency."""
+    snap = pipe.metrics_snapshot()["elements"]
+    print("-- element stats (latency µs / throughput milli-out/s / "
+          "invokes / drops / e2e p50,p99 ms)")
+    for el in pipe.elements:
+        s = snap[el.name]
+        drops = s.get("drops", s.get("qos_drops"))
+        e2e = (f"{s['e2e_p50_ms']:.1f},{s['e2e_p99_ms']:.1f}"
+               if "e2e_p50_ms" in s else "-")
+        print(f"  {el.name:28s} {s['latency_us']:>8d}  "
+              f"{s['throughput_milli']:>10d}  {s['invokes']:>8d}  "
+              f"{drops if drops is not None else '-':>6}  {e2e:>12s}")
 
 
 if __name__ == "__main__":

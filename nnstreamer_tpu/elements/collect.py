@@ -23,25 +23,38 @@ host-side; payloads (possibly device arrays) are only routed, never copied
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Dict, List, Optional
 
 from nnstreamer_tpu.tensors.buffer import TensorBuffer
 
 SYNC_POLICIES = ("nosync", "slowest", "basepad", "refresh")
 
+#: buffer-meta key carrying the CollectPads arrival stamp (popped when
+#: the buffer leaves in a frame-set, so it never travels downstream)
+_ARRIVE_KEY = "_collect_arrive_t"
+
 
 class CollectPads:
     """Collects one buffer per pad according to a sync policy and emits
-    combined frame-sets via ``on_ready([(pad_index, buffer), ...])``."""
+    combined frame-sets via ``on_ready([(pad_index, buffer), ...])``.
+
+    ``observe_wait`` (optional) receives, per emitted frame-set, the
+    sync-wait in seconds: how long the set's EARLIEST-arriving buffer
+    sat waiting for its peers — the pipeline-visible cost of the sync
+    policy (a slow pad shows up here before it shows up as fps loss).
+    """
 
     def __init__(self, num_pads: int, policy: str = "slowest",
                  option: str = "",
-                 on_ready: Optional[Callable[[List[tuple]], None]] = None):
+                 on_ready: Optional[Callable[[List[tuple]], None]] = None,
+                 observe_wait: Optional[Callable[[float], None]] = None):
         if policy not in SYNC_POLICIES:
             raise ValueError(f"unknown sync policy {policy!r}")
         self.num_pads = num_pads
         self.policy = policy
         self.on_ready = on_ready
+        self.observe_wait = observe_wait
         self._lock = threading.Lock()
         self._queues: Dict[int, List[TensorBuffer]] = {
             i: [] for i in range(num_pads)
@@ -70,13 +83,27 @@ class CollectPads:
     # -- input ---------------------------------------------------------------
     def push(self, pad_index: int, buf: TensorBuffer) -> None:
         ready = None
+        if self.observe_wait is not None:
+            buf.meta[_ARRIVE_KEY] = time.monotonic()
         with self._lock:
             self._queues[pad_index].append(buf)
             self._last[pad_index] = buf
             ready = self._collect_locked(pad_index)
         if ready and self.on_ready:
             for frame in ready:
+                self._observe_frame(frame)
                 self.on_ready(frame)
+
+    def _observe_frame(self, frame: List[tuple]) -> None:
+        """Report the frame-set's sync wait (earliest arrival → now).
+        Stamps are popped so a buffer reused by the ``refresh`` policy
+        contributes its wait only once."""
+        if self.observe_wait is None:
+            return
+        stamps = [b.meta.pop(_ARRIVE_KEY, None) for _, b in frame]
+        stamps = [t for t in stamps if t is not None]
+        if stamps:
+            self.observe_wait(time.monotonic() - min(stamps))
 
     def requeue_front(self, pad_index: int, buf: TensorBuffer) -> None:
         """Put a buffer back at the head of a pad's queue (no collect
@@ -96,6 +123,7 @@ class CollectPads:
             ready = self._collect_locked(-1)
         if ready and self.on_ready:
             for frame in ready:
+                self._observe_frame(frame)
                 self.on_ready(frame)
         return ready
 

@@ -26,11 +26,13 @@ pipeline stages overlap naturally.
 from __future__ import annotations
 
 import os
+import time as _time
 from typing import Any, List, Optional
 
 import numpy as np
 
 from nnstreamer_tpu.config import get_conf
+from nnstreamer_tpu.obs import get_registry
 from nnstreamer_tpu.filters.api import FilterFramework, FilterProperties
 from nnstreamer_tpu.pipeline.element import CustomEvent, Element, Event, Pad
 from nnstreamer_tpu.registry import ELEMENT, FILTER, get_subplugin, subplugin
@@ -96,6 +98,44 @@ class TensorFilter(Element):
         self._out_model_info: Optional[TensorsInfo] = None
         self._last_invoke_t = 0.0
         self._comb_cache: dict = {}
+        self._m_invoke = None  # created lazily: labels need pipeline name
+
+    def _obs_invoke(self):
+        """Filter-specific metrics. ``nns_tensor_filter_invoke_seconds``
+        times ONLY the backend invoke (the element-level chain histogram
+        includes the downstream push); opens/reloads count backend
+        lifecycle events (an open implies an XLA compile on jit
+        backends)."""
+        if self._m_invoke is None:
+            reg = get_registry()
+            labels = self._obs_labels()
+            self._m_invoke = {
+                "invoke": reg.histogram(
+                    "nns_tensor_filter_invoke_seconds",
+                    "Backend invoke() latency (dispatch->result handle)",
+                    **labels),
+                "opens": reg.counter(
+                    "nns_tensor_filter_opens_total",
+                    "Backend opens (first open compiles on jit backends)",
+                    **labels),
+                "reloads": reg.counter(
+                    "nns_tensor_filter_reloads_total",
+                    "Hot model reloads (RELOAD_MODEL)", **labels),
+                "qos_drops": reg.counter(
+                    "nns_tensor_filter_qos_drops_total",
+                    "Invokes skipped by throttle/QoS", **labels),
+            }
+        return self._m_invoke
+
+    def obs_snapshot(self):
+        out = super().obs_snapshot()
+        if self._m_invoke is not None:
+            h = self._m_invoke["invoke"]
+            if h.count:
+                out["invoke_p50_ms"] = round(h.percentile(50) * 1e3, 3)
+                out["invoke_p99_ms"] = round(h.percentile(99) * 1e3, 3)
+            out["qos_drops"] = int(self._m_invoke["qos_drops"].value)
+        return out
 
     def _combination(self, key: str):
         """Parsed input/output combination, cached off the hot path."""
@@ -139,6 +179,7 @@ class TensorFilter(Element):
         )
         fw.open(props)
         self.fw = fw
+        self._obs_invoke()["opens"].inc()
         return fw
 
     def _forced_info(self, dim_key: str, type_key: str) -> Optional[TensorsInfo]:
@@ -219,9 +260,11 @@ class TensorFilter(Element):
 
     # -- hot path ------------------------------------------------------------
     def chain(self, pad, buf):
+        obs = self._obs_invoke()
         throttle = int(self.get_property("throttle"))
         # min invoke interval: own throttle prop and downstream QoS combine
         if self._qos_throttled(1.0 / throttle if throttle > 0 else 0.0):
+            obs["qos_drops"].inc()
             return None  # QoS drop (tensor_filter.c:426)
         fw = self.fw or self._open_fw()
 
@@ -242,7 +285,9 @@ class TensorFilter(Element):
             model_inputs = [np.asarray(x) if not isinstance(x, np.ndarray)
                             else x for x in model_inputs]
 
+        t0 = _time.monotonic()
         outputs = fw.invoke(model_inputs)
+        obs["invoke"].observe(_time.monotonic() - t0)
 
         out_comb = self._combination("output_combination")
         if out_comb is not None:
@@ -292,6 +337,7 @@ class TensorFilter(Element):
         if isinstance(event, CustomEvent) and event.name == "reload_model":
             if self.fw is not None:
                 self.fw.handle_event("reload_model", event.data)
+                self._obs_invoke()["reloads"].inc()
                 self.log.info("model reloaded")
             return  # consumed
         super().sink_event(pad, event)
@@ -303,6 +349,7 @@ class TensorFilter(Element):
             self._props["model"] = model
         if self.fw is not None:
             self.fw.handle_event("reload_model", data)
+            self._obs_invoke()["reloads"].inc()
         region = getattr(self, "_fused_region", None)
         if region is not None:
             region.invalidate()

@@ -49,11 +49,18 @@ class TensorMux(Element):
 
     def _get_collect(self) -> CollectPads:
         if self._collect is None:
+            from nnstreamer_tpu.obs import get_registry
+
+            hist = get_registry().histogram(
+                "nns_tensor_mux_sync_wait_seconds",
+                "Frame-set assembly wait under the pad-sync policy",
+                **self._obs_labels())
             self._collect = CollectPads(
                 num_pads=len(self.sinkpads),
                 policy=self.get_property("sync_mode"),
                 option=self.get_property("sync_option"),
                 on_ready=self._emit,
+                observe_wait=hist.observe,
             )
         return self._collect
 

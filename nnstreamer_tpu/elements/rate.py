@@ -15,6 +15,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+from nnstreamer_tpu.obs import get_registry
 from nnstreamer_tpu.pipeline.element import Element, QosEvent
 from nnstreamer_tpu.registry import ELEMENT, subplugin
 from nnstreamer_tpu.tensors.types import Fraction, TensorsConfig
@@ -36,6 +37,26 @@ class TensorRate(Element):
         self.dropped = 0
         self.duplicated = 0
         self.out_count = 0
+        self._m_dropped = None     # registry counters, created lazily so
+        self._m_duplicated = None  # labels carry the owning pipeline name
+
+    def _obs_counters(self):
+        if self._m_dropped is None:
+            reg = get_registry()
+            labels = self._obs_labels()
+            self._m_dropped = reg.counter(
+                "nns_tensor_rate_dropped_total",
+                "Frames dropped by framerate conversion", **labels)
+            self._m_duplicated = reg.counter(
+                "nns_tensor_rate_duplicated_total",
+                "Frames duplicated by framerate conversion", **labels)
+        return self._m_dropped, self._m_duplicated
+
+    def obs_snapshot(self):
+        out = super().obs_snapshot()
+        out["drops"] = self.dropped
+        out["duplicates"] = self.duplicated
+        return out
 
     def _out_rate(self) -> Optional[Fraction]:
         spec = self.get_property("framerate")
@@ -91,6 +112,7 @@ class TensorRate(Element):
             # first timestamp (streams may carry wall-clock pts)
         ret = None
         pushed = False
+        m_drop, m_dup = self._obs_counters()
         # emit one output per elapsed output period; duplicate if input is
         # slower, drop if faster
         while buf.pts >= self._next_ts:
@@ -101,11 +123,13 @@ class TensorRate(Element):
             self.out_count += 1
             if pushed:
                 self.duplicated += 1
+                m_dup.inc()
                 if not self.get_property("silent"):
                     self.log.debug("duplicated frame at pts %d", out.pts)
             pushed = True
         if not pushed:
             self.dropped += 1
+            m_drop.inc()
             if not self.get_property("silent"):
                 self.log.debug("dropped frame at pts %d (total %d)",
                                buf.pts, self.dropped)

@@ -48,6 +48,24 @@ class TensorSink(Element):
         #: end-to-end per-frame latencies in seconds (create_t → chain);
         #: ring-bounded so long-lived live pipelines don't grow forever
         self.latencies: deque = deque(maxlen=100_000)
+        self._m_e2e = None  # lazy: labels need the owning pipeline's name
+
+    def _obs_e2e(self):
+        if self._m_e2e is None:
+            from nnstreamer_tpu.obs import get_registry
+
+            self._m_e2e = get_registry().histogram(
+                "nns_sink_e2e_seconds",
+                "End-to-end frame latency, source create() to sink",
+                **self._obs_labels())
+        return self._m_e2e
+
+    def obs_snapshot(self):
+        out = super().obs_snapshot()
+        pcts = self.latency_percentiles(50.0, 99.0)
+        if pcts is not None:
+            out["e2e_p50_ms"], out["e2e_p99_ms"] = pcts
+        return out
 
     def connect(self, callback: Callable[[TensorBuffer], None]) -> None:
         """Register a per-buffer callback (reference ``new-data`` signal)."""
@@ -82,7 +100,10 @@ class TensorSink(Element):
             now = time.monotonic()
             stamps = buf.create_stamps()
             if stamps:
-                self.latencies.extend(now - t for t in stamps)
+                hist = self._obs_e2e()
+                for t in stamps:
+                    self.latencies.append(now - t)
+                    hist.observe(now - t)
         with self._cv:
             if len(self.buffers) < int(self.get_property("max_stored")):
                 self.buffers.append(buf)

@@ -4,20 +4,55 @@ The reference maps ml_loge/logw/logi/logd onto dlog/android-log/GLib per
 platform; we map onto :mod:`logging` with one namespaced logger per element
 and the same severity vocabulary. Elements honor a ``silent`` property by
 raising their logger's level (reference: per-element ``silent`` prop).
+
+Configuration is lazy and idempotent: the first :func:`get_logger` call
+attaches one handler to the ``nnstreamer_tpu`` package logger (level from
+``NNSTREAMER_TPU_LOGLEVEL``, default WARNING) with ``propagate=False`` —
+the host application's root logging config is never touched (the old
+import-time ``logging.basicConfig()`` clobbered it, the classic library
+anti-pattern). Call :func:`configure` to re-apply after changing the env
+var or to set an explicit level programmatically.
 """
 
 from __future__ import annotations
 
 import logging
 import os
+import threading
 
 _ROOT = "nnstreamer_tpu"
+_FORMAT = "%(asctime)s %(levelname).1s %(name)s: %(message)s"
 
-logging.basicConfig(
-    level=os.environ.get("NNSTREAMER_TPU_LOGLEVEL", "WARNING").upper(),
-    format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
-)
+_configured = False
+_config_lock = threading.Lock()
+
+
+def configure(level=None, force: bool = False) -> logging.Logger:
+    """Configure the package logger once (idempotent). ``level`` overrides
+    ``NNSTREAMER_TPU_LOGLEVEL``; ``force=True`` re-reads the environment
+    and re-applies the level even if already configured."""
+    global _configured
+    logger = logging.getLogger(_ROOT)
+    with _config_lock:
+        if _configured and not force and level is None:
+            return logger
+        if level is None:
+            level = os.environ.get("NNSTREAMER_TPU_LOGLEVEL", "WARNING")
+        if isinstance(level, str):
+            level = level.upper()
+        logger.setLevel(level)
+        if not any(getattr(h, "_nnstpu", False) for h in logger.handlers):
+            handler = logging.StreamHandler()
+            handler.setFormatter(logging.Formatter(_FORMAT))
+            handler._nnstpu = True  # ours: the idempotency marker
+            logger.addHandler(handler)
+        # our handler does the emitting; don't also bubble into the host
+        # app's root handlers (double print) or its lastResort
+        logger.propagate = False
+        _configured = True
+    return logger
 
 
 def get_logger(name: str = "") -> logging.Logger:
+    configure()
     return logging.getLogger(f"{_ROOT}.{name}" if name else _ROOT)

@@ -29,9 +29,11 @@ from __future__ import annotations
 import dataclasses
 import enum
 import threading
+import time as _time
 from typing import Any, Callable, Dict, List, Optional
 
 from nnstreamer_tpu.log import get_logger
+from nnstreamer_tpu.obs import get_registry
 from nnstreamer_tpu.pipeline.caps import ANY, Caps, CapsList
 from nnstreamer_tpu.tensors.buffer import TensorBuffer
 from nnstreamer_tpu.utils.stats import InvokeStats
@@ -207,6 +209,7 @@ class Element:
         self.srcpads: List[Pad] = []
         self.stats = InvokeStats()
         self.pipeline = None  # set by Pipeline.add
+        self._obs_hist = None  # per-element chain histogram, lazy
         self._started = False
         self._lock = threading.RLock()
         for k, v in props.items():
@@ -315,20 +318,53 @@ class Element:
     #: see the same payload they would in an unfused pipeline.
     HANDLES_DEFERRED = False
 
+    def _obs_labels(self) -> Dict[str, str]:
+        """Stable metric labels: ``{pipeline=..., element=...}`` (the
+        ``nns_<element>_<metric>`` naming scheme's label half)."""
+        return {"pipeline": getattr(self.pipeline, "name", "") or "",
+                "element": self.name}
+
+    def _obs_chain_hist(self):
+        """The per-element chain-latency histogram (lazy: labels include
+        the owning pipeline's name, known only after Pipeline.add)."""
+        h = self._obs_hist
+        if h is None:
+            h = self._obs_hist = get_registry().histogram(
+                "nns_element_chain_seconds",
+                "Per-buffer chain duration (invoke + downstream push)",
+                **self._obs_labels())
+        return h
+
+    def obs_snapshot(self) -> Dict[str, Any]:
+        """Element-specific extras for ``Pipeline.metrics_snapshot()``
+        (subclasses add drops, depth, e2e percentiles, ...)."""
+        h = self._obs_hist
+        if h is None or h.count == 0:
+            return {}
+        p50, p99 = h.percentile(50), h.percentile(99)
+        return {"chain_p50_ms": round(p50 * 1e3, 3),
+                "chain_p99_ms": round(p99 * 1e3, 3)}
+
     def _chain_entry(self, pad: Pad, buf: TensorBuffer) -> FlowReturn:
         if pad.eos:
             return FlowReturn.EOS
-        with self.stats.measure():
+        t0 = _time.monotonic()
+        try:
             try:
                 if buf.finalize is not None and not self.HANDLES_DEFERRED:
-                    # blocking D2H + host finalize — inside measure() so the
-                    # element paying the sync is the one whose stats show it
+                    # blocking D2H + host finalize — inside the timed span
+                    # so the element paying the sync is the one whose
+                    # stats show it
                     buf = buf.to_host()
                 ret = self.chain(pad, buf)
             except FlowError:
                 raise
             except Exception as e:
                 raise FlowError(f"{self.name}: {e}") from e
+        finally:
+            now = _time.monotonic()
+            self.stats.record(now - t0, now)
+            self._obs_chain_hist().observe(now - t0)
         return FlowReturn.OK if ret is None else ret
 
     def _event_entry(self, pad: Pad, event: Event) -> None:

@@ -24,6 +24,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from nnstreamer_tpu.log import get_logger
+from nnstreamer_tpu.obs import get_registry, register_pipeline_collector
 from nnstreamer_tpu.pipeline.element import (
     Element,
     EosEvent,
@@ -130,6 +131,9 @@ class Queue(Element):
 
     _EOS = object()
 
+    #: rate limit for the leaky-drop warning (seconds between warnings)
+    DROP_WARN_INTERVAL_S = 5.0
+
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self.add_sink_pad("sink")
@@ -138,12 +142,62 @@ class Queue(Element):
         self._worker: Optional[threading.Thread] = None
         self._stop_evt = threading.Event()
         self._eos_done = threading.Event()
+        self._m_drops = None      # leaky-downstream drop counter (lazy)
+        self._m_blocked = None    # cumulative blocked-put seconds (lazy)
+        self._last_drop_warn_t = 0.0
+        self._drops_since_warn = 0
+
+    def _obs_init(self):
+        """Queue metrics: depth gauge (sampled), drop counter, blocked
+        time. Created at start() so the labels carry the owning
+        pipeline's name."""
+        reg = get_registry()
+        labels = self._obs_labels()
+        self._m_drops = reg.counter(
+            "nns_queue_drops_total",
+            "Buffers discarded by leaky=downstream backpressure", **labels)
+        self._m_blocked = reg.counter(
+            "nns_queue_blocked_seconds_total",
+            "Cumulative producer time spent blocked on a full queue",
+            **labels)
+        import weakref
+
+        ref = weakref.ref(self)
+        reg.gauge("nns_queue_depth", "Buffers currently queued",
+                  fn=lambda: (ref()._q.qsize() if ref() is not None else 0),
+                  **labels)
+
+    def _count_drop(self) -> None:
+        """Satellite: leaky-downstream drops were silent — count every
+        one and emit one rate-limited warning so live operators see the
+        loss without per-frame log spam."""
+        self._m_drops.inc()
+        self._drops_since_warn += 1
+        now = time.monotonic()
+        if now - self._last_drop_warn_t >= self.DROP_WARN_INTERVAL_S:
+            self.log.warning(
+                "%s: leaky=downstream dropped %d buffer(s) since last "
+                "report (downstream slower than producer; total %d)",
+                self.name, self._drops_since_warn,
+                int(self._m_drops.value))
+            self._last_drop_warn_t = now
+            self._drops_since_warn = 0
+
+    def obs_snapshot(self):
+        out = super().obs_snapshot()
+        out["depth"] = self._q.qsize()
+        if self._m_drops is not None:
+            out["drops"] = int(self._m_drops.value)
+            out["blocked_s"] = round(self._m_blocked.value, 4)
+        return out
 
     def start(self):
         super().start()
         self._stop_evt.clear()
         self._eos_done.clear()
         self._q = _queue.Queue(maxsize=int(self.get_property("max_size_buffers")))
+        if self._m_drops is None:
+            self._obs_init()
         self._worker = threading.Thread(
             target=self._drain, name=f"{self.name}-worker", daemon=True
         )
@@ -206,14 +260,20 @@ class Queue(Element):
                 except _queue.Full:
                     try:
                         self._q.get_nowait()  # drop oldest
+                        self._count_drop()
                     except _queue.Empty:
                         pass
         else:
+            t0 = None
             while not self._stop_evt.is_set():
                 try:
                     self._q.put(buf, timeout=0.1)
+                    if t0 is not None:
+                        self._m_blocked.inc(time.monotonic() - t0)
                     return FlowReturn.OK
                 except _queue.Full:
+                    if t0 is None:
+                        t0 = time.monotonic()
                     continue
             return FlowReturn.EOS
 
@@ -301,6 +361,9 @@ class Pipeline:
         self._lock = threading.Lock()
         self._fuse = fuse
         self._regions: Optional[list] = None
+        # export per-element latency/throughput gauges at scrape time
+        # (weakref-bound: a collected pipeline unregisters itself)
+        register_pipeline_collector(self)
 
     # -- construction ---------------------------------------------------------
     def add(self, *elements: Element) -> "Pipeline":
@@ -328,6 +391,27 @@ class Pipeline:
         from nnstreamer_tpu.pipeline.dot import pipeline_to_dot
 
         return pipeline_to_dot(self)
+
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        """In-process structured metrics read: one dict per element with
+        the reference-style windowed stats (same ``InvokeStats`` the
+        ``latency``/``throughput`` properties read) plus element-specific
+        extras (queue depth/drops, rate drops/duplicates, sink e2e
+        percentiles). The HTTP exporter serves the registry-wide view;
+        this is the pipeline-scoped one."""
+        elements: Dict[str, Any] = {}
+        for el in self.elements:
+            stats = el._metrics_stats()
+            entry: Dict[str, Any] = {
+                "type": el.ELEMENT_NAME,
+                "latency_us": stats.latency_us,
+                "throughput_milli": stats.throughput_milli,
+                "invokes": stats.total_invokes,
+            }
+            entry.update(el.obs_snapshot())
+            elements[el.name] = entry
+        return {"pipeline": self.name, "state": self.state.value,
+                "elements": elements}
 
     # -- state ----------------------------------------------------------------
     def start(self) -> "Pipeline":

@@ -95,12 +95,35 @@ class TensorServiceServer:
         # RecvTensors subscriber must not grow without bound at video rate
         self._sendq: _queue.Queue = _queue.Queue(maxsize=64)
         self._stop = threading.Event()
+        from nnstreamer_tpu.obs import get_registry
+
+        reg = get_registry()
+        self._m_recv = reg.counter(
+            "nns_grpc_requests_total",
+            "Buffers moved through TensorService",
+            method="SendTensors", idl=idl)
+        self._m_send = reg.counter(
+            "nns_grpc_requests_total",
+            "Buffers moved through TensorService",
+            method="RecvTensors", idl=idl)
+        self._m_errors = reg.counter(
+            "nns_grpc_errors_total",
+            "on_recv callback failures", idl=idl)
+        self._m_send_drops = reg.counter(
+            "nns_grpc_send_drops_total",
+            "RecvTensors-queue buffers displaced by backpressure", idl=idl)
 
         def send_tensors(request_iterator, context):
             # client→server stream; requests arrive already decoded
             for buf in request_iterator:
+                self._m_recv.inc()
                 if self.on_recv is not None:
-                    self.on_recv(buf)
+                    try:
+                        self.on_recv(buf)
+                    except Exception:  # noqa: BLE001 — one bad frame must
+                        # not tear down the client's whole send stream
+                        self._m_errors.inc()
+                        log.exception("on_recv callback failed")
             return b""  # Empty
 
         def recv_tensors(request, context):
@@ -140,6 +163,7 @@ class TensorServiceServer:
     def send(self, buf: TensorBuffer) -> None:
         """Queue a buffer for RecvTensors streams (drops oldest on
         backpressure, like a leaky downstream queue)."""
+        self._m_send.inc()
         while True:
             try:
                 self._sendq.put_nowait(buf)
@@ -147,6 +171,7 @@ class TensorServiceServer:
             except _queue.Full:
                 try:
                     self._sendq.get_nowait()
+                    self._m_send_drops.inc()
                 except _queue.Empty:
                     pass
 

@@ -59,6 +59,15 @@ class QueryServer:
         self._sink_core = None  # refwire: native sink-port core
         self._refwire = None    # refwire: pure-Python two-port server
         self._config = None     # refwire: TensorsConfig for reconstruction
+        from nnstreamer_tpu.obs import get_registry
+
+        reg = get_registry()
+        self._m_requests = reg.counter(
+            "nns_query_requests_total",
+            "Buffers received from query clients", wire=self.wire)
+        self._m_errors = reg.counter(
+            "nns_query_errors_total",
+            "Malformed / undeliverable query frames", wire=self.wire)
         if caps_str and wire == "nnstreamer":
             try:
                 from nnstreamer_tpu.pipeline.parse import parse_caps_string
@@ -204,6 +213,7 @@ class QueryServer:
                     except Exception as e:  # noqa: BLE001 — corrupt frame:
                         # orderly disconnect (matches the native path's
                         # kick-on-bad-frame), not a thread-killing traceback
+                        self._m_errors.inc()
                         log.warning("bad frame from client %d (%s); "
                                     "disconnecting it", client_id, e)
                         break
@@ -242,6 +252,7 @@ class QueryServer:
                     pts=info.get("pts"), dts=info.get("dts"),
                     duration=info.get("duration"))
         except ValueError as e:
+            self._m_errors.inc()
             log.warning("refwire buffer from client %d does not match "
                         "the configured caps (%s); dropping it",
                         client_id, e)
@@ -264,6 +275,7 @@ class QueryServer:
             raw = R.pack_buffer_frames(mems, pts=buf.pts)
             ok = sink_core.send_raw(client_id, raw)
             if not ok:
+                self._m_errors.inc()
                 log.warning("refwire result for client %d not deliverable",
                             client_id)
             return ok
@@ -272,23 +284,33 @@ class QueryServer:
             ok = core.send(client_id, int(P.Cmd.RESULT),
                            P.pack_buffer(buf))
             if not ok:
+                self._m_errors.inc()
                 log.warning("result for client %d not deliverable",
                             client_id)
             return ok
         with self._clients_lock:
             conn = self._clients.get(client_id)
         if conn is None:
+            self._m_errors.inc()
             log.warning("result for unknown client %d dropped", client_id)
             return False
         try:
             P.send_buffer(conn, buf, cmd=P.Cmd.RESULT)
             return True
         except OSError as e:
+            self._m_errors.inc()
             log.warning("send to client %d failed: %s", client_id, e)
             return False
 
     def get_buffer(self, timeout: Optional[float] = None
                    ) -> Optional[TensorBuffer]:
+        buf = self._get_buffer_impl(timeout)
+        if buf is not None:
+            self._m_requests.inc()
+        return buf
+
+    def _get_buffer_impl(self, timeout: Optional[float] = None
+                         ) -> Optional[TensorBuffer]:
         if self.wire == "nnstreamer":
             from nnstreamer_tpu.query import refwire as R
 
@@ -309,6 +331,7 @@ class QueryServer:
             try:
                 info, mems = R.split_assembled(payload)
             except R.RefWireError as e:
+                self._m_errors.inc()
                 log.warning("bad refwire frame from client %d (%s); "
                             "disconnecting it", cid, e)
                 core.kick(cid)
@@ -336,6 +359,7 @@ class QueryServer:
                 except Exception as e:  # noqa: BLE001 — corrupt frame:
                     # disconnect the sender (pure-Python parity: its client
                     # loop dies on a bad frame) and keep waiting
+                    self._m_errors.inc()
                     log.warning("bad frame from client %d (%s); "
                                 "disconnecting it", client_id, e)
                     core.kick(client_id)

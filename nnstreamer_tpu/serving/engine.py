@@ -33,6 +33,7 @@ every dispatch so XLA updates it in place.
 from __future__ import annotations
 
 import collections
+import itertools
 import queue as _queue
 import threading
 import time as _time
@@ -193,6 +194,7 @@ class _PendingRequest:
         self.prompt = prompt
         self.max_new = max_new
         self.stream = stream
+        self.submit_t = _time.monotonic()  # → queue-wait histogram
 
 
 class ContinuousBatchingEngine:
@@ -390,6 +392,18 @@ class ContinuousBatchingEngine:
             "prefill_chunks": 0, "slot_steps": 0, "active_slot_steps": 0,
             "prefix_hits": 0, "prefix_tokens_reused": 0,
         }
+        from nnstreamer_tpu.obs import (
+            get_registry,
+            register_engine_collector,
+        )
+
+        #: registry label distinguishing concurrent engines in one process
+        self.obs_name = f"engine{next(self._OBS_SEQ)}"
+        self._m_queue_wait = get_registry().histogram(
+            "nns_serving_queue_wait_seconds",
+            "submit() to batch-slot admission wait",
+            engine=self.obs_name)
+        register_engine_collector(self)
         self.prefix_cache = int(prefix_cache)
         if self.prefix_cache < 0:
             raise ValueError(
@@ -683,6 +697,7 @@ class ContinuousBatchingEngine:
         first-token sampling DISPATCH. Returns the activation record for
         :meth:`_activate_commit` — the loop commits a whole admission
         wave with one host sync instead of one round trip per prompt."""
+        self._m_queue_wait.observe(_time.monotonic() - req.submit_t)
         jnp = self._jnp
         prompt = req.prompt
         n = prompt.size
@@ -732,11 +747,15 @@ class ContinuousBatchingEngine:
     #: reserves a batch slot while its chunked prefill is in flight
     _RESERVED = object()
 
+    #: process-wide sequence behind ``obs_name`` (engine0, engine1, ...)
+    _OBS_SEQ = itertools.count()
+
     #: minimum common-prefix length worth a warm (remainder-only)
     #: admission; exact whole-prompt hits are never thresholded
     PREFIX_MIN_REUSE = 4
 
     def _begin_partial(self, req: _PendingRequest, slot: int):
+        self._m_queue_wait.observe(_time.monotonic() - req.submit_t)
         base = 0
         cache1 = self._init_cache1()
         if self.prefix_cache:
