@@ -1,0 +1,47 @@
+"""Static analysis for nnstreamer_tpu: ``nns-lint``.
+
+Two halves sharing one diagnostics model:
+
+- the **pipeline verifier** (:func:`verify_description`,
+  :func:`verify_pipeline`) statically checks nns-launch descriptions —
+  graph shape, caps/dtype/shape propagation, policy conflicts — without
+  constructing any runtime state (codes ``NNS0xx``);
+- the **project AST lint** (:func:`lint_tree`) enforces codebase
+  invariants like monotonic-clock usage and no blocking calls under
+  locks (codes ``NNS1xx``).
+
+See ``docs/linting.md`` for the full diagnostic-code table, the JSON
+output schema, and the pragma syntax.
+"""
+
+from nnstreamer_tpu.analysis.astlint import (     # noqa: F401
+    lint_file,
+    lint_source,
+    lint_tree,
+)
+from nnstreamer_tpu.analysis.diagnostics import (  # noqa: F401
+    CODE_TABLE,
+    ERROR,
+    INFO,
+    WARNING,
+    Diagnostic,
+    Location,
+    has_errors,
+    render_json,
+    render_text,
+    sort_diagnostics,
+    summarize,
+)
+from nnstreamer_tpu.analysis.verify import (       # noqa: F401
+    verify_description,
+    verify_pipeline,
+)
+
+__all__ = [
+    "CODE_TABLE", "Diagnostic", "Location",
+    "ERROR", "WARNING", "INFO",
+    "has_errors", "render_json", "render_text", "sort_diagnostics",
+    "summarize",
+    "verify_description", "verify_pipeline",
+    "lint_file", "lint_source", "lint_tree",
+]

@@ -1,0 +1,299 @@
+"""Project-invariant AST lint — the ``NNS1xx`` half of ``nns-lint``.
+
+These rules encode invariants this codebase has already been burned by
+(see docs/linting.md for the rationale of each):
+
+- NNS101: ``time.time()`` measures wall-clock, which jumps under NTP
+  steps; durations and deadlines must use ``time.monotonic()``. Binding
+  the value to a ``wall*``-prefixed name marks the intentional wall-clock
+  uses (export timestamps) without a pragma.
+- NNS102: sleeping, joining a thread, or doing socket IO while holding a
+  lock serializes every other waiter behind the blocking call.
+- NNS103: library code logs through ``utils/log.py``; ``print`` is only
+  for CLI entry points.
+- NNS104: a bare ``except:`` (or ``except Exception: pass``) swallows
+  ``KeyboardInterrupt``/bugs silently.
+- NNS105: a ``threading.Thread`` without an explicit ``daemon=`` choice
+  inherits it implicitly — shutdown behavior should be a decision, not an
+  accident.
+- NNS106: metric names must follow ``nns_<subsystem>_...`` so dashboards
+  can group by prefix.
+
+Findings are suppressed per-line with::
+
+    # nns-lint: disable=NNS101 -- <why this line is an exception>
+
+A pragma with no justification is itself a finding (NNS199).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+
+from nnstreamer_tpu.analysis.diagnostics import (
+    ERROR,
+    Diagnostic,
+    Location,
+    sort_diagnostics,
+)
+
+_PRAGMA_RE = re.compile(
+    r"#\s*nns-lint:\s*disable=([A-Z0-9,]+)(?:\s+--\s*(\S.*))?")
+
+#: metric-registry constructor methods whose first argument is the name
+_METRIC_METHODS = {"counter", "gauge", "histogram"}
+_METRIC_NAME_RE = re.compile(r"^nns_[a-z0-9]+(_[a-z0-9]+)+$")
+
+#: socket methods that block on the network
+_SOCKET_BLOCKING = {"recv", "recvfrom", "recv_into", "accept", "connect",
+                    "sendall", "sendto"}
+
+
+def _parse_pragmas(text: str) -> Tuple[Dict[int, Set[str]], List[int]]:
+    """Per-line suppressed codes, plus lines with a reasonless pragma."""
+    suppressed: Dict[int, Set[str]] = {}
+    missing_reason: List[int] = []
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        m = _PRAGMA_RE.search(line)
+        if not m:
+            continue
+        codes = {c.strip() for c in m.group(1).split(",") if c.strip()}
+        suppressed[lineno] = codes
+        if not m.group(2):
+            missing_reason.append(lineno)
+    return suppressed, missing_reason
+
+
+def _dotted(node: ast.AST) -> str:
+    """'time.time' for Attribute/Name chains, '' for anything dynamic."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+class _FileLinter(ast.NodeVisitor):
+    def __init__(self, path: Path, tree: ast.Module, text: str,
+                 rel: str):
+        self.path = path
+        self.rel = rel
+        self.tree = tree
+        self.text = text
+        self.diags: List[Diagnostic] = []
+        self._lock_depth = 0
+        self._func_stack: List[str] = []
+        self._wall_lines: Set[int] = set()
+        self._collect_wall_bindings(tree)
+
+    # -- helpers -------------------------------------------------------------
+    def emit(self, code: str, node: ast.AST, message: str,
+             hint: Optional[str] = None) -> None:
+        loc = Location(self.rel, getattr(node, "lineno", 1),
+                       getattr(node, "col_offset", 0) + 1)
+        self.diags.append(Diagnostic(code, ERROR, loc, message, hint))
+
+    def _collect_wall_bindings(self, tree: ast.Module) -> None:
+        """Lines where time.time() is bound to a wall*-prefixed name —
+        the in-code way to mark deliberate wall-clock reads."""
+        for node in ast.walk(tree):
+            targets: List[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                targets = [node.target]
+            for t in targets:
+                name = t.attr if isinstance(t, ast.Attribute) else \
+                    t.id if isinstance(t, ast.Name) else ""
+                if name.startswith("wall"):
+                    for sub in ast.walk(node):
+                        if hasattr(sub, "lineno"):
+                            self._wall_lines.add(sub.lineno)
+
+    # -- visitors ------------------------------------------------------------
+    def visit_With(self, node: ast.With) -> None:
+        is_lock = any("lock" in _dotted(item.context_expr.func
+                                        if isinstance(item.context_expr,
+                                                      ast.Call)
+                                        else item.context_expr).lower()
+                      for item in node.items)
+        if is_lock:
+            self._lock_depth += 1
+            self.generic_visit(node)
+            self._lock_depth -= 1
+        else:
+            self.generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._func_stack.append(node.name)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_Call(self, node: ast.Call) -> None:
+        dotted = _dotted(node.func)
+        self._rule_nns101(node, dotted)
+        if self._lock_depth:
+            self._rule_nns102(node, dotted)
+        self._rule_nns103(node, dotted)
+        self._rule_nns105(node, dotted)
+        self._rule_nns106(node, dotted)
+        self.generic_visit(node)
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        self._rule_nns104(node)
+        self.generic_visit(node)
+
+    # -- rules ---------------------------------------------------------------
+    def _rule_nns101(self, node: ast.Call, dotted: str) -> None:
+        if dotted != "time.time":
+            return
+        if node.lineno in self._wall_lines:
+            return
+        self.emit(
+            "NNS101", node,
+            "time.time() is wall-clock and jumps under NTP steps — use "
+            "time.monotonic() for durations and deadlines",
+            hint="if this really is an export timestamp, bind it to a "
+                 "wall*-prefixed name or add a justified pragma")
+
+    def _rule_nns102(self, node: ast.Call, dotted: str) -> None:
+        blocking: Optional[str] = None
+        if dotted == "time.sleep":
+            blocking = "time.sleep"
+        elif isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            if attr == "join" and self._looks_like_thread_join(node):
+                blocking = "thread join"
+            elif attr in _SOCKET_BLOCKING:
+                blocking = f"socket .{attr}()"
+        if blocking:
+            self.emit(
+                "NNS102", node,
+                f"{blocking} while holding a lock — every other waiter "
+                f"stalls behind this call",
+                hint="copy state under the lock, block outside it")
+
+    @staticmethod
+    def _looks_like_thread_join(node: ast.Call) -> bool:
+        """Disambiguate Thread.join from str.join: a thread join takes
+        no args, a timeout kwarg, or a single numeric positional."""
+        if any(kw.arg == "timeout" for kw in node.keywords):
+            return True
+        if not node.args and not node.keywords:
+            return True
+        if len(node.args) == 1 and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, (int, float)) \
+                and not isinstance(node.args[0].value, bool):
+            return True
+        return False
+
+    def _rule_nns103(self, node: ast.Call, dotted: str) -> None:
+        if dotted != "print":
+            return
+        if self.path.name == "cli.py" or "main" in self._func_stack:
+            return
+        self.emit(
+            "NNS103", node,
+            "print() in library code bypasses the logging pipeline",
+            hint="use nnstreamer_tpu.utils.log (or move this into a CLI "
+                 "main())")
+
+    def _rule_nns104(self, node: ast.ExceptHandler) -> None:
+        if node.type is None:
+            self.emit(
+                "NNS104", node,
+                "bare 'except:' also catches KeyboardInterrupt/SystemExit",
+                hint="name the exception type (Exception at the broadest)")
+            return
+        names = [_dotted(node.type)]
+        if isinstance(node.type, ast.Tuple):
+            names = [_dotted(e) for e in node.type.elts]
+        broad = any(n in ("Exception", "BaseException") for n in names)
+        body_is_pass = all(isinstance(s, ast.Pass) for s in node.body)
+        if broad and body_is_pass:
+            self.emit(
+                "NNS104", node,
+                "'except Exception: pass' silently swallows every bug",
+                hint="log the exception, narrow the type, or justify "
+                     "with a pragma")
+
+    def _rule_nns105(self, node: ast.Call, dotted: str) -> None:
+        if dotted not in ("threading.Thread", "Thread"):
+            return
+        if any(kw.arg == "daemon" for kw in node.keywords):
+            return
+        self.emit(
+            "NNS105", node,
+            "Thread without an explicit daemon= choice — shutdown "
+            "behavior becomes an accident of the spawning thread",
+            hint="pass daemon=True (reaped at exit) or daemon=False "
+                 "(must be joined), whichever you actually mean")
+
+    def _rule_nns106(self, node: ast.Call, dotted: str) -> None:
+        if not isinstance(node.func, ast.Attribute) or \
+                node.func.attr not in _METRIC_METHODS:
+            return
+        if not node.args:
+            return
+        first = node.args[0]
+        if not (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)):
+            return
+        name = first.value
+        if not _METRIC_NAME_RE.match(name):
+            self.emit(
+                "NNS106", first,
+                f"metric name {name!r} violates the nns_<subsystem>_... "
+                f"convention",
+                hint="lowercase, nns_ prefix, >=2 more _-separated parts")
+
+
+def lint_source(text: str, rel: str,
+                path: Optional[Path] = None) -> List[Diagnostic]:
+    """Lint one Python source string. ``rel`` is the reported source
+    label; ``path`` (if given) only feeds the cli.py filename check."""
+    path = path or Path(rel)
+    try:
+        tree = ast.parse(text)
+    except SyntaxError as e:
+        return [Diagnostic("NNS104", ERROR,
+                           Location(rel, e.lineno or 1,
+                                    (e.offset or 1)),
+                           f"file does not parse: {e.msg}")]
+    linter = _FileLinter(path, tree, text, rel)
+    linter.visit(tree)
+    suppressed, missing_reason = _parse_pragmas(text)
+    diags = [d for d in linter.diags
+             if d.code not in suppressed.get(d.loc.line, set())]
+    for lineno in missing_reason:
+        diags.append(Diagnostic(
+            "NNS199", ERROR, Location(rel, lineno, 1),
+            "nns-lint pragma without a justification",
+            hint="append ' -- <reason>' explaining why this line is an "
+                 "exception"))
+    return diags
+
+
+def lint_file(path: Path, root: Optional[Path] = None) -> List[Diagnostic]:
+    rel = str(path.relative_to(root)) if root else str(path)
+    return lint_source(path.read_text(encoding="utf-8"), rel, path)
+
+
+def lint_tree(root: Path) -> List[Diagnostic]:
+    """Lint every ``.py`` file under ``root`` (skipping caches)."""
+    diags: List[Diagnostic] = []
+    base = root if root.is_dir() else root.parent
+    files = [root] if root.is_file() else sorted(root.rglob("*.py"))
+    for path in files:
+        if "__pycache__" in path.parts:
+            continue
+        diags.extend(lint_file(path, base.parent))
+    return sort_diagnostics(diags)

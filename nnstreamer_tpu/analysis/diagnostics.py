@@ -1,0 +1,137 @@
+"""Diagnostics model shared by the pipeline verifier and the AST lint.
+
+One value type for every finding (``Diagnostic``: code, severity,
+location, message, hint) so both halves of ``nns-lint`` — the static
+pipeline verifier (``NNS0xx``) and the project-invariant AST rules
+(``NNS1xx``) — render through the same text and JSON writers and gate CI
+through the same exit-code policy. The shape mirrors what compiler-first
+stream checkers emit (one record per finding, machine-readable), which is
+what lets the CI job and ``tests/test_static_gates.py`` consume the same
+output.
+
+JSON schema (documented in ``docs/linting.md``; ``version`` bumps on any
+incompatible change)::
+
+    {"version": 1,
+     "diagnostics": [{"code": "NNS001", "severity": "error",
+                      "message": "...", "hint": "..." | null,
+                      "loc": {"source": "...", "line": 1, "column": 37}}],
+     "summary": {"error": N, "warning": N, "info": N}}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional
+
+ERROR = "error"
+WARNING = "warning"
+INFO = "info"
+
+_SEV_ORDER = {ERROR: 0, WARNING: 1, INFO: 2}
+
+#: every diagnostic code with its one-line meaning — the table rendered in
+#: docs/linting.md. NNS0xx: pipeline-graph findings; NNS1xx: AST rules.
+CODE_TABLE: Dict[str, str] = {
+    # -- graph (static pipeline verifier) ------------------------------------
+    "NNS001": "unknown element factory",
+    "NNS002": "unknown element property",
+    "NNS003": "duplicate element name",
+    "NNS004": "unknown element/pad reference",
+    "NNS005": "empty caps intersection on a link (format mismatch)",
+    "NNS006": "dangling pad (unlinked input, or dropped output)",
+    "NNS007": "cycle in the pipeline graph",
+    "NNS008": "mux/merge sync-policy conflict",
+    "NNS009": "tee fan-out without queue (serialization/deadlock risk)",
+    "NNS010": "leaky queue without drop monitoring",
+    "NNS011": "unknown tensor_filter framework / subplugin",
+    "NNS012": "description syntax error",
+    # -- code (project-invariant AST lint) -----------------------------------
+    "NNS101": "wall-clock time.time() where monotonic is required",
+    "NNS102": "blocking call (sleep/join/socket IO) while holding a lock",
+    "NNS103": "print() in library code (use log.py)",
+    "NNS104": "bare or blind except (silently swallowed broad exception)",
+    "NNS105": "thread created without an explicit daemon= choice",
+    "NNS106": "metric name violates the nns_<subsystem>_ convention",
+    "NNS199": "nns-lint pragma without a justification",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Location:
+    """Where a finding points: a source identifier plus 1-based line and
+    column. For pipeline descriptions ``source`` is the file (or
+    ``<description>``) and ``line`` is 1 unless the description came from
+    a multi-line file."""
+
+    source: str = "<description>"
+    line: int = 1
+    column: int = 1
+
+    def __str__(self):
+        return f"{self.source}:{self.line}:{self.column}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Diagnostic:
+    """One finding: stable code, severity, location, message, fix hint."""
+
+    code: str
+    severity: str          # ERROR | WARNING | INFO
+    loc: Location
+    message: str
+    hint: Optional[str] = None
+
+    def render(self) -> str:
+        out = f"{self.loc}: {self.severity}: {self.code} {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+    def to_json(self) -> dict:
+        return {
+            "code": self.code,
+            "severity": self.severity,
+            "message": self.message,
+            "hint": self.hint,
+            "loc": {"source": self.loc.source, "line": self.loc.line,
+                    "column": self.loc.column},
+        }
+
+
+def sort_diagnostics(diags: List[Diagnostic]) -> List[Diagnostic]:
+    """Stable order: by source, line, column, then severity."""
+    return sorted(diags, key=lambda d: (d.loc.source, d.loc.line,
+                                        d.loc.column,
+                                        _SEV_ORDER.get(d.severity, 9),
+                                        d.code))
+
+
+def summarize(diags: List[Diagnostic]) -> Dict[str, int]:
+    out = {ERROR: 0, WARNING: 0, INFO: 0}
+    for d in diags:
+        out[d.severity] = out.get(d.severity, 0) + 1
+    return out
+
+
+def render_text(diags: List[Diagnostic]) -> str:
+    diags = sort_diagnostics(diags)
+    lines = [d.render() for d in diags]
+    s = summarize(diags)
+    lines.append(f"nns-lint: {s[ERROR]} error(s), {s[WARNING]} warning(s), "
+                 f"{s[INFO]} info")
+    return "\n".join(lines)
+
+
+def render_json(diags: List[Diagnostic]) -> str:
+    diags = sort_diagnostics(diags)
+    return json.dumps(
+        {"version": 1,
+         "diagnostics": [d.to_json() for d in diags],
+         "summary": summarize(diags)},
+        indent=2)
+
+
+def has_errors(diags: List[Diagnostic]) -> bool:
+    return any(d.severity == ERROR for d in diags)

@@ -207,6 +207,9 @@ def main(argv=None) -> int:
     ap.add_argument("-v", "--verbose", action="store_true")
     ap.add_argument("--confchk", action="store_true",
                     help="print effective configuration and exit")
+    ap.add_argument("--check", action="store_true",
+                    help="statically verify the description and exit "
+                         "without running it (same checks as nns-lint)")
     ap.add_argument("--scaffold", nargs=2, metavar=("KIND", "NAME"),
                     help="generate subplugin boilerplate "
                          "(filter|decoder|converter) and exit")
@@ -267,6 +270,15 @@ def main(argv=None) -> int:
     from nnstreamer_tpu.elements.sink import TensorSink
 
     desc = " ".join(args.description)
+    if args.check:
+        from nnstreamer_tpu.analysis.diagnostics import has_errors, \
+            render_text
+        from nnstreamer_tpu.analysis.verify import verify_description
+
+        diags = verify_description(desc)
+        if diags or not args.quiet:
+            print(render_text(diags))
+        return 1 if has_errors(diags) else 0
     try:
         pipe = parse_launch(desc)
     except (ValueError, KeyError) as e:

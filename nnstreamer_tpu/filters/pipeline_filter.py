@@ -26,8 +26,11 @@ import queue
 from typing import Any, List, Optional, Sequence
 
 from nnstreamer_tpu.filters.api import FilterFramework, FilterProperties
+from nnstreamer_tpu.log import get_logger
 from nnstreamer_tpu.registry import FILTER, subplugin
 from nnstreamer_tpu.tensors.types import TensorsInfo
+
+log = get_logger("filters.pipeline")
 
 
 @subplugin(FILTER, "pipeline")
@@ -68,8 +71,8 @@ class PipelineFilter(FilterFramework):
         if self._pipe is not None:
             try:
                 self._src.end_of_stream()
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 — teardown best-effort
+                log.debug("inner pipeline EOS on close failed: %s", e)
             self._pipe.stop()
         self._pipe = self._src = None
         super().close()

@@ -328,7 +328,8 @@ class MetricsRegistry:
             else:
                 entry["value"] = m.value
             metrics.append(entry)
-        return {"ts": time.time(), "metrics": metrics}
+        wall_ts = time.time()  # export timestamp: epoch seconds on the wire
+        return {"ts": wall_ts, "metrics": metrics}
 
     def reset(self) -> None:
         """Drop every metric and collector (test isolation only: live

@@ -54,7 +54,8 @@ class ServerAdvertiser:
                  host: str, port: int):
         self.client = make_broker_client(broker_host, broker_port)
         self.topic = f"{TOPIC_PREFIX}{operation}/{host}:{port}"
-        self.endpoint = {"host": host, "port": port, "ts": time.time()}
+        wall_ts = time.time()  # advertised epoch timestamp, read by peers
+        self.endpoint = {"host": host, "port": port, "ts": wall_ts}
 
     def publish(self) -> None:
         self.client.publish(self.topic,

@@ -205,7 +205,7 @@ class TensorServiceClient:
     def __del__(self):  # best-effort channel cleanup
         try:
             self._channel.close()
-        except Exception:  # noqa: BLE001
+        except Exception:  # nns-lint: disable=NNS104 -- __del__ at interpreter teardown; even logging can fail here
             pass
 
     def wait_ready(self, timeout: float = 10.0):

@@ -434,11 +434,11 @@ class MqttClient:
                     for filt, _cb, qos in subs:
                         self._pid = self._pid % 0xFFFF + 1
                         self._resub_pids[self._pid] = filt
-                        sock.sendall(subscribe_packet(self._pid, filt,
+                        sock.sendall(subscribe_packet(self._pid, filt,  # nns-lint: disable=NNS102 -- this lock exists to serialize writes to this very socket
                                                       qos=qos))
                     for pid, (topic, payload, retain,
                               *_rest) in unacked:
-                        sock.sendall(publish_packet(topic, payload, retain,
+                        sock.sendall(publish_packet(topic, payload, retain,  # nns-lint: disable=NNS102 -- this lock exists to serialize writes to this very socket
                                                     qos=1, packet_id=pid,
                                                     dup=True))
                 except OSError:
@@ -507,7 +507,7 @@ class MqttClient:
                         continue
                     entry[4] += 1
                     try:
-                        self._sock.sendall(publish_packet(
+                        self._sock.sendall(publish_packet(  # nns-lint: disable=NNS102 -- this lock exists to serialize writes to this very socket
                             entry[0], entry[1], entry[2], qos=1,
                             packet_id=pid, dup=True))
                     except OSError:
@@ -522,7 +522,7 @@ class MqttClient:
         loop retransmits (DUP) each tick until PUBACK."""
         if qos == 0:
             with self._lock:
-                self._sock.sendall(publish_packet(topic, payload, retain))
+                self._sock.sendall(publish_packet(topic, payload, retain))  # nns-lint: disable=NNS102 -- this lock exists to serialize writes to this very socket
             return
         if qos != 1:
             raise ValueError("mqtt: only QoS 0/1 supported")
@@ -541,7 +541,7 @@ class MqttClient:
             pid = self._pid
             entry = [topic, payload, retain, evt, 0, "pending"]
             self._unacked[pid] = entry
-            self._sock.sendall(publish_packet(topic, payload, retain,
+            self._sock.sendall(publish_packet(topic, payload, retain,  # nns-lint: disable=NNS102 -- this lock exists to serialize writes to this very socket
                                               qos=1, packet_id=pid))
         if timeout is not None:
             deadline = time.monotonic() + timeout
@@ -562,7 +562,7 @@ class MqttClient:
                     # bandwidth here too
                     if pid in self._unacked:
                         try:  # retransmit with DUP while waiting
-                            self._sock.sendall(publish_packet(
+                            self._sock.sendall(publish_packet(  # nns-lint: disable=NNS102 -- this lock exists to serialize writes to this very socket
                                 topic, payload, retain, qos=1,
                                 packet_id=pid, dup=True))
                         except OSError:
@@ -584,7 +584,7 @@ class MqttClient:
             pid = self._pid
             self._subs.append((topic_filter, cb, qos))
             self._pending_subacks[pid] = (evt, slot, topic_filter)
-            self._sock.sendall(subscribe_packet(pid, topic_filter,
+            self._sock.sendall(subscribe_packet(pid, topic_filter,  # nns-lint: disable=NNS102 -- this lock exists to serialize writes to this very socket
                                                 qos=qos))
         try:
             if not evt.wait(timeout):
@@ -617,7 +617,7 @@ class MqttClient:
                         parse_publish(flags, body)
                     if qos and pid is not None:
                         with self._lock:
-                            self._sock.sendall(puback_packet(pid))
+                            self._sock.sendall(puback_packet(pid))  # nns-lint: disable=NNS102 -- this lock exists to serialize writes to this very socket
                     for pattern, cb, _q in list(self._subs):
                         if topic_matches(pattern, topic):
                             try:
@@ -659,7 +659,7 @@ class MqttClient:
                     self._pong_at = time.monotonic()
                 elif ptype == PINGREQ:
                     with self._lock:
-                        self._sock.sendall(pingresp_packet())
+                        self._sock.sendall(pingresp_packet())  # nns-lint: disable=NNS102 -- this lock exists to serialize writes to this very socket
             except Exception as e:  # noqa: BLE001 — malformed peer bytes
                 # framing state is unreliable past a parse error: fail the
                 # connection so pollers of `failed` see it, don't hang
@@ -671,14 +671,14 @@ class MqttClient:
     def ping(self) -> None:
         with self._lock:
             self._ping_at = time.monotonic()
-            self._sock.sendall(pingreq_packet())
+            self._sock.sendall(pingreq_packet())  # nns-lint: disable=NNS102 -- this lock exists to serialize writes to this very socket
 
     def close(self) -> None:
         self._alive = False
         self._stop_evt.set()
         try:
             with self._lock:
-                self._sock.sendall(disconnect_packet())
+                self._sock.sendall(disconnect_packet())  # nns-lint: disable=NNS102 -- this lock exists to serialize writes to this very socket
         except OSError:
             pass
         try:
@@ -735,7 +735,7 @@ class MqttBroker:
             sock.sendall(data)  # pre-registration (CONNACK): single-owner
             return
         with wlock:
-            sock.sendall(data)
+            sock.sendall(data)  # nns-lint: disable=NNS102 -- this lock exists to serialize writes to this very socket
 
     def _retx_loop(self):
         while self._alive:

@@ -42,6 +42,7 @@ serversrc trusts its configured caps.
 
 from __future__ import annotations
 
+import queue
 import socket
 import struct
 import time
@@ -390,7 +391,7 @@ class RefWireQueryServer:
                 pass
         try:
             self.incoming.put_nowait(None)
-        except Exception:  # noqa: BLE001 — queue full: consumer not blocked
+        except queue.Full:  # consumer is not blocked on us; nothing to do
             pass
 
     # -- src port ----------------------------------------------------------
@@ -437,8 +438,8 @@ class RefWireQueryServer:
             if TensorsConfig.from_caps(server).is_equal(
                     TensorsConfig.from_caps(client)):
                 return True
-        except Exception:  # noqa: BLE001 — not tensor caps on one side
-            pass
+        except (ValueError, KeyError, TypeError):
+            pass  # not tensor caps on one side; fall back to intersect
         return server.intersect(client) is not None
 
     def _src_loop(self, cid: int, conn: socket.socket):

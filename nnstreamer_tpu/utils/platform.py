@@ -45,7 +45,15 @@ def _probe_cache_get(preset: str) -> Optional[dict]:
         ttl = DEFAULT_PROBE_CACHE_TTL
     path = _probe_cache_path(preset)
     try:
-        if time.time() - os.stat(path).st_mtime > ttl:
+        # st_mtime is wall-clock, so the freshness check must be too —
+        # the cache file outlives the process, and no monotonic epoch
+        # spans process restarts. This is the documented exception to
+        # the monotonic-clock rule (NNS101). A negative age means the
+        # clock was stepped backwards since the file was written; the
+        # file is then arbitrarily old in real time, so treat it as
+        # stale instead of trusting it for another full TTL.
+        wall_age = time.time() - os.stat(path).st_mtime
+        if not 0 <= wall_age <= ttl:
             return None
         with open(path) as f:
             entry = json.load(f)

@@ -1,0 +1,384 @@
+"""nns-lint: static pipeline verifier + project AST lint.
+
+Covers both halves of nnstreamer_tpu/analysis/ — the NNS0xx graph
+diagnostics produced without constructing any runtime state, the NNS1xx
+AST rules with pragma suppression, description extraction from shipped
+files, the CLI contract (exit codes, JSON schema), positional parse
+errors, and the Pipeline.verify() pre-flight.
+"""
+
+import json
+
+import pytest
+
+from nnstreamer_tpu.analysis import (
+    CODE_TABLE,
+    ERROR,
+    WARNING,
+    lint_source,
+    verify_description,
+)
+from nnstreamer_tpu.analysis.extract import (
+    extract_from_markdown,
+    extract_from_python,
+)
+from nnstreamer_tpu.pipeline.parse import ParseError, parse_launch
+
+
+def codes(diags):
+    return [d.code for d in diags]
+
+
+def by_code(diags, code):
+    return [d for d in diags if d.code == code]
+
+
+class TestVerifierGraph:
+    def test_clean_pipeline_no_diagnostics(self):
+        diags = verify_description(
+            "videotestsrc num-buffers=4 ! tensor_converter ! "
+            "tensor_filter framework=auto model=m.tflite ! tensor_sink")
+        assert diags == []
+
+    def test_unknown_factory_with_suggestion(self):
+        diags = verify_description("videotestsrc ! tensor_convertr "
+                                   "! tensor_sink")
+        errs = by_code(diags, "NNS001")
+        assert errs and errs[0].severity == ERROR
+        assert "tensor_convertr" in errs[0].message
+        assert "tensor_converter" in (errs[0].hint or "")
+
+    def test_unknown_property_names_known_ones(self):
+        diags = verify_description("videotestsrc ! fakesink bogus=1")
+        errs = by_code(diags, "NNS002")
+        assert errs and "bogus" in errs[0].message
+        assert "sync" in (errs[0].hint or "")
+
+    def test_duplicate_name(self):
+        diags = verify_description(
+            "videotestsrc name=a ! fakesink videotestsrc name=a "
+            "! fakesink")
+        assert by_code(diags, "NNS003")
+
+    def test_unknown_reference(self):
+        diags = verify_description("videotestsrc ! tee name=t "
+                                   "nosuch. ! fakesink")
+        errs = by_code(diags, "NNS004")
+        assert errs and "nosuch" in errs[0].message
+
+    def test_sink_pad_exhaustion(self):
+        # fakesink has exactly one sink pad; a second feed must be
+        # rejected statically, same as parse_launch would at build time
+        diags = verify_description(
+            "videotestsrc ! fakesink name=s videotestsrc ! s.")
+        errs = by_code(diags, "NNS004")
+        assert errs and "no free sink pad" in errs[0].message
+
+    def test_media_type_mismatch_suggests_converter(self):
+        diags = verify_description(
+            "videotestsrc ! tensor_filter framework=auto ! fakesink")
+        errs = by_code(diags, "NNS005")
+        assert errs and "video/x-raw" in errs[0].message
+        assert "tensor_converter" in (errs[0].hint or "")
+
+    def test_capsfilter_empty_intersection(self):
+        diags = verify_description(
+            "videotestsrc format=RGB ! video/x-raw,format=GRAY8 "
+            "! fakesink")
+        assert by_code(diags, "NNS005")
+
+    def test_capsfilter_compatible_is_clean(self):
+        diags = verify_description(
+            "videotestsrc format=RGB ! video/x-raw,format=RGB "
+            "! fakesink")
+        assert by_code(diags, "NNS005") == []
+
+    def test_unlinked_sink_is_error(self):
+        diags = verify_description("queue ! fakesink")
+        errs = by_code(diags, "NNS006")
+        assert any(d.severity == ERROR and "never linked" in d.message
+                   for d in errs)
+
+    def test_implied_mux_pads_unfed(self):
+        # m.sink_2 implies sink_0/sink_1 exist too; a sync policy would
+        # wait on them forever — parse_launch rejects this at build time
+        diags = verify_description(
+            "videotestsrc ! tensor_converter ! m.sink_2 "
+            "tensor_mux name=m ! fakesink")
+        errs = by_code(diags, "NNS006")
+        assert any(d.severity == ERROR and "implied" in d.message
+                   for d in errs)
+
+    def test_dropped_output_is_warning(self):
+        diags = verify_description(
+            "videotestsrc ! tensor_converter")
+        warns = by_code(diags, "NNS006")
+        assert any(d.severity == WARNING and "dropped" in d.message
+                   for d in warns)
+
+    def test_cycle_detected(self):
+        diags = verify_description(
+            "tensor_mux name=m sync-mode=nosync ! "
+            "tensor_transform name=t ! m.sink_1")
+        errs = by_code(diags, "NNS007")
+        assert errs and "cycle" in errs[0].message.lower()
+
+    def test_sync_mode_unknown(self):
+        diags = verify_description(
+            "tensor_mux name=m sync-mode=bogus ! fakesink "
+            "videotestsrc ! tensor_converter ! m.sink_0")
+        errs = by_code(diags, "NNS008")
+        assert errs and errs[0].severity == ERROR
+
+    def test_sync_option_ignored_warns(self):
+        diags = verify_description(
+            "tensor_mux name=m sync-mode=slowest sync-option=1:33 "
+            "! fakesink videotestsrc ! tensor_converter ! m.sink_0")
+        warns = by_code(diags, "NNS008")
+        assert warns and warns[0].severity == WARNING
+
+    def test_basepad_option_malformed(self):
+        diags = verify_description(
+            "tensor_mux name=m sync-mode=basepad sync-option=oops "
+            "! fakesink videotestsrc ! tensor_converter ! m.sink_0")
+        errs = by_code(diags, "NNS008")
+        assert errs and errs[0].severity == ERROR
+
+    def test_tee_branch_without_queue(self):
+        diags = verify_description(
+            "videotestsrc ! tee name=t t. ! fakesink t. ! "
+            "queue ! fakesink")
+        warns = by_code(diags, "NNS009")
+        # exactly the queue-less branch is named
+        assert len(warns) == 1 and "fakesink" in warns[0].message
+
+    def test_leaky_queue_without_name(self):
+        diags = verify_description(
+            "videotestsrc ! queue leaky=downstream ! fakesink")
+        assert by_code(diags, "NNS010")
+        named = verify_description(
+            "videotestsrc ! queue name=q leaky=downstream ! fakesink")
+        assert by_code(named, "NNS010") == []
+
+    def test_unknown_framework_is_error(self):
+        # the acceptance pipeline from the issue: exits non-zero with an
+        # NNS0xx code naming the bad element
+        diags = verify_description(
+            "videotestsrc ! tensor_converter ! tensor_filter "
+            "framework=bogus")
+        errs = by_code(diags, "NNS011")
+        assert errs and errs[0].severity == ERROR
+        assert "bogus" in errs[0].message
+
+    def test_unknown_decoder_mode(self):
+        diags = verify_description(
+            "videotestsrc ! tensor_converter ! tensor_decoder "
+            "mode=nope ! fakesink")
+        assert by_code(diags, "NNS011")
+
+    def test_syntax_error_carries_column(self):
+        diags = verify_description('videotestsrc ! "unterminated')
+        errs = by_code(diags, "NNS012")
+        assert errs and errs[0].loc.column > 1
+
+    def test_every_emitted_code_is_documented(self):
+        # any diagnostic the verifier can emit has a CODE_TABLE row
+        # (docs/linting.md renders from the same table)
+        assert {"NNS001", "NNS005", "NNS011", "NNS101",
+                "NNS199"} <= set(CODE_TABLE)
+
+
+class TestParsePositionalErrors:
+    def test_unknown_element_reports_column(self):
+        desc = "videotestsrc ! bogus_element ! fakesink"
+        with pytest.raises(ParseError) as ei:
+            parse_launch(desc)
+        assert ei.value.pos == desc.index("bogus_element")
+        assert "column" in str(ei.value)
+
+    def test_unknown_property_reports_column(self):
+        desc = "videotestsrc ! fakesink nope=1"
+        with pytest.raises(ParseError) as ei:
+            parse_launch(desc)
+        assert ei.value.pos == desc.index("nope=1")
+
+    def test_unterminated_quote_reports_column(self):
+        with pytest.raises(ParseError) as ei:
+            parse_launch('videotestsrc ! fakesink name="x')
+        assert ei.value.pos is not None
+
+
+class TestAstLint:
+    def test_nns101_wall_clock(self):
+        diags = lint_source("import time\nd = time.time()\n", "x.py")
+        assert codes(diags) == ["NNS101"]
+
+    def test_nns101_wall_binding_allowed(self):
+        diags = lint_source("import time\nwall_ts = time.time()\n",
+                            "x.py")
+        assert diags == []
+
+    def test_nns102_sleep_under_lock(self):
+        src = ("import threading, time\n"
+               "lock = threading.Lock()\n"
+               "def f():\n"
+               "    with lock:\n"
+               "        time.sleep(1)\n")
+        assert "NNS102" in codes(lint_source(src, "x.py"))
+
+    def test_nns102_thread_join_vs_str_join(self):
+        src = ("def f(self):\n"
+               "    with self._lock:\n"
+               "        self._t.join(timeout=1)\n"
+               "        s = ','.join(['a'])\n")
+        diags = by_code(lint_source(src, "x.py"), "NNS102")
+        assert len(diags) == 1  # the thread join, not the str join
+
+    def test_nns102_outside_lock_ok(self):
+        src = "import time\ndef f():\n    time.sleep(1)\n"
+        assert by_code(lint_source(src, "x.py"), "NNS102") == []
+
+    def test_nns103_print_in_library(self):
+        assert "NNS103" in codes(
+            lint_source("def f():\n    print('x')\n", "lib.py"))
+
+    def test_nns103_print_in_main_ok(self):
+        assert by_code(lint_source(
+            "def main():\n    print('x')\n", "lib.py"), "NNS103") == []
+
+    def test_nns104_bare_except(self):
+        src = "try:\n    f()\nexcept:\n    pass\n"
+        assert "NNS104" in codes(lint_source(src, "x.py"))
+
+    def test_nns104_blind_swallow(self):
+        src = "try:\n    f()\nexcept Exception:\n    pass\n"
+        assert "NNS104" in codes(lint_source(src, "x.py"))
+
+    def test_nns104_logged_broad_except_ok(self):
+        src = ("try:\n    f()\nexcept Exception as e:\n"
+               "    log.debug('%s', e)\n")
+        assert by_code(lint_source(src, "x.py"), "NNS104") == []
+
+    def test_nns105_thread_without_daemon(self):
+        src = "import threading\nt = threading.Thread(target=f)\n"
+        assert "NNS105" in codes(lint_source(src, "x.py"))
+        ok = ("import threading\n"
+              "t = threading.Thread(target=f, daemon=True)\n")
+        assert by_code(lint_source(ok, "x.py"), "NNS105") == []
+
+    def test_nns106_metric_naming(self):
+        src = "c = reg.counter('queue_drops')\n"
+        assert "NNS106" in codes(lint_source(src, "x.py"))
+        ok = "c = reg.counter('nns_queue_drops_total')\n"
+        assert by_code(lint_source(ok, "x.py"), "NNS106") == []
+
+    def test_pragma_suppresses_with_reason(self):
+        src = ("import time\n"
+               "d = time.time()  # nns-lint: disable=NNS101 -- epoch "
+               "for the wire\n")
+        assert lint_source(src, "x.py") == []
+
+    def test_pragma_without_reason_is_nns199(self):
+        src = ("import time\n"
+               "d = time.time()  # nns-lint: disable=NNS101\n")
+        assert codes(lint_source(src, "x.py")) == ["NNS199"]
+
+
+class TestExtract:
+    def test_python_literal_and_fstring(self):
+        src = ("from nnstreamer_tpu import parse_launch\n"
+               "p = parse_launch('videotestsrc ! fakesink')\n"
+               "q = parse_launch(f'videotestsrc num-buffers={n} "
+               "! fakesink')\n"
+               "r = parse_launch('videotestsrc ! ... ! fakesink')\n")
+        snips = extract_from_python(src, "x.py")
+        assert len(snips) == 2  # the '...' placeholder is skipped
+        assert snips[0].description == "videotestsrc ! fakesink"
+        assert "num-buffers=0" in snips[1].description
+
+    def test_markdown_fences(self):
+        md = ("# Doc\n"
+              "```bash\n"
+              'nns-launch "videotestsrc ! fakesink"\n'
+              "```\n"
+              "```python\n"
+              "parse_launch('audiotestsrc ! fakesink')\n"
+              "```\n"
+              "```bash\n"
+              'nns-launch "videotestsrc ! ... ! fakesink"\n'
+              "```\n")
+        snips = extract_from_markdown(md, "doc.md")
+        assert [s.description for s in snips] == [
+            "videotestsrc ! fakesink", "audiotestsrc ! fakesink"]
+        assert snips[0].line == 3
+
+
+class TestCli:
+    def test_error_exits_nonzero(self, capsys):
+        from nnstreamer_tpu.analysis.cli import main
+
+        rc = main(["videotestsrc ! tensor_converter ! tensor_filter "
+                   "framework=bogus"])
+        assert rc == 1
+        assert "NNS011" in capsys.readouterr().out
+
+    def test_clean_exits_zero(self, capsys):
+        from nnstreamer_tpu.analysis.cli import main
+
+        assert main(["videotestsrc ! tensor_converter ! tensor_sink"]) \
+            == 0
+
+    def test_usage_error_exits_two(self, capsys):
+        from nnstreamer_tpu.analysis.cli import main
+
+        assert main([]) == 2
+
+    def test_json_schema(self, capsys):
+        from nnstreamer_tpu.analysis.cli import main
+
+        rc = main(["--format", "json",
+                   "videotestsrc ! tensor_converter ! tensor_filter "
+                   "framework=bogus"])
+        assert rc == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["version"] == 1
+        assert set(doc["summary"]) == {"error", "warning", "info"}
+        assert doc["summary"]["error"] >= 1
+        d = doc["diagnostics"][0]
+        assert set(d) == {"code", "severity", "message", "hint", "loc"}
+        assert set(d["loc"]) == {"source", "line", "column"}
+        assert all(x["code"] in CODE_TABLE for x in doc["diagnostics"])
+
+    def test_strict_fails_on_warnings(self, capsys):
+        from nnstreamer_tpu.analysis.cli import main
+
+        desc = ("videotestsrc ! tee name=t t. ! tensor_sink t. ! "
+                "tensor_sink")
+        assert main([desc]) == 0          # warnings only
+        assert main(["--strict", desc]) == 1
+
+    def test_launch_check_flag(self, capsys):
+        from nnstreamer_tpu.cli import main as launch_main
+
+        assert launch_main(
+            ["--check", "videotestsrc ! tensor_converter ! "
+             "tensor_filter framework=bogus"]) == 1
+        assert launch_main(
+            ["--check", "videotestsrc num-buffers=2 ! "
+             "tensor_converter ! tensor_sink"]) == 0
+
+
+class TestPipelineVerify:
+    def test_parsed_pipeline_verifies_clean(self):
+        pipe = parse_launch("videotestsrc num-buffers=2 ! "
+                            "tensor_converter ! tensor_sink")
+        assert pipe.verify() == []
+
+    def test_programmatic_dangling_sink(self):
+        from nnstreamer_tpu.pipeline.pipeline import Pipeline, Queue
+
+        pipe = Pipeline("p")
+        pipe.add(Queue(name="q"))
+        diags = pipe.verify()
+        assert "NNS006" in [d.code for d in diags]
+        assert any(d.severity == ERROR for d in diags)

@@ -1,0 +1,101 @@
+"""Edge cases of the caps negotiation value types (pipeline/caps.py).
+
+The static verifier (analysis/verify.py) leans on the exact same
+intersection engine runtime negotiation uses, so the degenerate inputs —
+ranges that collapse to a point, ANY against lists, fixation of
+empty-field caps — need pinned behavior.
+"""
+
+from nnstreamer_tpu.pipeline.caps import ANY, Caps, CapsList, IntRange
+
+
+class TestIntRangeDegenerate:
+    def test_point_range_intersect_collapses_to_scalar(self):
+        # lo == hi is a single admissible value: intersecting with a
+        # range that covers it must yield the scalar, not IntRange(5, 5)
+        assert IntRange(5, 5).intersect(IntRange(0, 10)) == 5
+        assert IntRange(0, 10).intersect(IntRange(5, 5)) == 5
+
+    def test_point_range_intersect_point_range(self):
+        assert IntRange(7, 7).intersect(IntRange(7, 7)) == 7
+        assert IntRange(7, 7).intersect(IntRange(8, 8)) is None
+
+    def test_point_range_vs_scalar(self):
+        assert IntRange(5, 5).intersect(5) == 5
+        assert IntRange(5, 5).intersect(6) is None
+
+    def test_touching_ranges_collapse(self):
+        # [0,5] ∩ [5,9] touches at exactly one value
+        assert IntRange(0, 5).intersect(IntRange(5, 9)) == 5
+
+    def test_point_range_contains(self):
+        assert 5 in IntRange(5, 5)
+        assert 4 not in IntRange(5, 5)
+
+    def test_point_range_in_caps_field(self):
+        a = Caps("other/tensors", {"num_tensors": IntRange(2, 2)})
+        b = Caps("other/tensors", {"num_tensors": IntRange(1, 4)})
+        merged = a.intersect(b)
+        assert merged is not None and merged["num_tensors"] == 2
+        assert merged.is_fixed()
+
+
+class TestAnyVsList:
+    def test_any_field_adopts_list(self):
+        a = Caps("video/x-raw", {"format": ANY})
+        b = Caps("video/x-raw", {"format": ["RGB", "GRAY8"]})
+        merged = a.intersect(b)
+        assert merged is not None
+        assert merged["format"] == ["RGB", "GRAY8"]
+        # ANY adopted a list -> still not fixed; fixate picks the head
+        assert not merged.is_fixed()
+        assert merged.fixate()["format"] == "RGB"
+
+    def test_list_vs_any_symmetric(self):
+        a = Caps("video/x-raw", {"format": ["RGB", "GRAY8"]})
+        b = Caps("video/x-raw", {"format": ANY})
+        assert a.intersect(b)["format"] == ["RGB", "GRAY8"]
+
+    def test_any_capslist_vs_concrete(self):
+        # CapsList.any() (unconstrained pad) adopts the other side whole;
+        # distinct from an empty CapsList (failed negotiation)
+        concrete = CapsList([Caps("other/tensors", {"num_tensors": 1})])
+        merged = CapsList.any().intersect(concrete)
+        assert not merged.is_empty()
+        assert merged.first() == concrete.first()
+        assert CapsList.any().intersect(CapsList.any()).is_any()
+        assert not CapsList([], _any=False).intersect(concrete).is_any()
+        assert CapsList([], _any=False).intersect(concrete).is_empty()
+
+    def test_single_common_element_collapses(self):
+        a = Caps("video/x-raw", {"format": ["RGB", "BGR"]})
+        b = Caps("video/x-raw", {"format": ["GRAY8", "RGB"]})
+        assert a.intersect(b)["format"] == "RGB"
+
+    def test_disjoint_lists_empty(self):
+        a = Caps("video/x-raw", {"format": ["RGB"]})
+        b = Caps("video/x-raw", {"format": ["GRAY8"]})
+        assert a.intersect(b) is None
+
+
+class TestFixateEmptyFields:
+    def test_fixate_no_fields_is_identity(self):
+        c = Caps("other/tensors")
+        fixed = c.fixate()
+        assert fixed == c
+        assert fixed.is_fixed()  # vacuously fixed: nothing unconstrained
+
+    def test_fixate_drops_any_fields(self):
+        c = Caps("other/tensors", {"format": ANY, "num_tensors": 2})
+        fixed = c.fixate()
+        assert "format" not in fixed
+        assert fixed["num_tensors"] == 2
+        assert fixed.is_fixed()
+
+    def test_fixate_all_any_yields_empty_fields(self):
+        c = Caps("other/tensors", {"format": ANY, "framerate": ANY})
+        assert c.fixate().fields == {}
+
+    def test_fixate_point_range(self):
+        c = Caps("other/tensors", {"num_tensors": IntRange(3, 3)})
+        assert c.fixate()["num_tensors"] == 3

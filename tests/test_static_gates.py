@@ -9,7 +9,12 @@ pytest so `python -m pytest tests/` IS the CI:
 - every module and public element/builder carries a docstring (the
   doxygen-tag audit);
 - no stray debugging artifacts (pdb traces, print() in the hot paths of
-  library code — logging goes through log.py).
+  library code — logging goes through log.py);
+- the project's own static analyzer comes back clean: ``nns-lint --self``
+  (monotonic clocks, no blocking under locks, explicit thread daemonism,
+  metric naming — docs/linting.md) reports zero findings, and every
+  pipeline description shipped in examples/ and the docs passes the
+  static verifier with no error-severity diagnostics.
 """
 
 import ast
@@ -80,3 +85,37 @@ def test_no_stray_prints_in_library_code():
         for lineno in _print_calls(ast.parse(path.read_text())):
             offenders.append(f"{path}:{lineno}")
     assert not offenders, offenders
+
+
+def test_self_lint_clean():
+    """`nns-lint --self` gate: the NNS1xx AST rules report nothing on
+    the package itself (any deliberate exception carries a justified
+    pragma, which the linter verifies via NNS199)."""
+    from nnstreamer_tpu.analysis.astlint import lint_tree
+
+    diags = lint_tree(PKG)
+    assert not diags, "\n".join(d.render() for d in diags)
+
+
+def test_shipped_pipelines_verify():
+    """Every pipeline description shipped in examples/ and the
+    getting-started doc passes the static verifier with no
+    error-severity diagnostics (warnings are allowed — e.g. the
+    recurrence examples tee into a reposink without a queue, which is
+    deliberate)."""
+    from nnstreamer_tpu.analysis.diagnostics import ERROR
+    from nnstreamer_tpu.analysis.extract import extract_from_file
+    from nnstreamer_tpu.analysis.verify import verify_description
+
+    root = PKG.parent
+    targets = sorted((root / "examples").glob("*.py"))
+    targets.append(root / "docs" / "getting-started.md")
+    snippets = [s for t in targets for s in extract_from_file(t)]
+    assert len(snippets) >= 5  # the extractor actually found the demos
+    errors = []
+    for snip in snippets:
+        for d in verify_description(snip.description,
+                                    source=f"{snip.source}:{snip.line}"):
+            if d.severity == ERROR:
+                errors.append(d.render())
+    assert not errors, "\n".join(errors)
