@@ -18,6 +18,11 @@ These rules encode invariants this codebase has already been burned by
   accident.
 - NNS106: metric names must follow ``nns_<subsystem>_...`` so dashboards
   can group by prefix.
+- NNS107: sync-forcing calls (``np.asarray``, ``.block_until_ready()``,
+  ``float(x[...])``) inside per-frame hot paths (``chain`` /
+  ``chain_list`` / ``_chain_locked`` / ``device_stage``) silently
+  collapse the dispatch window (``pipeline/dispatch.py``) back to
+  synchronous dispatch — materialize at the fence or sink instead.
 
 Findings are suppressed per-line with::
 
@@ -50,6 +55,15 @@ _METRIC_NAME_RE = re.compile(r"^nns_[a-z0-9]+(_[a-z0-9]+)+$")
 #: socket methods that block on the network
 _SOCKET_BLOCKING = {"recv", "recvfrom", "recv_into", "accept", "connect",
                     "sendall", "sendto"}
+
+#: sync-forcing callables by dotted name (NNS107): each one blocks the
+#: caller until outstanding device work retires (or copies D2H, which
+#: implies the same)
+_SYNC_CALLS = {"np.asarray", "numpy.asarray", "np.array", "numpy.array",
+               "jax.block_until_ready"}
+#: per-frame hot-path function names where a hidden sync defeats the
+#: inflight dispatch window (pipeline/dispatch.py)
+_HOT_FUNCS = {"chain", "chain_list", "_chain_locked", "device_stage"}
 
 
 def _parse_pragmas(text: str) -> Tuple[Dict[int, Set[str]], List[int]]:
@@ -145,6 +159,7 @@ class _FileLinter(ast.NodeVisitor):
         self._rule_nns103(node, dotted)
         self._rule_nns105(node, dotted)
         self._rule_nns106(node, dotted)
+        self._rule_nns107(node, dotted)
         self.generic_visit(node)
 
     def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
@@ -254,6 +269,31 @@ class _FileLinter(ast.NodeVisitor):
                 f"metric name {name!r} violates the nns_<subsystem>_... "
                 f"convention",
                 hint="lowercase, nns_ prefix, >=2 more _-separated parts")
+
+    def _rule_nns107(self, node: ast.Call, dotted: str) -> None:
+        if not any(f in _HOT_FUNCS for f in self._func_stack):
+            return
+        what: Optional[str] = None
+        if dotted in _SYNC_CALLS:
+            what = f"{dotted}()"
+        elif isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "block_until_ready":
+            what = ".block_until_ready()"
+        elif dotted in ("float", "int") and len(node.args) == 1 and \
+                isinstance(node.args[0], ast.Subscript):
+            # float(out[0]) / int(scores[i]) on a device array blocks on
+            # the whole dispatch to fetch one scalar
+            what = f"{dotted}(x[...])"
+        if what is None:
+            return
+        self.emit(
+            "NNS107", node,
+            f"{what} in a per-frame hot path forces a device sync — the "
+            f"inflight dispatch window silently collapses to synchronous "
+            f"dispatch",
+            hint="materialize at the fence/sink (to_host, "
+                 "materialize-host queue) or justify host-only use with "
+                 "a pragma")
 
 
 def lint_source(text: str, rel: str,

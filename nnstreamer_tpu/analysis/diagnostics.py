@@ -54,6 +54,8 @@ CODE_TABLE: Dict[str, str] = {
     "NNS104": "bare or blind except (silently swallowed broad exception)",
     "NNS105": "thread created without an explicit daemon= choice",
     "NNS106": "metric name violates the nns_<subsystem>_ convention",
+    "NNS107": "sync-forcing call in a per-frame hot path (defeats the "
+              "dispatch window)",
     "NNS199": "nns-lint pragma without a justification",
 }
 

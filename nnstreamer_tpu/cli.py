@@ -236,6 +236,12 @@ def main(argv=None) -> int:
                          "input info")
     ap.add_argument("--inputtype", default=None,
                     help="input types for --export (e.g. float32)")
+    ap.add_argument("--inflight", type=int, default=None, metavar="K",
+                    help="override the dispatch-window depth on every "
+                         "element that has an 'inflight' property "
+                         "(tensor_filter and fused regions); 0 forces "
+                         "fully synchronous dispatch, the default is 2 "
+                         "(see docs/profiling.md, Overlap tuning)")
     args = ap.parse_args(argv)
 
     if args.confchk:
@@ -285,6 +291,11 @@ def main(argv=None) -> int:
         print(f"nns-launch: parse error: {e}", file=sys.stderr)
         return 2
 
+    if args.inflight is not None:
+        for el in pipe.elements:
+            if "inflight" in el._props:
+                el.set_property("inflight", max(0, args.inflight))
+
     if args.verbose:
         for el in pipe.elements:
             if isinstance(el, TensorSink):
@@ -333,7 +344,8 @@ def main(argv=None) -> int:
 def _print_stats(pipe) -> None:
     """Post-EOS per-element table from the metrics snapshot: the
     InvokeStats trio plus drops and end-to-end tail latency."""
-    snap = pipe.metrics_snapshot()["elements"]
+    full = pipe.metrics_snapshot()
+    snap = full["elements"]
     print("-- element stats (latency µs / throughput milli-out/s / "
           "invokes / drops / e2e p50,p99 ms)")
     for el in pipe.elements:
@@ -344,6 +356,11 @@ def _print_stats(pipe) -> None:
         print(f"  {el.name:28s} {s['latency_us']:>8d}  "
               f"{s['throughput_milli']:>10d}  {s['invokes']:>8d}  "
               f"{drops if drops is not None else '-':>6}  {e2e:>12s}")
+    pool = full.get("pool")
+    if pool and (pool["hits"] or pool["misses"]):
+        print(f"-- ingest pool: hit-rate {pool['hit_rate']:.1%} "
+              f"({pool['hits']} hits / {pool['misses']} misses, "
+              f"{pool['outstanding']} outstanding)")
 
 
 if __name__ == "__main__":

@@ -38,6 +38,9 @@ from nnstreamer_tpu.tensors.buffer import TensorBuffer, is_device_array
 @subplugin(ELEMENT, "tensor_aggregator")
 class TensorAggregator(Element):
     ELEMENT_NAME = "tensor_aggregator"
+    #: batch-drain opt-in: a queue backlog arrives as one list, windowed
+    #: under ONE lock acquisition (see chain_list)
+    HANDLES_LIST = True
     PROPERTIES = {
         **Element.PROPERTIES,
         "frames_in": 1,
@@ -110,6 +113,16 @@ class TensorAggregator(Element):
     def chain(self, pad, buf):
         with self._lock:
             return self._chain_locked(pad, buf)
+
+    def chain_list(self, pad, bufs):
+        """Batch-drain fast path: the whole queue backlog windows under
+        one lock acquisition (the flusher thread contends once per
+        backlog instead of once per frame)."""
+        ret = None
+        with self._lock:
+            for b in bufs:
+                ret = self._chain_locked(pad, b)
+        return ret
 
     def _chain_locked(self, pad, buf):
         fin = int(self.get_property("frames_in"))
@@ -234,7 +247,21 @@ class TensorAggregator(Element):
                     import jax.numpy as jnp
 
                     outs.append(jnp.concatenate(chunk, axis=axis))
+                elif all(c.dtype == chunk[0].dtype for c in chunk):
+                    # host windows assemble into a recycled staging
+                    # buffer (tensors/pool.py): at flagship rates this
+                    # concat is the ingest path's one per-window
+                    # allocation, and the pooled buffer recycles once
+                    # the H2D that consumes it fences downstream
+                    from nnstreamer_tpu.tensors.pool import get_pool
+
+                    shape = list(chunk[0].shape)
+                    shape[axis] = sum(c.shape[axis] for c in chunk)
+                    dst = get_pool().acquire(shape, chunk[0].dtype)
+                    np.concatenate(chunk, axis=axis, out=dst)
+                    outs.append(dst)
                 else:
+                    # mixed dtypes promote — let numpy own the result
                     outs.append(np.concatenate(chunk, axis=axis))
             else:
                 # concat=false: collected frames stay separate tensors

@@ -157,7 +157,19 @@ class TensorConverter(Element):
         self._frame_acc.append((frame, buf))
         if len(self._frame_acc) < fpt:
             return None
-        frames = np.stack([f for f, _ in self._frame_acc], axis=0)
+        acc = [f for f, _ in self._frame_acc]
+        if all(f.shape == acc[0].shape and f.dtype == acc[0].dtype
+               for f in acc):
+            # stack into a recycled aligned staging buffer
+            # (tensors/pool.py) — this is the converter's one per-output
+            # host allocation on the batched ingest path
+            from nnstreamer_tpu.tensors.pool import get_pool
+
+            frames = get_pool().acquire((len(acc),) + acc[0].shape,
+                                        acc[0].dtype)
+            np.stack(acc, axis=0, out=frames)
+        else:
+            frames = np.stack(acc, axis=0)
         first = self._frame_acc[0][1]
         self._frame_acc.clear()
         return self._emit(first.with_tensors([frames]))

@@ -86,12 +86,20 @@ class TensorFilter(Element):
         "output_combination": None,
         "shared_tensor_filter_key": None,
         "throttle": 0,            # max invokes/sec; 0 = unthrottled
+        # max device batches outstanding past this filter before the
+        # producer thread fences the oldest (pipeline/dispatch.py):
+        # 2 overlaps host work for frame N+1 with device compute of
+        # frame N; 0 fences every frame (fully synchronous)
+        "inflight": 2,
     }
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self.add_sink_pad("sink")
         self.add_src_pad("src")
+        from nnstreamer_tpu.pipeline.dispatch import DispatchWindow
+
+        self._window = DispatchWindow(self)
         self.fw: Optional[FilterFramework] = None
         self._in_model_info: Optional[TensorsInfo] = None
         self._in_full_info: Optional[TensorsInfo] = None
@@ -135,6 +143,7 @@ class TensorFilter(Element):
                 out["invoke_p50_ms"] = round(h.percentile(50) * 1e3, 3)
                 out["invoke_p99_ms"] = round(h.percentile(99) * 1e3, 3)
             out["qos_drops"] = int(self._m_invoke["qos_drops"].value)
+        out.update(self._window.snapshot())
         return out
 
     def _combination(self, key: str):
@@ -194,10 +203,16 @@ class TensorFilter(Element):
         self._open_fw()
 
     def stop(self):
+        self._window.drain()  # fence outstanding dispatches before the
+        # backend (whose params they read) closes
         if self.fw is not None:
             self.fw.close()
             self.fw = None
         super().stop()
+
+    def handle_eos(self):
+        # EOS flush: fence the whole window before EOS crosses downstream
+        self._window.drain()
 
     # -- negotiation ---------------------------------------------------------
     def transform_caps(self, pad, caps):
@@ -282,9 +297,17 @@ class TensorFilter(Element):
             self._out_model_info = fw.set_input_info(derived)
 
         if not fw.KEEP_ON_DEVICE:
-            model_inputs = [np.asarray(x) if not isinstance(x, np.ndarray)
-                            else x for x in model_inputs]
+            # host-only backend: its invoke() contract IS host arrays, so
+            # this materialization is the backend boundary, not a hidden
+            # fence the dispatch window could have avoided
+            model_inputs = [
+                np.asarray(x)  # nns-lint: disable=NNS107 -- host backend
+                if not isinstance(x, np.ndarray) else x
+                for x in model_inputs]
 
+        from nnstreamer_tpu.pipeline.dispatch import POOL_STASH_META
+
+        stash = buf.meta.pop(POOL_STASH_META, None)
         t0 = _time.monotonic()
         outputs = fw.invoke(model_inputs)
         obs["invoke"].observe(_time.monotonic() - t0)
@@ -295,6 +318,13 @@ class TensorFilter(Element):
                      for k, i in out_comb]
         else:
             final = list(outputs)
+        if stash or any(not isinstance(t, np.ndarray) for t in final):
+            # bounded async dispatch: register the outstanding batch; the
+            # oldest fences only when more than `inflight` are in flight,
+            # and pooled staging inputs recycle at that fence point.
+            # Host-only results with no stash skip the window entirely —
+            # nothing is outstanding for them.
+            self._window.admit(final, stash)
         return self.srcpad.push(buf.with_tensors(final))
 
     # -- region fusion (pipeline/fuse.py) ------------------------------------

@@ -149,10 +149,14 @@ class TensorLMServe(Element):
     def chain(self, pad, buf):
         cid = int(buf.meta.get("query_client_id", 0))
         try:
-            prompt = np.asarray(buf.tensors[0]).reshape(-1).astype(np.int32)
+            # query-wire payloads are host arrays by construction (the
+            # protocol deserializes into numpy) — no device sync here
+            prompt = np.asarray(  # nns-lint: disable=NNS107 -- wire payload
+                buf.tensors[0]).reshape(-1).astype(np.int32)
             max_new = int(self.get_property("max_new_tokens"))
             if len(buf.tensors) > 1:  # budget as payload (survives wire)
-                max_new = int(np.asarray(buf.tensors[1]).reshape(-1)[0])
+                max_new = int(np.asarray(  # nns-lint: disable=NNS107 -- wire
+                    buf.tensors[1]).reshape(-1)[0])
             max_new = int(buf.meta.get("lm_max_new", max_new))
             stream = self._engine.submit(prompt, max_new_tokens=max_new)
             self._enqueue(cid, (stream, buf, None, time.monotonic()))

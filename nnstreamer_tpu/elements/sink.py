@@ -72,6 +72,13 @@ class TensorSink(Element):
         self._callbacks.append(callback)
 
     def chain(self, pad, buf):
+        # pooled host staging arrays riding in meta (queue prefetch-device
+        # stamped them, no dispatch window claimed them): pop the claim
+        # now — released below once materialization proves the device
+        # work that read them is complete, else left to the GC fallback
+        from nnstreamer_tpu.pipeline.dispatch import POOL_STASH_META
+
+        stash = buf.meta.pop(POOL_STASH_META, None)
         # a pending finalize is ALWAYS applied — even with to_host=false —
         # so the app sees the same payload/meta as in an unfused pipeline
         # (with to_host=false the materialization only fetches the deferred
@@ -96,6 +103,12 @@ class TensorSink(Element):
         # only record once the payload is actually host-resident —
         # recording a device handle's arrival would measure dispatch
         # enqueue, not completion (the round-3 bench-honesty rule)
+        if stash and not buf.on_device():
+            # host-materialized output ⇒ the dispatch that consumed the
+            # staging arrays is complete ⇒ safe to recycle them
+            from nnstreamer_tpu.tensors.pool import get_pool
+
+            get_pool().release_many(stash)
         if not buf.on_device():
             now = time.monotonic()
             stamps = buf.create_stamps()
@@ -166,7 +179,14 @@ class FileSink(Element):
         self._fh = open(loc, mode)
 
     def chain(self, pad, buf):
+        from nnstreamer_tpu.pipeline.dispatch import POOL_STASH_META
+
+        stash = buf.meta.pop(POOL_STASH_META, None)
         buf = buf.to_host()
+        if stash:
+            from nnstreamer_tpu.tensors.pool import get_pool
+
+            get_pool().release_many(stash)
         for t in buf.tensors:
             self._fh.write(np.ascontiguousarray(t).tobytes())
         return FlowReturn.OK

@@ -106,7 +106,14 @@ class VideoTestSrc(SourceElement):
             row = np.linspace(0, 255, w, dtype=np.uint8)
             img = np.broadcast_to(row[None, :, None], (h, w, ch)).copy()
         elif pattern == "ball":
-            img = np.zeros((h, w, ch), np.uint8)
+            # the one frame-dependent pattern synthesizes per frame: write
+            # into a recycled aligned staging buffer (tensors/pool.py)
+            # instead of allocating — the slab returns to the pool the
+            # moment the last downstream reference dies
+            from nnstreamer_tpu.tensors.pool import get_pool
+
+            img = get_pool().acquire((h, w, ch), np.uint8)
+            img[:] = 0
             cx = (i * 7) % w
             cy = (i * 5) % h
             y, x = np.ogrid[:h, :w]

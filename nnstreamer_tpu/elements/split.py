@@ -61,7 +61,9 @@ class TensorSplit(Element):
         arr = buf.tensors[0]
         dim_idx = int(self.get_property("dimension"))
         axis = arr.ndim - 1 - dim_idx
-        offsets = np.cumsum([0] + sizes)
+        # plain ints: offsets come from the element's own sizes property,
+        # never from a device array, and slice() takes them directly
+        offsets = np.cumsum([0] + sizes).tolist()
         if offsets[-1] != arr.shape[axis]:
             raise ValueError(
                 f"tensor_split: tensorseg sums to {offsets[-1]} but dim "
@@ -70,7 +72,7 @@ class TensorSplit(Element):
         ret = FlowReturn.OK
         for i, sp in enumerate(self.srcpads[:len(sizes)]):
             sl = [slice(None)] * arr.ndim
-            sl[axis] = slice(int(offsets[i]), int(offsets[i + 1]))
+            sl[axis] = slice(offsets[i], offsets[i + 1])
             part = arr[tuple(sl)]
             if sp.caps is None:
                 from nnstreamer_tpu.tensors.types import TensorsConfig

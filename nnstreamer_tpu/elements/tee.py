@@ -35,6 +35,15 @@ class Tee(Element):
         return downstream
 
     def chain(self, pad, buf):
+        from nnstreamer_tpu.pipeline.dispatch import POOL_STASH_META
+
+        if POOL_STASH_META in buf.meta:
+            # fan-out would duplicate the staging-buffer release claim:
+            # one branch's explicit release could recycle memory another
+            # branch's in-flight device work still reads. Drop the claim
+            # — the pool's GC fallback recycles once every branch is done.
+            buf = buf.replace()
+            buf.meta.pop(POOL_STASH_META, None)
         ret = FlowReturn.OK
         for sp in self.srcpads:
             r = sp.push(buf)

@@ -1,0 +1,133 @@
+"""DispatchWindow — bounded async dispatch per filter / fused region.
+
+XLA dispatch is asynchronous: ``jitted(...)`` returns device handles
+before the device finishes. The pipeline previously consumed that
+asynchrony one frame at a time — the next frame's host work only started
+once the previous frame's downstream chain returned, and any downstream
+materialization point fenced every frame individually, so the device sat
+idle between dispatches (BENCH_r05: flagship at 13.4% of the device
+ceiling). The overlap layer's contract instead allows up to ``inflight=K``
+device batches outstanding per dispatching element: host work for frame
+N+1 proceeds while the device computes frame N, and the producer thread
+only blocks (fences the OLDEST outstanding batch) when the window is full
+— bounded pipelining, same ordering.
+
+The window also owns the staging-buffer recycle point: a pooled host
+array consumed by an H2D transfer (``tensors/pool.py``, carried in
+``meta["pool_stash"]``) must not be rewritten while the transfer or the
+dispatch reading it is in flight. Fencing entry N proves dispatch N
+completed, so its stash is released exactly there.
+
+Instrumented as ``nns_filter_inflight`` (current window occupancy) and
+``nns_filter_fence_wait_seconds`` (time spent blocked in each fence —
+near-zero means the device finishes before the window fills; large means
+the pipeline is device-bound at this element).
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+import weakref
+from typing import Any, Deque, List, Optional, Tuple
+
+from nnstreamer_tpu.tensors.buffer import is_device_array
+
+#: meta key carrying pool-owned host staging arrays whose release is
+#: deferred to the fence point (set by Queue prefetch-device)
+POOL_STASH_META = "pool_stash"
+
+
+class DispatchWindow:
+    """Per-element window of outstanding (dispatched, unfenced) batches.
+
+    Not thread-safe on its own: a window belongs to one element whose
+    chain runs on one streaming thread at a time (the same contract every
+    element's ``chain`` already has).
+    """
+
+    def __init__(self, owner):
+        #: weakly bound: the window must not keep a dead element (and its
+        #: pipeline) alive through the metrics registry
+        self._owner = weakref.ref(owner)
+        self._entries: Deque[Tuple[List[Any], Optional[list]]] = \
+            collections.deque()
+        self._m_fence = None
+        self._gauge_done = False
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def _inflight(self) -> int:
+        owner = self._owner()
+        if owner is None:
+            return 1
+        try:
+            return max(0, int(owner.get_property("inflight")))
+        except (KeyError, TypeError, ValueError):
+            return 2
+
+    def _obs(self):
+        if self._m_fence is None:
+            owner = self._owner()
+            if owner is None:
+                return None
+            from nnstreamer_tpu.obs import get_registry
+
+            reg = get_registry()
+            labels = owner._obs_labels()
+            self._m_fence = reg.histogram(
+                "nns_filter_fence_wait_seconds",
+                "Time blocked fencing the oldest outstanding dispatch "
+                "(window full or EOS)", **labels)
+            if not self._gauge_done:
+                ref = weakref.ref(self)
+                reg.gauge(
+                    "nns_filter_inflight",
+                    "Dispatched device batches currently outstanding",
+                    fn=lambda: (len(ref()) if ref() is not None else 0),
+                    **labels)
+                self._gauge_done = True
+        return self._m_fence
+
+    # -- hot path -----------------------------------------------------------
+    def admit(self, tensors: List[Any],
+              stash: Optional[list] = None) -> None:
+        """Register a just-dispatched batch; fence the oldest entries
+        until at most ``inflight`` remain outstanding."""
+        self._entries.append((list(tensors), stash))
+        limit = self._inflight()
+        while len(self._entries) > limit:
+            self._fence_oldest()
+
+    def _fence_oldest(self) -> None:
+        tensors, stash = self._entries.popleft()
+        hist = self._obs()
+        t0 = time.monotonic()
+        for t in tensors:
+            if is_device_array(t):
+                t.block_until_ready()
+        if hist is not None:
+            hist.observe(time.monotonic() - t0)
+        if stash:
+            # the fenced dispatch (and the H2D feeding it) is complete:
+            # its pooled host staging buffers have no readers left
+            from nnstreamer_tpu.tensors.pool import get_pool
+
+            get_pool().release_many(stash)
+
+    def drain(self) -> None:
+        """Fence everything outstanding (EOS / stop / unsplice)."""
+        while self._entries:
+            self._fence_oldest()
+
+    def snapshot(self) -> dict:
+        out = {"inflight_now": len(self._entries),
+               "inflight_limit": self._inflight()}
+        h = self._m_fence
+        if h is not None and h.count:
+            out["fence_wait_p50_ms"] = round(
+                (h.percentile(50) or 0.0) * 1e3, 3)
+            out["fence_wait_p99_ms"] = round(
+                (h.percentile(99) or 0.0) * 1e3, 3)
+        return out

@@ -142,6 +142,26 @@ class Pad:
             # drop to allow partial pipelines in tests)
         return self.peer.element._chain_entry(self.peer, buf)
 
+    def push_list(self, bufs: List[TensorBuffer]) -> FlowReturn:
+        """Push a backlog of buffers downstream in one hand-off.
+
+        Peers that opt in (``Element.HANDLES_LIST``) receive the whole
+        list through one ``chain_list`` call — the batch-drain fast path
+        (one lock/wake/entry per backlog instead of per frame). Everyone
+        else gets the exact per-buffer push sequence, so opting out is
+        always behavior-preserving."""
+        if self.peer is None:
+            return FlowReturn.OK
+        el = self.peer.element
+        if getattr(el, "HANDLES_LIST", False) and len(bufs) > 1:
+            return el._chain_list_entry(self.peer, bufs)
+        ret = FlowReturn.OK
+        for b in bufs:
+            ret = el._chain_entry(self.peer, b)
+            if ret is FlowReturn.EOS:
+                return ret
+        return ret
+
     def push_event(self, event: Event) -> None:
         if isinstance(event, CapsEvent):
             self.caps = event.caps
@@ -318,6 +338,13 @@ class Element:
     #: see the same payload they would in an unfused pipeline.
     HANDLES_DEFERRED = False
 
+    #: Elements that accept a whole buffer backlog per entry (aggregator,
+    #: fused regions) set this True; a batch-draining queue then hands its
+    #: backlog through ONE ``chain_list`` call instead of a per-buffer
+    #: push sequence. Ordering is identical — the list preserves queue
+    #: order and ``chain_list`` consumes it in order.
+    HANDLES_LIST = False
+
     def _obs_labels(self) -> Dict[str, str]:
         """Stable metric labels: ``{pipeline=..., element=...}`` (the
         ``nns_<element>_<metric>`` naming scheme's label half)."""
@@ -367,6 +394,34 @@ class Element:
             self._obs_chain_hist().observe(now - t0)
         return FlowReturn.OK if ret is None else ret
 
+    def _chain_list_entry(self, pad: Pad,
+                          bufs: List[TensorBuffer]) -> FlowReturn:
+        """Batch twin of :meth:`_chain_entry` (``Pad.push_list`` → here).
+        Same deferred-finalize contract per buffer; stats attribute the
+        batch duration evenly across its buffers so invoke counts and
+        throughput read the same as the per-buffer path."""
+        if pad.eos:
+            return FlowReturn.EOS
+        t0 = _time.monotonic()
+        try:
+            try:
+                if not self.HANDLES_DEFERRED:
+                    bufs = [b.to_host() if b.finalize is not None else b
+                            for b in bufs]
+                ret = self.chain_list(pad, bufs)
+            except FlowError:
+                raise
+            except Exception as e:
+                raise FlowError(f"{self.name}: {e}") from e
+        finally:
+            now = _time.monotonic()
+            per = (now - t0) / max(len(bufs), 1)
+            hist = self._obs_chain_hist()
+            for _ in range(max(len(bufs), 1)):
+                self.stats.record(per, now)
+                hist.observe(per)
+        return FlowReturn.OK if ret is None else ret
+
     def _event_entry(self, pad: Pad, event: Event) -> None:
         if isinstance(event, CapsEvent):
             pad.caps = event.caps
@@ -383,6 +438,18 @@ class Element:
         if self.srcpads:
             return self.srcpad.push(buf)
         return FlowReturn.OK
+
+    def chain_list(self, pad: Pad, bufs: List[TensorBuffer]
+                   ) -> Optional[FlowReturn]:
+        """Process a queue-drained backlog in order. Default: loop
+        :meth:`chain`; HANDLES_LIST elements may override to hoist
+        per-buffer overhead (e.g. one lock acquisition per backlog)."""
+        ret = None
+        for b in bufs:
+            ret = self.chain(pad, b)
+            if ret is FlowReturn.EOS:
+                break
+        return ret
 
     def src_event(self, pad: Pad, event: Event) -> None:
         """Handle an upstream-flowing event arriving on a src pad.

@@ -104,7 +104,11 @@ class FusedRegion(Element):
     """
 
     ELEMENT_NAME = "fused_region"
-    PROPERTIES = {**Element.PROPERTIES}
+    #: a queue feeding a region may hand its whole backlog as one list —
+    #: each buffer dispatches immediately (async), the dispatch window
+    #: paces the batch, so a backlog becomes back-to-back device work
+    HANDLES_LIST = True
+    PROPERTIES = {**Element.PROPERTIES, "inflight": 2}
 
     def __init__(self, members: Sequence[Element], name=None, **props):
         super().__init__(name, **props)
@@ -123,6 +127,18 @@ class FusedRegion(Element):
         self._trace_cache: Optional[Tuple[list, Callable]] = None
         self._dead = False  # set when un-spliced back out of the graph
         self._verified = False  # first frame after a (re)compile is synced
+        from nnstreamer_tpu.pipeline.dispatch import DispatchWindow
+
+        #: bounded async dispatch: up to `inflight` outstanding batches
+        #: (pipeline/dispatch.py); the region adopts the largest member
+        #: `inflight` so `tensor_filter inflight=K` in a description
+        #: keeps meaning after fusion
+        member_inflight = [int(m.get_property("inflight"))
+                           for m in self.members if "inflight" in m._props]
+        if member_inflight:
+            self._props["inflight"] = max(member_inflight)
+        self._window = DispatchWindow(self)
+        self._m_retrace = None  # region re-trace counter (lazy)
 
     # -- stage (re)build -----------------------------------------------------
     def _build(self) -> Tuple[list, Callable]:
@@ -155,10 +171,31 @@ class FusedRegion(Element):
 
             jitted = jax.jit(composed)
             self._trace_cache = (keys, jitted)
+            self._count_retrace()
         compiled = ([st.consts for st in stages], jitted, stages[-1].finalize)
         self._compiled = compiled
         self._verified = False  # first frame after (re)compile syncs
         return compiled
+
+    def _count_retrace(self) -> None:
+        """Count actual region re-traces (`nns_fuse_retraces_total`) —
+        the no-new-XLA-recompiles acceptance gate reads this: a consts
+        swap or an inflight change must NOT move it."""
+        if self._m_retrace is None:
+            from nnstreamer_tpu.obs import get_registry
+
+            self._m_retrace = get_registry().counter(
+                "nns_fuse_retraces_total",
+                "Region re-traces (each implies one XLA compile)",
+                **self._obs_labels())
+        self._m_retrace.inc()
+
+    def obs_snapshot(self):
+        out = super().obs_snapshot()
+        out.update(self._window.snapshot())
+        if self._m_retrace is not None:
+            out["retraces"] = int(self._m_retrace.value)
+        return out
 
     def invalidate(self) -> None:
         """Drop the compiled (consts, jit) pair; the next frame re-pulls
@@ -206,6 +243,9 @@ class FusedRegion(Element):
                 # resumes seamlessly
                 return self._fallback(buf)
         consts, jitted, finalize = compiled
+        from nnstreamer_tpu.pipeline.dispatch import POOL_STASH_META
+
+        stash = buf.meta.pop(POOL_STASH_META, None)
         try:
             out = jitted(consts, list(buf.tensors))
             if not self._verified:
@@ -216,7 +256,9 @@ class FusedRegion(Element):
                 # the first frame after every (re)compile so both trace-time
                 # and first-frame runtime failures take the fallback path;
                 # steady-state frames stay fully async.
-                jax.block_until_ready(out)
+                # one-time post-(re)compile verification sync, not a
+                # per-frame fence; steady-state frames skip this branch
+                jax.block_until_ready(out)  # nns-lint: disable=NNS107 -- once
                 self._verified = True
         except Exception as e:  # noqa: BLE001 — fusion is an optimization,
             # never a failure: a stage that won't trace or whose first
@@ -227,6 +269,11 @@ class FusedRegion(Element):
             log.warning("%s: fused program failed (%s); falling back to "
                         "member chain", self.name, e)
             return self._fallback(buf)
+        # bounded async dispatch: register the outstanding batch (fences
+        # the OLDEST only when more than `inflight` are in flight); the
+        # pooled host staging arrays this dispatch consumed recycle at
+        # that fence point
+        self._window.admit(out, stash)
         out_buf = buf.with_tensors(list(out))
         if finalize is not None:
             out_buf = out_buf.replace(finalize=finalize)
@@ -238,6 +285,15 @@ class FusedRegion(Element):
         self.unsplice()
         first = self.members[0]
         return first._chain_entry(first.sinkpads[0], buf)
+
+    def handle_eos(self):
+        # EOS flush: every outstanding dispatch fences before EOS crosses
+        # downstream — a sink observing EOS has all results materializable
+        self._window.drain()
+
+    def stop(self):
+        self._window.drain()
+        super().stop()
 
     # -- events --------------------------------------------------------------
     def src_event(self, pad: Pad, event: Event) -> None:
@@ -308,6 +364,8 @@ class FusedRegion(Element):
 
     def unsplice(self) -> None:
         """Restore the original element links (region becomes inert)."""
+        self._window.drain()  # outstanding dispatches belong to the dying
+        # region; fence them so fallback replay can never reorder results
         first, last = self.members[0], self.members[-1]
         last.srcpads[0].unlink()  # internal pad
         up_src = self.sinkpad.peer

@@ -127,7 +127,12 @@ class Queue(Element):
                   # deferred finalize is applied here). For sink-bound
                   # queues feeding to-host consumers; unlike prefetch_host
                   # it changes the payload type, so it is its own opt-in.
-                  "materialize_host": False}
+                  "materialize_host": False,
+                  # batch drain: max buffers the worker gathers per wake
+                  # (whatever is ALREADY queued — it never waits). Runs of
+                  # data buffers go to HANDLES_LIST peers as one list;
+                  # 1 disables gathering entirely.
+                  "drain_batch": 64}
 
     _EOS = object()
 
@@ -144,6 +149,12 @@ class Queue(Element):
         self._eos_done = threading.Event()
         self._m_drops = None      # leaky-downstream drop counter (lazy)
         self._m_blocked = None    # cumulative blocked-put seconds (lazy)
+        self._m_drain = None      # per-wake drain size histogram (lazy)
+        #: data buffers the worker has popped but not yet handed
+        #: downstream — batch drain moves the backlog out of the FIFO in
+        #: one wake, so qsize() alone would under-report occupancy while
+        #: the worker is blocked delivering (single-writer: the worker)
+        self._undelivered = 0
         self._last_drop_warn_t = 0.0
         self._drops_since_warn = 0
 
@@ -160,11 +171,16 @@ class Queue(Element):
             "nns_queue_blocked_seconds_total",
             "Cumulative producer time spent blocked on a full queue",
             **labels)
+        self._m_drain = reg.histogram(
+            "nns_queue_drain_size",
+            "Data buffers the worker drained per wake (backlog batching)",
+            buckets=(1, 2, 4, 8, 16, 32, 64), **labels)
         import weakref
 
         ref = weakref.ref(self)
         reg.gauge("nns_queue_depth", "Buffers currently queued",
-                  fn=lambda: (ref()._q.qsize() if ref() is not None else 0),
+                  fn=lambda: ((ref()._q.qsize() + ref()._undelivered)
+                              if ref() is not None else 0),
                   **labels)
 
     def _count_drop(self) -> None:
@@ -185,16 +201,19 @@ class Queue(Element):
 
     def obs_snapshot(self):
         out = super().obs_snapshot()
-        out["depth"] = self._q.qsize()
+        out["depth"] = self._q.qsize() + self._undelivered
         if self._m_drops is not None:
             out["drops"] = int(self._m_drops.value)
             out["blocked_s"] = round(self._m_blocked.value, 4)
+        if self._m_drain is not None and self._m_drain.count:
+            out["drain_size_p50"] = self._m_drain.percentile(50)
         return out
 
     def start(self):
         super().start()
         self._stop_evt.clear()
         self._eos_done.clear()
+        self._undelivered = 0
         self._q = _queue.Queue(maxsize=int(self.get_property("max_size_buffers")))
         if self._m_drops is None:
             self._obs_init()
@@ -244,7 +263,23 @@ class Queue(Element):
                 # the previous frame's compute; on a tunneled chip the
                 # per-call transfer RPC otherwise serializes into every
                 # dispatch)
+                from nnstreamer_tpu.tensors.pool import get_pool
+
+                stash = [t for t in buf.tensors if get_pool().owns(t)]
                 buf = buf.to_device()
+                if stash:
+                    # pooled staging arrays must survive until the
+                    # dispatch that consumes the uploaded copies has
+                    # fenced (the H2D may alias or still be in flight);
+                    # the downstream DispatchWindow releases them at its
+                    # fence point (pipeline/dispatch.py). to_device()
+                    # returned a fresh buffer, so its meta is still ours
+                    # to stamp.
+                    from nnstreamer_tpu.pipeline.dispatch import (
+                        POOL_STASH_META,
+                    )
+
+                    buf.meta[POOL_STASH_META] = stash
             # a latency-budget partial window deferred its padding here
             # (aggregator pad-device): only the real frames crossed the
             # link; the zero rows are synthesized on device now
@@ -292,23 +327,55 @@ class Queue(Element):
             # a CapsEvent must not overtake buffers queued ahead of it
             self._q.put(event)
 
+    def _flush_run(self, run: list) -> None:
+        """Deliver a gathered run of data buffers: materialized one by
+        one (materialize_host), as ONE list hand-off when the peer opts
+        in (``Pad.push_list`` → ``HANDLES_LIST``), else per-buffer."""
+        if not run:
+            return
+        if self.get_property("materialize_host"):
+            # materialize HERE, where the group's copies were just
+            # issued — handing device arrays onward would re-serialize
+            # the fetches at the sink
+            for it in run:
+                self._undelivered -= 1
+                self.srcpad.push(it.to_host())
+        elif len(run) > 1:
+            peer = self.srcpad.peer
+            if peer is not None and getattr(peer.element,
+                                            "HANDLES_LIST", False):
+                # one chain_list hand-off: the whole run leaves at once
+                self._undelivered -= len(run)
+                self.srcpad.push_list(run)
+            else:
+                # push_list would fall back to sequential pushes — keep
+                # the occupancy honest while the peer works through them
+                for it in run:
+                    self._undelivered -= 1
+                    self.srcpad.push(it)
+        else:
+            self._undelivered -= 1
+            self.srcpad.push(run[0])
+
     def _drain(self):
         group_host = bool(self.get_property("materialize_host"))
+        drain_max = max(1, int(self.get_property("drain_batch")))
         while not self._stop_evt.is_set():
             try:
                 item = self._q.get(timeout=0.1)
             except _queue.Empty:
                 continue
             batch = [item]
-            if group_host and not isinstance(item, Event) and \
+            if drain_max > 1 and not isinstance(item, Event) and \
                     item is not self._EOS:
                 # gather whatever is ALREADY queued (never wait): one
-                # grouped flush materializes the whole backlog. On a
-                # tunneled chip a blocking fetch costs a full RTT (~100 ms)
-                # no matter the size, but transfers started from this
-                # thread right before the block all ride the same round —
-                # A/B-measured 6x per-buffer (94 ms → 16 ms) at depth 10.
-                while len(batch) < 64:
+                # grouped flush services the whole backlog — one worker
+                # wake, one downstream hand-off. On a tunneled chip a
+                # blocking fetch costs a full RTT (~100 ms) no matter the
+                # size, but transfers started from this thread right
+                # before the block all ride the same round — A/B-measured
+                # 6x per-buffer (94 ms → 16 ms) at depth 10.
+                while len(batch) < drain_max:
                     try:
                         nxt = self._q.get_nowait()
                     except _queue.Empty:
@@ -316,6 +383,12 @@ class Queue(Element):
                     batch.append(nxt)
                     if nxt is self._EOS or isinstance(nxt, Event):
                         break  # events stay serialized with the data flow
+            ndata = sum(1 for it in batch
+                        if it is not self._EOS and not isinstance(it, Event))
+            self._undelivered += ndata
+            if ndata and self._m_drain is not None:
+                self._m_drain.observe(ndata)
+            if group_host:
                 for it in batch:
                     if isinstance(it, Event) or it is self._EOS:
                         continue
@@ -323,28 +396,29 @@ class Queue(Element):
                         start_async = getattr(t, "copy_to_host_async", None)
                         if start_async is not None:
                             start_async()
-            for i, it in enumerate(batch):
-                if it is self._EOS:
-                    self.srcpad.push_event(EosEvent())
-                    self._eos_done.set()
-                    return
-                try:
-                    if isinstance(it, Event):
+            run: list = []
+            try:
+                for it in batch:
+                    if it is self._EOS or isinstance(it, Event):
+                        # events delimit runs and stay serialized: drain
+                        # the data queued ahead of them first
+                        self._flush_run(run)
+                        run = []
+                        if it is self._EOS:
+                            self.srcpad.push_event(EosEvent())
+                            self._eos_done.set()
+                            return
                         self.srcpad.push_event(it)
-                    elif group_host:
-                        # materialize HERE, where the group's copies were
-                        # just issued — handing device arrays onward would
-                        # re-serialize the fetches at the sink
-                        self.srcpad.push(it.to_host())
                     else:
-                        self.srcpad.push(it)
-                except Exception as e:  # noqa: BLE001 — downstream
-                    # negotiation or chain failures must reach the bus,
-                    # not silently kill this worker thread
-                    self.post_error(e if isinstance(e, FlowError)
-                                    else FlowError(f"{self.name}: {e}"))
-                    self._eos_done.set()  # unblock a waiting EOS pusher
-                    return
+                        run.append(it)
+                self._flush_run(run)
+            except Exception as e:  # noqa: BLE001 — downstream
+                # negotiation or chain failures must reach the bus,
+                # not silently kill this worker thread
+                self.post_error(e if isinstance(e, FlowError)
+                                else FlowError(f"{self.name}: {e}"))
+                self._eos_done.set()  # unblock a waiting EOS pusher
+                return
 
 
 class Pipeline:
@@ -419,8 +493,16 @@ class Pipeline:
             }
             entry.update(el.obs_snapshot())
             elements[el.name] = entry
-        return {"pipeline": self.name, "state": self.state.value,
-                "elements": elements}
+        out = {"pipeline": self.name, "state": self.state.value,
+               "elements": elements}
+        from nnstreamer_tpu.tensors.pool import get_pool, pool_enabled
+
+        if pool_enabled():
+            # the ingest staging pool is process-wide (sources/converters/
+            # aggregators share it); surfaced here so one snapshot answers
+            # "is the hot path recycling or allocating?"
+            out["pool"] = get_pool().snapshot()
+        return out
 
     # -- state ----------------------------------------------------------------
     def start(self) -> "Pipeline":

@@ -11,3 +11,8 @@ from nnstreamer_tpu.tensors.types import (  # noqa: F401
 )
 from nnstreamer_tpu.tensors.buffer import TensorBuffer  # noqa: F401
 from nnstreamer_tpu.tensors.meta import TensorMetaInfo  # noqa: F401
+from nnstreamer_tpu.tensors.pool import (  # noqa: F401
+    BufferPool,
+    get_pool,
+    pool_enabled,
+)

@@ -1,0 +1,231 @@
+"""Ingest buffer pool — recycled, aligned host staging buffers.
+
+The reference ships a ``tensor_allocator`` so per-frame payloads come out
+of a reused allocation instead of malloc/free per buffer; GStreamer itself
+pools via ``GstBufferPool``. Our ingest hot path had neither: every source
+frame, converter stack, and aggregator window concatenation allocated a
+fresh numpy array, and at flagship rates (batch=8 × 224×224×3 uint8) that
+host allocation traffic is a real slice of the 486-fps ingest bound the
+bench measures. This module is the tensor_allocator analog:
+
+- **Size-classed free lists.** Requests round up to a power-of-two byte
+  class; a released slab serves any same-class request regardless of
+  shape/dtype (the view is re-derived per acquire).
+- **Aligned.** Slabs are offset to ``align`` (default 64) byte boundaries
+  so XLA's host ingestion path (and any zero-copy H2D that requires
+  alignment) never falls off its fast path.
+- **Safe recycling.** ``acquire`` registers a GC finalizer on the view it
+  hands out: a buffer that flows to the end of a pipeline and is simply
+  dropped returns its slab to the free list the moment the last reference
+  dies — no explicit release required for correctness. ``release`` is the
+  explicit fast path for owners that KNOW the array is dead (e.g. the
+  dispatch window fencing the batch that consumed a staging buffer); it
+  detaches the finalizer so a recycled id can never double-free. Both
+  paths refcount-check the slab before recycling: numpy collapses view
+  chains (``frame[None].base`` is the slab, not our view), so a live
+  derived view downstream means the slab is dropped to plain GC rather
+  than handed to the next acquire.
+
+Instrumented with ``nns_pool_hits_total`` / ``nns_pool_misses_total`` /
+``nns_pool_grows_total`` counters and an ``nns_pool_outstanding`` gauge in
+``obs/``. Disable with ``NNSTPU_POOL=0`` (acquire degrades to plain
+``np.empty``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+#: smallest size class in bytes — tiny requests all share one class
+_MIN_CLASS = 256
+
+
+def pool_enabled() -> bool:
+    return os.environ.get("NNSTPU_POOL", "1").strip().lower() not in (
+        "0", "false", "no", "off"
+    )
+
+
+def _size_class(nbytes: int) -> int:
+    if nbytes <= _MIN_CLASS:
+        return _MIN_CLASS
+    return 1 << (nbytes - 1).bit_length()
+
+
+class BufferPool:
+    """Thread-safe, size-classed pool of aligned host staging buffers."""
+
+    def __init__(self, align: int = 64, max_per_class: int = 32,
+                 name: str = "ingest"):
+        self.align = int(align)
+        self.max_per_class = int(max_per_class)
+        self.name = name
+        self._lock = threading.Lock()
+        #: size class → list of free slabs (uint8 arrays, len = class+align)
+        self._free: Dict[int, List[np.ndarray]] = {}
+        #: id(view) → (class, slab, finalizer) for live pool-owned views
+        self._out: Dict[int, Tuple[int, np.ndarray, Any]] = {}
+        self.hits = 0
+        self.misses = 0
+        self.grows = 0
+        self._metrics = None
+
+    # -- obs ----------------------------------------------------------------
+    def _obs(self):
+        if self._metrics is None:
+            from nnstreamer_tpu.obs import get_registry
+
+            reg = get_registry()
+            labels = {"pool": self.name}
+            ref = weakref.ref(self)
+            self._metrics = {
+                "hits": reg.counter(
+                    "nns_pool_hits_total",
+                    "Acquires served from a recycled slab", **labels),
+                "misses": reg.counter(
+                    "nns_pool_misses_total",
+                    "Acquires that found no free slab in the class",
+                    **labels),
+                "grows": reg.counter(
+                    "nns_pool_grows_total",
+                    "Fresh slab allocations (pool footprint growth)",
+                    **labels),
+            }
+            reg.gauge(
+                "nns_pool_outstanding",
+                "Pool-owned buffers currently held by the pipeline",
+                fn=lambda: (len(ref()._out) if ref() is not None else 0),
+                **labels)
+        return self._metrics
+
+    # -- hot path -----------------------------------------------------------
+    def acquire(self, shape, dtype) -> np.ndarray:
+        """An uninitialized, ``align``-byte-aligned array of (shape, dtype)
+        backed by a recycled slab when one is free."""
+        shape = tuple(int(s) for s in shape)
+        dt = np.dtype(dtype)
+        nbytes = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+        if not pool_enabled() or nbytes == 0:
+            return np.empty(shape, dt)
+        cls = _size_class(nbytes)
+        obs = self._obs()
+        with self._lock:
+            free = self._free.get(cls)
+            slab = free.pop() if free else None
+        if slab is None:
+            self.misses += 1
+            self.grows += 1
+            obs["misses"].inc()
+            obs["grows"].inc()
+            slab = np.empty(cls + self.align, np.uint8)
+        else:
+            self.hits += 1
+            obs["hits"].inc()
+        off = (-slab.ctypes.data) % self.align
+        view = slab[off:off + nbytes].view(dt).reshape(shape)
+        token = id(view)
+        fin = weakref.finalize(view, self._expire, token)
+        with self._lock:
+            self._out[token] = (cls, slab, fin)
+        return view
+
+    def _expire(self, token: int) -> None:
+        """GC fallback: the view died without an explicit release.
+
+        The slab is recycled ONLY when nothing else references it. numpy
+        collapses view chains — a derived view's ``.base`` is the slab,
+        not the view we handed out — so the tracked view can die while a
+        downstream ``frame[None]``/slice still reads the slab. Each such
+        base reference shows up in the slab's refcount; if any remain,
+        the slab is dropped (plain GC frees it when the last view dies)
+        instead of re-entering the free list."""
+        import sys
+
+        with self._lock:
+            entry = self._out.pop(token, None)
+            if entry is None:
+                return
+            cls, slab = entry[0], entry[1]
+            del entry
+            # refs now: local `slab` + getrefcount's argument + the DYING
+            # view's .base (tp_dealloc fires weakref callbacks before it
+            # drops the instance's own references) == 3
+            if sys.getrefcount(slab) > 3:
+                return  # a derived view is still live — never alias it
+            free = self._free.setdefault(cls, [])
+            if len(free) < self.max_per_class:
+                free.append(slab)
+
+    def owns(self, arr) -> bool:
+        """True if ``arr`` is a view this pool handed out (not a derived
+        view — those pin the slab out of circulation until they die)."""
+        with self._lock:
+            return id(arr) in self._out
+
+    def release(self, arr) -> bool:
+        """Explicitly return ``arr``'s slab to the free list. Only call
+        when no other reader (host or in-flight device transfer) can
+        still touch the memory. Unknown arrays are ignored (False)."""
+        import sys
+
+        with self._lock:
+            entry = self._out.pop(id(arr), None)
+            if entry is None:
+                return False
+            cls, slab, fin = entry
+            del entry
+            fin.detach()  # a future acquire may reuse this id — the stale
+            # finalizer must never fire against the new registration
+            # refs now: local `slab` + getrefcount arg + `arr.base` == 3;
+            # more means a derived view (numpy collapses .base to the
+            # slab) is still live somewhere — drop the slab instead of
+            # recycling it under that reader
+            if sys.getrefcount(slab) > 3:
+                return True
+            free = self._free.setdefault(cls, [])
+            if len(free) < self.max_per_class:
+                free.append(slab)
+            return True
+
+    def release_many(self, arrs) -> int:
+        return sum(1 for a in (arrs or ()) if self.release(a))
+
+    # -- introspection ------------------------------------------------------
+    def hit_rate(self) -> Optional[float]:
+        total = self.hits + self.misses
+        return (self.hits / total) if total else None
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            free = sum(len(v) for v in self._free.values())
+            out = len(self._out)
+        rate = self.hit_rate()
+        return {"hits": self.hits, "misses": self.misses,
+                "grows": self.grows, "outstanding": out, "free": free,
+                "hit_rate": None if rate is None else round(rate, 4)}
+
+    def clear(self) -> None:
+        """Drop all free slabs (outstanding views are untouched)."""
+        with self._lock:
+            self._free.clear()
+
+
+_default: Optional[BufferPool] = None
+_default_lock = threading.Lock()
+
+
+def get_pool() -> BufferPool:
+    """Process-wide ingest pool (sources/converters/aggregators share
+    it so a pipeline's steady-state working set converges on a few
+    slabs)."""
+    global _default
+    if _default is None:
+        with _default_lock:
+            if _default is None:
+                _default = BufferPool()
+    return _default

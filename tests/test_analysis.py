@@ -272,6 +272,40 @@ class TestAstLint:
         ok = "c = reg.counter('nns_queue_drops_total')\n"
         assert by_code(lint_source(ok, "x.py"), "NNS106") == []
 
+    def test_nns107_sync_in_chain(self):
+        src = ("import numpy as np\n"
+               "class E:\n"
+               "    def chain(self, pad, buf):\n"
+               "        x = np.asarray(buf.tensors[0])\n")
+        assert "NNS107" in codes(lint_source(src, "x.py"))
+
+    def test_nns107_block_until_ready_and_scalar_pull(self):
+        src = ("def chain_list(self, pad, bufs):\n"
+               "    out.block_until_ready()\n"
+               "    v = float(out[0])\n")
+        assert codes(lint_source(src, "x.py")) == ["NNS107", "NNS107"]
+
+    def test_nns107_outside_hot_path_ok(self):
+        src = ("import numpy as np\n"
+               "def to_host(buf):\n"
+               "    return np.asarray(buf.tensors[0])\n")
+        assert by_code(lint_source(src, "x.py"), "NNS107") == []
+
+    def test_nns107_nested_in_device_stage(self):
+        src = ("import numpy as np\n"
+               "def device_stage(self):\n"
+               "    def run(x):\n"
+               "        return np.asarray(x)\n"
+               "    return run\n")
+        assert "NNS107" in codes(lint_source(src, "x.py"))
+
+    def test_nns107_pragma_suppressible(self):
+        src = ("import numpy as np\n"
+               "def chain(self, pad, buf):\n"
+               "    x = np.asarray(  # nns-lint: disable=NNS107 -- host\n"
+               "        buf.tensors[0])\n")
+        assert by_code(lint_source(src, "x.py"), "NNS107") == []
+
     def test_pragma_suppresses_with_reason(self):
         src = ("import time\n"
                "d = time.time()  # nns-lint: disable=NNS101 -- epoch "

@@ -1,0 +1,569 @@
+"""Overlap layer: dispatch window, ingest buffer pool, batch-drain queues.
+
+The contract under test (pipeline/dispatch.py, tensors/pool.py, the Queue
+drain loop): pipelining host and device work must be OBSERVABLY free —
+per-frame outputs and their ordering are byte-identical at every
+``inflight`` setting, EOS flushes a non-empty window, recycled staging
+buffers never alias live data, and list hand-offs preserve per-buffer
+semantics (stats, ordering, events serialized).
+"""
+
+import gc
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import parse_launch
+from nnstreamer_tpu.filters.jax_backend import (
+    register_jax_model,
+    unregister_jax_model,
+)
+from nnstreamer_tpu.pipeline.dispatch import POOL_STASH_META, DispatchWindow
+from nnstreamer_tpu.pipeline.element import Element, EosEvent, FlowReturn
+from nnstreamer_tpu.pipeline.pipeline import Pipeline, Queue, SourceElement
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
+from nnstreamer_tpu.tensors.pool import BufferPool, _size_class, get_pool
+from nnstreamer_tpu.tensors.types import TensorInfo, TensorsInfo, TensorType
+
+
+# -- shared helpers -----------------------------------------------------------
+
+
+class _NumSrc(SourceElement):
+    """Counts 0..n-1 as 1-elem float32 tensors."""
+
+    ELEMENT_NAME = "_numsrc"
+    PROPERTIES = {**SourceElement.PROPERTIES, "num_buffers": 5}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.i = 0
+
+    def negotiate(self):
+        from nnstreamer_tpu.tensors.types import TensorsConfig
+
+        cfg = TensorsConfig.from_arrays([np.zeros((1,), np.float32)])
+        self.srcpad.set_caps(cfg.to_caps())
+
+    def create(self):
+        if self.i >= self.get_property("num_buffers"):
+            return None
+        buf = TensorBuffer([np.array([float(self.i)], np.float32)],
+                           pts=self.i * 1000)
+        self.i += 1
+        return buf
+
+
+class _Collect(Element):
+    ELEMENT_NAME = "_collect"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad("sink")
+        self.buffers = []
+        self.got_eos = False
+
+    def chain(self, pad, buf):
+        self.buffers.append(buf)
+        return FlowReturn.OK
+
+    def sink_event(self, pad, event):
+        if isinstance(event, EosEvent):
+            self.got_eos = True
+
+
+@pytest.fixture
+def linear_model():
+    import jax.numpy as jnp
+
+    w = jnp.full((4, 3), 0.5, jnp.float32)
+
+    def fn(params, x):
+        return x.astype(jnp.float32) @ params
+
+    in_info = TensorsInfo([TensorInfo(dim=(4, 8), type=TensorType.FLOAT32)])
+    out_info = TensorsInfo([TensorInfo(dim=(3, 8), type=TensorType.FLOAT32)])
+    register_jax_model("overlap_linear", fn, w, in_info=in_info,
+                       out_info=out_info)
+    yield "overlap_linear"
+    unregister_jax_model("overlap_linear")
+
+
+# -- buffer pool --------------------------------------------------------------
+
+
+class TestBufferPool:
+    def test_size_classes(self):
+        assert _size_class(1) == 256
+        assert _size_class(256) == 256
+        assert _size_class(257) == 512
+        assert _size_class(4096) == 4096
+        assert _size_class(4097) == 8192
+
+    def test_alignment(self):
+        p = BufferPool(align=64)
+        for shape, dt in (((7,), np.uint8), ((3, 5), np.float32),
+                          ((1, 224, 224, 3), np.uint8)):
+            a = p.acquire(shape, dt)
+            assert a.ctypes.data % 64 == 0
+            assert a.shape == shape and a.dtype == np.dtype(dt)
+
+    def test_reuse_after_release(self):
+        p = BufferPool()
+        a = p.acquire((8, 8), np.float32)
+        addr = a.ctypes.data
+        assert p.owns(a)
+        assert p.release(a) is True
+        assert not p.owns(a)
+        del a
+        b = p.acquire((16, 16), np.uint8)  # same 256B class, new shape
+        assert p.hits == 1 and p.misses == 1
+        assert b.ctypes.data == addr  # the recycled slab, re-derived
+
+    def test_double_release_rejected(self):
+        p = BufferPool()
+        a = p.acquire((4,), np.float32)
+        assert p.release(a) is True
+        assert p.release(a) is False
+        assert p.snapshot()["free"] == 1  # not freed twice
+
+    def test_gc_fallback_recycles(self):
+        p = BufferPool()
+        a = p.acquire((4,), np.float32)
+        del a
+        gc.collect()
+        snap = p.snapshot()
+        assert snap["outstanding"] == 0 and snap["free"] == 1
+        b = p.acquire((4,), np.float32)
+        assert p.hits == 1
+        del b
+
+    def test_gc_fallback_never_aliases_derived_view(self):
+        """numpy collapses view chains: ``a[None].base`` is the SLAB,
+        not the pool-tracked view — so the tracked view can die (and its
+        finalizer fire) while a derived view downstream still reads the
+        memory. The slab must NOT re-enter circulation."""
+        p = BufferPool()
+        a = p.acquire((4,), np.float32)
+        a[:] = 7.0
+        derived = a[None]  # base collapses to the slab
+        del a
+        gc.collect()
+        assert p.snapshot()["free"] == 0  # pinned by the derived view
+        b = p.acquire((4,), np.float32)
+        b[:] = 0.0
+        np.testing.assert_array_equal(
+            derived[0], np.full(4, 7.0, np.float32))
+
+    def test_release_never_aliases_derived_view(self):
+        """Even an explicit release must not recycle a slab that a
+        derived view elsewhere (tee branch, app callback) still reads —
+        pool ownership ends, but the slab falls back to plain GC."""
+        p = BufferPool()
+        a = p.acquire((4,), np.float32)
+        a[:] = 7.0
+        derived = a[None]
+        assert p.release(a) is True
+        assert p.snapshot()["free"] == 0  # dropped, not recycled
+        b = p.acquire((4,), np.float32)
+        b[:] = 0.0
+        np.testing.assert_array_equal(
+            derived[0], np.full(4, 7.0, np.float32))
+
+    def test_stale_finalizer_cannot_double_free(self):
+        """Explicit release detaches the GC finalizer: when the view dies
+        later, its slab must not be freed a second time (a fresh acquire
+        could reuse id(view), and a stale finalizer firing against the
+        new registration would recycle live memory)."""
+        p = BufferPool()
+        a = p.acquire((4,), np.float32)
+        p.release(a)
+        del a
+        gc.collect()
+        assert p.snapshot()["free"] == 1
+
+    def test_reuse_does_not_alias_outstanding(self):
+        """Without release, a second acquire must NOT hand out the same
+        memory the first view still owns."""
+        p = BufferPool()
+        a = p.acquire((8,), np.float32)
+        b = p.acquire((8,), np.float32)
+        a[:], b[:] = 1.0, 2.0
+        assert a.ctypes.data != b.ctypes.data
+        np.testing.assert_array_equal(a, np.full(8, 1.0, np.float32))
+
+    def test_disabled_by_env(self, monkeypatch):
+        monkeypatch.setenv("NNSTPU_POOL", "0")
+        p = BufferPool()
+        a = p.acquire((4,), np.float32)
+        assert not p.owns(a)
+        assert p.hits == p.misses == 0
+
+    def test_max_per_class_bounds_freelist(self):
+        p = BufferPool(max_per_class=2)
+        views = [p.acquire((4,), np.float32) for _ in range(4)]
+        for v in views:
+            p.release(v)
+        assert p.snapshot()["free"] == 2
+
+
+# -- dispatch window ----------------------------------------------------------
+
+
+class _WindowOwner(Element):
+    ELEMENT_NAME = "_winowner"
+    PROPERTIES = {**Element.PROPERTIES, "inflight": 2}
+
+
+class TestDispatchWindow:
+    def _mk(self, inflight):
+        owner = _WindowOwner(inflight=inflight)
+        return owner, DispatchWindow(owner)
+
+    def test_admit_bounds_window(self):
+        import jax.numpy as jnp
+
+        _owner, w = self._mk(2)
+        for i in range(5):
+            w.admit([jnp.full((4,), i)])
+            assert len(w) <= 2
+        assert len(w) == 2
+
+    def test_inflight_zero_is_synchronous(self):
+        import jax.numpy as jnp
+
+        _owner, w = self._mk(0)
+        w.admit([jnp.zeros((4,))])
+        assert len(w) == 0
+
+    def test_drain_empties_window(self):
+        import jax.numpy as jnp
+
+        _owner, w = self._mk(8)
+        for i in range(5):
+            w.admit([jnp.full((2,), i)])
+        assert len(w) == 5  # never hit the limit
+        w.drain()
+        assert len(w) == 0
+
+    def test_fence_releases_stash(self):
+        import jax.numpy as jnp
+
+        pool = get_pool()
+        staged = pool.acquire((4,), np.float32)
+        _owner, w = self._mk(1)
+        w.admit([jnp.zeros((4,))], stash=[staged])
+        assert pool.owns(staged)  # still outstanding inside the window
+        w.drain()
+        assert not pool.owns(staged)  # fence proved dispatch done
+
+    def test_snapshot_reports_limits(self):
+        import jax.numpy as jnp
+
+        _owner, w = self._mk(3)
+        w.admit([jnp.zeros((2,))])
+        snap = w.snapshot()
+        assert snap["inflight_now"] == 1
+        assert snap["inflight_limit"] == 3
+
+
+# -- queue opt-ins × deferred finalize ---------------------------------------
+
+
+class _DeferredProbe(Element):
+    """HANDLES_DEFERRED sink recording placement and finalize state at
+    arrival, then materializing (so finalize correctness is also
+    checked)."""
+
+    ELEMENT_NAME = "_defprobe"
+    HANDLES_DEFERRED = True
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad("sink")
+        self.arrived = []   # (finalize_pending, on_device) at chain entry
+        self.values = []
+
+    def chain(self, pad, buf):
+        self.arrived.append((buf.finalize is not None, buf.on_device()))
+        host = buf.to_host()
+        self.values.append(np.asarray(host.tensors[0]).copy())
+        return FlowReturn.OK
+
+
+class _FinalizeSrc(SourceElement):
+    """Pushes buffers carrying a deferred finalize that doubles the
+    payload — the fused-region deferred-stage pattern in miniature."""
+
+    ELEMENT_NAME = "_finsrc"
+    PROPERTIES = {**SourceElement.PROPERTIES, "num_buffers": 4,
+                  "device": False}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.i = 0
+
+    def negotiate(self):
+        from nnstreamer_tpu.tensors.types import TensorsConfig
+
+        cfg = TensorsConfig.from_arrays([np.zeros((2,), np.float32)])
+        self.srcpad.set_caps(cfg.to_caps())
+
+    def create(self):
+        if self.i >= self.get_property("num_buffers"):
+            return None
+        arr = np.full((2,), float(self.i), np.float32)
+        if self.get_property("device"):
+            import jax.numpy as jnp
+
+            arr = jnp.asarray(arr)
+        buf = TensorBuffer([arr], pts=self.i).replace(
+            finalize=lambda b: b.with_tensors(
+                [np.asarray(t) * 2 for t in b.tensors]))
+        self.i += 1
+        return buf
+
+
+def _run_finalize_pipe(queue_props, n=4, device=False):
+    src = _FinalizeSrc(num_buffers=n, device=device)
+    q = Queue(**queue_props)
+    probe = _DeferredProbe()
+    pipe = Pipeline().add_linked(src, q, probe)
+    msg = pipe.run(timeout=30)
+    assert msg is not None and msg.kind == "eos"
+    return probe
+
+
+class TestQueueOptIns:
+    def test_plain_queue_keeps_finalize_lazy(self):
+        probe = _run_finalize_pipe({})
+        # queue is HANDLES_DEFERRED passthrough: finalize arrives intact
+        assert all(pending for pending, _dev in probe.arrived)
+        for i, v in enumerate(probe.values):
+            np.testing.assert_array_equal(v, np.full((2,), 2.0 * i))
+
+    def test_materialize_host_applies_finalize_at_queue(self):
+        probe = _run_finalize_pipe({"materialize_host": True}, device=True)
+        assert all(not pending and not dev
+                   for pending, dev in probe.arrived)
+        for i, v in enumerate(probe.values):
+            np.testing.assert_array_equal(v, np.full((2,), 2.0 * i))
+
+    def test_prefetch_device_keeps_finalize_and_moves_payload(self):
+        probe = _run_finalize_pipe({"prefetch_device": True})
+        assert all(pending and dev for pending, dev in probe.arrived)
+        for i, v in enumerate(probe.values):
+            np.testing.assert_array_equal(v, np.full((2,), 2.0 * i))
+
+    def test_prefetch_host_preserves_results(self):
+        probe = _run_finalize_pipe({"prefetch_host": True}, device=True)
+        for i, v in enumerate(probe.values):
+            np.testing.assert_array_equal(v, np.full((2,), 2.0 * i))
+
+    def test_prefetch_device_stamps_pool_stash(self):
+        """A pool-owned host array crossing a prefetch-device queue must
+        ride on as a stash claim (released downstream at the fence), not
+        be recycled while the H2D may still read it."""
+        pool = get_pool()
+
+        class _PoolSrc(_NumSrc):
+            ELEMENT_NAME = "_poolsrc"
+
+            def create(self):
+                if self.i >= self.get_property("num_buffers"):
+                    return None
+                arr = pool.acquire((1,), np.float32)
+                arr[0] = float(self.i)
+                self.i += 1
+                return TensorBuffer([arr], pts=self.i * 1000)
+
+        src = _PoolSrc(num_buffers=3)
+        q = Queue(prefetch_device=True)
+        probe = _DeferredProbe()
+        pipe = Pipeline().add_linked(src, q, probe)
+        msg = pipe.run(timeout=30)
+        assert msg is not None and msg.kind == "eos"
+        assert len(probe.values) == 3
+        # every buffer was uploaded and carries its staging-array claim
+        assert all(dev for _pending, dev in probe.arrived)
+
+
+# -- batch drain --------------------------------------------------------------
+
+
+class _ListCollect(Element):
+    """HANDLES_LIST consumer recording list vs single hand-offs; the
+    first chain call stalls briefly so a backlog builds behind it."""
+
+    ELEMENT_NAME = "_listcollect"
+    HANDLES_LIST = True
+
+    def __init__(self, name=None, stall_s=0.0, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad("sink")
+        self.values = []
+        self.list_sizes = []
+        self.singles = 0
+        self._stall_s = stall_s
+        self._stalled = False
+
+    def _maybe_stall(self):
+        if self._stall_s and not self._stalled:
+            self._stalled = True
+            time.sleep(self._stall_s)
+
+    def chain(self, pad, buf):
+        self._maybe_stall()
+        self.singles += 1
+        self.values.append(float(np.asarray(buf.tensors[0])[0]))
+        return FlowReturn.OK
+
+    def chain_list(self, pad, bufs):
+        self._maybe_stall()
+        self.list_sizes.append(len(bufs))
+        for b in bufs:
+            self.values.append(float(np.asarray(b.tensors[0])[0]))
+        return FlowReturn.OK
+
+
+class TestBatchDrain:
+    def test_backlog_drains_as_ordered_list(self):
+        n = 40
+        src = _NumSrc(num_buffers=n)
+        q = Queue(max_size_buffers=n)
+        sink = _ListCollect(stall_s=0.3)
+        pipe = Pipeline().add_linked(src, q, sink)
+        msg = pipe.run(timeout=30)
+        assert msg is not None and msg.kind == "eos"
+        assert sink.values == [float(i) for i in range(n)]
+        # the stall built a backlog → at least one multi-buffer hand-off
+        assert sink.list_sizes and max(sink.list_sizes) > 1
+
+    def test_drain_batch_1_disables_gathering(self):
+        n = 20
+        src = _NumSrc(num_buffers=n)
+        q = Queue(max_size_buffers=n, drain_batch=1)
+        sink = _ListCollect(stall_s=0.2)
+        pipe = Pipeline().add_linked(src, q, sink)
+        pipe.run(timeout=30)
+        assert sink.values == [float(i) for i in range(n)]
+        assert sink.list_sizes == [] and sink.singles == n
+
+    def test_non_list_peer_gets_per_buffer_chain(self):
+        n = 30
+        src = _NumSrc(num_buffers=n)
+        q = Queue(max_size_buffers=n)
+        sink = _Collect()
+        pipe = Pipeline().add_linked(src, q, sink)
+        pipe.run(timeout=30)
+        vals = [float(b.tensors[0][0]) for b in sink.buffers]
+        assert vals == [float(i) for i in range(n)]
+        assert sink.got_eos
+
+    def test_list_handoff_keeps_invoke_stats_per_buffer(self):
+        n = 24
+        src = _NumSrc(num_buffers=n)
+        q = Queue(max_size_buffers=n)
+        sink = _ListCollect(stall_s=0.2)
+        pipe = Pipeline().add_linked(src, q, sink)
+        pipe.run(timeout=30)
+        # a list of k buffers must count as k invokes, not 1
+        assert sink.stats.total_invokes == n
+
+    def test_drain_size_metric_recorded(self):
+        n = 32
+        src = _NumSrc(num_buffers=n)
+        q = Queue(max_size_buffers=n)
+        sink = _ListCollect(stall_s=0.3)
+        pipe = Pipeline().add_linked(src, q, sink)
+        pipe.run(timeout=30)
+        snap = q.obs_snapshot()
+        assert snap.get("drain_size_p50") is not None
+
+
+# -- inflight semantics through a real filter pipeline ------------------------
+
+
+FILTER_DESC = (
+    "appsrc name=src ! "
+    "tensor_transform mode=arithmetic option=typecast:float32,mul:2.0 ! "
+    "tensor_filter framework=jax model={m} name=filter inflight={k} ! "
+    "tensor_sink name=sink"
+)
+
+
+def _run_filter(desc, frames, fuse):
+    pipe = parse_launch(desc)
+    pipe._fuse = fuse
+    pipe.start()
+    try:
+        src = pipe.get("src")
+        for f in frames:
+            src.push([f.copy()])
+        src.end_of_stream()
+        msg = pipe.wait(timeout=60)
+        assert msg is not None and msg.kind == "eos", msg
+    finally:
+        pipe.stop()
+    return pipe, [np.asarray(b.tensors[0])
+                  for b in pipe.get("sink").buffers]
+
+
+class TestInflight:
+    @pytest.mark.parametrize("fuse", [False, True])
+    def test_results_byte_identical_inflight_1_vs_2(self, linear_model,
+                                                    fuse):
+        frames = [np.random.default_rng(i).integers(0, 9, (8, 4))
+                  .astype(np.uint8) for i in range(8)]
+        _p1, out1 = _run_filter(FILTER_DESC.format(m=linear_model, k=1),
+                                frames, fuse)
+        _p2, out2 = _run_filter(FILTER_DESC.format(m=linear_model, k=2),
+                                frames, fuse)
+        assert len(out1) == len(out2) == len(frames)
+        for a, b in zip(out1, out2):
+            assert a.tobytes() == b.tobytes()  # bytes AND order
+
+    @pytest.mark.parametrize("fuse", [False, True])
+    def test_eos_flushes_non_empty_window(self, linear_model, fuse):
+        # window deeper than the frame count: nothing ever forces a
+        # fence mid-stream, so EOS alone must deliver every result
+        frames = [np.full((8, 4), i, np.uint8) for i in range(3)]
+        pipe, out = _run_filter(FILTER_DESC.format(m=linear_model, k=16),
+                                frames, fuse)
+        assert len(out) == 3
+        for i, a in enumerate(out):
+            np.testing.assert_allclose(
+                a, np.full((8, 3), i * 2 * 0.5 * 4, np.float32))
+
+    def test_region_adopts_member_inflight(self, linear_model):
+        pipe, _ = _run_filter(FILTER_DESC.format(m=linear_model, k=5),
+                              [np.ones((8, 4), np.uint8)] * 2, fuse=True)
+        assert pipe._regions
+        assert int(pipe._regions[0].get_property("inflight")) == 5
+
+    def test_metrics_snapshot_exposes_overlap_series(self, linear_model):
+        pipe, _ = _run_filter(FILTER_DESC.format(m=linear_model, k=2),
+                              [np.ones((8, 4), np.uint8)] * 4, fuse=False)
+        snap = pipe.metrics_snapshot()
+        filt = snap["elements"]["filter"]
+        assert filt["inflight_limit"] == 2
+        assert "inflight_now" in filt
+        assert "pool" in snap  # process-wide ingest pool surfaced
+        for key in ("hits", "misses", "outstanding", "hit_rate"):
+            assert key in snap["pool"]
+
+
+class TestSourcePooling:
+    def test_videotestsrc_ball_uses_pool(self):
+        before = get_pool().snapshot()
+        pipe = parse_launch(
+            "videotestsrc pattern=ball num-buffers=6 width=32 height=32 ! "
+            "tensor_converter ! tensor_sink name=sink")
+        msg = pipe.run(timeout=30)
+        assert msg is not None and msg.kind == "eos"
+        after = get_pool().snapshot()
+        assert (after["hits"] + after["misses"]) > \
+            (before["hits"] + before["misses"])
+        assert len(pipe.get("sink").buffers) == 6
