@@ -23,6 +23,13 @@ These rules encode invariants this codebase has already been burned by
   ``chain_list`` / ``_chain_locked`` / ``device_stage``) silently
   collapse the dispatch window (``pipeline/dispatch.py``) back to
   synchronous dispatch — materialize at the fence or sink instead.
+- NNS108: materializing a buffer's tensors directly
+  (``np.asarray(buf.tensors[i])``, ``jax.device_get(...)``,
+  ``.addressable_data(...)``) bypasses the residency layer's one
+  sanctioned ``to_host()`` site (``tensors/buffer.py``): a
+  ``DeviceBuffer`` caches its host view there, so a direct fetch copies
+  the same bytes again AND dodges the transfer counters the bench and
+  the ``nns_buffer_resident_ratio`` gauge rely on.
 
 Findings are suppressed per-line with::
 
@@ -64,6 +71,13 @@ _SYNC_CALLS = {"np.asarray", "numpy.asarray", "np.array", "numpy.array",
 #: per-frame hot-path function names where a hidden sync defeats the
 #: inflight dispatch window (pipeline/dispatch.py)
 _HOT_FUNCS = {"chain", "chain_list", "_chain_locked", "device_stage"}
+
+#: direct-materialization callables (NNS108): fetch device bytes while
+#: bypassing the cached, counted to_host() path
+_MATERIALIZE_CALLS = {"np.asarray", "numpy.asarray", "jax.device_get"}
+#: functions that ARE the sanctioned materialization site — anything
+#: inside them is exempt from NNS108
+_SANCTIONED_FUNCS = {"to_host"}
 
 
 def _parse_pragmas(text: str) -> Tuple[Dict[int, Set[str]], List[int]]:
@@ -160,6 +174,7 @@ class _FileLinter(ast.NodeVisitor):
         self._rule_nns105(node, dotted)
         self._rule_nns106(node, dotted)
         self._rule_nns107(node, dotted)
+        self._rule_nns108(node, dotted)
         self.generic_visit(node)
 
     def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
@@ -294,6 +309,37 @@ class _FileLinter(ast.NodeVisitor):
             hint="materialize at the fence/sink (to_host, "
                  "materialize-host queue) or justify host-only use with "
                  "a pragma")
+
+    def _rule_nns108(self, node: ast.Call, dotted: str) -> None:
+        if any(f in _SANCTIONED_FUNCS for f in self._func_stack):
+            return
+        what: Optional[str] = None
+        if dotted in _MATERIALIZE_CALLS and node.args and \
+                self._touches_buffer_tensors(node.args[0]):
+            # np.asarray(buf.tensors[i]) — fetching a buffer's payload
+            # around the wrapper; plain np.asarray(x) on a loose array
+            # is NNS107's business, not this rule's
+            what = f"{dotted}(...tensors...)"
+        elif isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "addressable_data":
+            what = ".addressable_data(...)"
+        if what is None:
+            return
+        self.emit(
+            "NNS108", node,
+            f"{what} materializes buffer tensors around the sanctioned "
+            f"to_host() site — a DeviceBuffer's cached host view is "
+            f"bypassed (double copy) and the nns_transfer_* counters "
+            f"miss the fetch",
+            hint="call buf.to_host() (cached, counted) or justify a "
+                 "host-only payload with a pragma")
+
+    @staticmethod
+    def _touches_buffer_tensors(arg: ast.AST) -> bool:
+        """True when the argument expression reads a ``.tensors``
+        attribute somewhere (``buf.tensors[0]``, ``info.tensors``...)."""
+        return any(isinstance(sub, ast.Attribute) and sub.attr == "tensors"
+                   for sub in ast.walk(arg))
 
 
 def lint_source(text: str, rel: str,

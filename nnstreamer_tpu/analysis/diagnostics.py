@@ -56,6 +56,9 @@ CODE_TABLE: Dict[str, str] = {
     "NNS106": "metric name violates the nns_<subsystem>_ convention",
     "NNS107": "sync-forcing call in a per-frame hot path (defeats the "
               "dispatch window)",
+    "NNS108": "direct tensor materialization outside the sanctioned "
+              "to_host() site (bypasses the DeviceBuffer cache and the "
+              "transfer counters)",
     "NNS199": "nns-lint pragma without a justification",
 }
 

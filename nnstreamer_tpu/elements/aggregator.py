@@ -41,6 +41,7 @@ class TensorAggregator(Element):
     #: batch-drain opt-in: a queue backlog arrives as one list, windowed
     #: under ONE lock acquisition (see chain_list)
     HANDLES_LIST = True
+    DEVICE_PASSTHROUGH = True  # device windows concat via jnp, host via np
     PROPERTIES = {
         **Element.PROPERTIES,
         "frames_in": 1,

@@ -83,7 +83,8 @@ class TensorIf(Element):
         if cv == "A_VALUE":
             coords_part, _, tidx_part = opt.partition(",")
             tidx = int(tidx_part) if tidx_part else 0
-            arr = np.asarray(buf.tensors[tidx])
+            arr = np.asarray(  # nns-lint: disable=NNS108 -- entry-materialized host payload (tensor_if is not DEVICE_PASSTHROUGH)
+                buf.tensors[tidx])
             coords = [int(c) for c in coords_part.split(":") if c != ""]
             # coords are innermost-first dims → numpy index is reversed
             idx = tuple(reversed(coords))[-arr.ndim:] if arr.ndim else ()

@@ -73,7 +73,8 @@ class TensorCrop(Element):
             if data.ndim == 4 and data.shape[0] == 1:
                 data = data[0]  # (H, W, C)
             datas.append(data)
-        regions = np.asarray(info.tensors[0]).reshape(-1, 4).astype(int)
+        regions = np.asarray(  # nns-lint: disable=NNS108 -- entry-materialized host payload (tensor_crop is not DEVICE_PASSTHROUGH)
+            info.tensors[0]).reshape(-1, 4).astype(int)
         crops = []
         # region-major: all data tensors cropped at region 0, then 1, ...
         for x, y, w, h in regions:

@@ -17,6 +17,7 @@ from nnstreamer_tpu.tensors.types import TensorsConfig, TensorsInfo
 @subplugin(ELEMENT, "tensor_demux")
 class TensorDemux(Element):
     ELEMENT_NAME = "tensor_demux"
+    DEVICE_PASSTHROUGH = True  # routes tensor subsets by reference
     PROPERTIES = {**Element.PROPERTIES, "tensorpick": None}
 
     def __init__(self, name=None, **props):

@@ -34,8 +34,15 @@ import numpy as np
 from nnstreamer_tpu.config import get_conf
 from nnstreamer_tpu.obs import get_registry
 from nnstreamer_tpu.filters.api import FilterFramework, FilterProperties
-from nnstreamer_tpu.pipeline.element import CustomEvent, Element, Event, Pad
+from nnstreamer_tpu.pipeline.element import (
+    CustomEvent,
+    Element,
+    Event,
+    Pad,
+    peer_device_capable,
+)
 from nnstreamer_tpu.registry import ELEMENT, FILTER, get_subplugin, subplugin
+from nnstreamer_tpu.tensors.buffer import DeviceBuffer, as_device_buffer
 from nnstreamer_tpu.tensors.types import (
     TensorsConfig,
     TensorsInfo,
@@ -71,6 +78,9 @@ def _parse_combination(spec: Optional[str]) -> Optional[List[tuple]]:
 @subplugin(ELEMENT, "tensor_filter")
 class TensorFilter(Element):
     ELEMENT_NAME = "tensor_filter"
+    #: device backends consume jax.Arrays as-is; for host-only backends
+    #: chain() below materializes via the sanctioned cached to_host
+    DEVICE_PASSTHROUGH = True
     PROPERTIES = {
         **Element.PROPERTIES,
         "framework": "auto",
@@ -283,6 +293,13 @@ class TensorFilter(Element):
             return None  # QoS drop (tensor_filter.c:426)
         fw = self.fw or self._open_fw()
 
+        if not fw.KEEP_ON_DEVICE and isinstance(buf, DeviceBuffer):
+            # host-only backend consuming a resident buffer: one cached
+            # materialization up front (reuses a prefetch queue's
+            # pre-upload host view when one rode along) instead of the
+            # per-tensor asarray below
+            buf = buf.to_host()
+
         in_comb = self._combination("input_combination")
         if in_comb is not None:
             model_inputs = [buf.tensors[i] for _, i in in_comb]
@@ -325,7 +342,12 @@ class TensorFilter(Element):
             # Host-only results with no stash skip the window entirely —
             # nothing is outstanding for them.
             self._window.admit(final, stash)
-        return self.srcpad.push(buf.with_tensors(final))
+        out_buf = buf.with_tensors(final)
+        if peer_device_capable(self.srcpad):
+            # device-capable downstream: keep the result resident (no-op
+            # for host outputs or when NNSTPU_RESIDENT=0)
+            out_buf = as_device_buffer(out_buf)
+        return self.srcpad.push(out_buf)
 
     # -- region fusion (pipeline/fuse.py) ------------------------------------
     def device_stage(self):

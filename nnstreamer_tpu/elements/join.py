@@ -17,6 +17,7 @@ from nnstreamer_tpu.registry import ELEMENT, subplugin
 @subplugin(ELEMENT, "join")
 class Join(Element):
     ELEMENT_NAME = "join"
+    DEVICE_PASSTHROUGH = True  # first-arrival selection, payload untouched
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)

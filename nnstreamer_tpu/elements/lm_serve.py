@@ -151,11 +151,11 @@ class TensorLMServe(Element):
         try:
             # query-wire payloads are host arrays by construction (the
             # protocol deserializes into numpy) — no device sync here
-            prompt = np.asarray(  # nns-lint: disable=NNS107 -- wire payload
+            prompt = np.asarray(  # nns-lint: disable=NNS107,NNS108 -- wire payload is host by construction
                 buf.tensors[0]).reshape(-1).astype(np.int32)
             max_new = int(self.get_property("max_new_tokens"))
             if len(buf.tensors) > 1:  # budget as payload (survives wire)
-                max_new = int(np.asarray(  # nns-lint: disable=NNS107 -- wire
+                max_new = int(np.asarray(  # nns-lint: disable=NNS107,NNS108 -- wire
                     buf.tensors[1]).reshape(-1)[0])
             max_new = int(buf.meta.get("lm_max_new", max_new))
             stream = self._engine.submit(prompt, max_new_tokens=max_new)

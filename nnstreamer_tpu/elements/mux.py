@@ -30,6 +30,7 @@ from nnstreamer_tpu.tensors.types import (
 @subplugin(ELEMENT, "tensor_mux")
 class TensorMux(Element):
     ELEMENT_NAME = "tensor_mux"
+    DEVICE_PASSTHROUGH = True  # collects/merges tensor lists by reference
     PROPERTIES = {**Element.PROPERTIES, "sync_mode": "slowest",
                   "sync_option": ""}
 

@@ -24,6 +24,7 @@ from nnstreamer_tpu.tensors.types import Fraction, TensorsConfig
 @subplugin(ELEMENT, "tensor_rate")
 class TensorRate(Element):
     ELEMENT_NAME = "tensor_rate"
+    DEVICE_PASSTHROUGH = True  # drops/duplicates whole buffers only
     PROPERTIES = {**Element.PROPERTIES, "framerate": None, "throttle": True,
                   "silent_drop": None}  # deprecated alias of `silent`
 

@@ -33,6 +33,9 @@ class TensorSink(Element):
     # element's materialization point rather than on pad entry, so upstream
     # queues can batch the D2H instead of each frame syncing eagerly
     HANDLES_DEFERRED = True
+    #: the chain below owns its materialization point (the sanctioned
+    #: to_host call) — entry must not force an extra copy first
+    DEVICE_PASSTHROUGH = True
 
     ELEMENT_NAME = "tensor_sink"
     PROPERTIES = {**Element.PROPERTIES, "sync": False, "max_stored": 4096,
@@ -48,6 +51,12 @@ class TensorSink(Element):
         #: end-to-end per-frame latencies in seconds (create_t → chain);
         #: ring-bounded so long-lived live pipelines don't grow forever
         self.latencies: deque = deque(maxlen=100_000)
+        #: latencies of frames ADMITTED by an upstream stamp_admission
+        #: queue (leaky ingress): the served-traffic population — under
+        #: saturation `latencies` still includes pre-admission backlog
+        #: wait, which measures the source's free-running pace, not the
+        #: pipeline's service time
+        self.admitted_latencies: deque = deque(maxlen=100_000)
         self._m_e2e = None  # lazy: labels need the owning pipeline's name
 
     def _obs_e2e(self):
@@ -117,6 +126,13 @@ class TensorSink(Element):
                 for t in stamps:
                     self.latencies.append(now - t)
                     hist.observe(now - t)
+            adm = buf.meta.get("admitted_t")
+            if adm is not None:
+                # one admission stamp covers the (possibly aggregated)
+                # buffer; count it once per constituent frame so the
+                # served population weighs frames like `latencies` does
+                for _ in range(max(len(stamps), 1)):
+                    self.admitted_latencies.append(now - adm)
         with self._cv:
             if len(self.buffers) < int(self.get_property("max_stored")):
                 self.buffers.append(buf)
@@ -125,12 +141,17 @@ class TensorSink(Element):
             cb(buf)
         return FlowReturn.OK
 
-    def latency_percentiles(self, *qs: float, skip: int = 0):
-        """End-to-end frame latency percentiles in ms (create→sink), the
-        queryable pipeline stat counterpart of the per-element
-        InvokeStats. Default (p50, p99). ``skip`` drops the first N
-        frames (warm-up exclusion for paced measurements)."""
-        vals = list(self.latencies)[skip:]
+    def latency_percentiles(self, *qs: float, skip: int = 0,
+                            base: str = "create"):
+        """End-to-end frame latency percentiles in ms, the queryable
+        pipeline stat counterpart of the per-element InvokeStats.
+        ``base="create"`` measures from the source capture stamp;
+        ``base="admitted"`` from the upstream stamp_admission queue's
+        accept point (served-traffic latency — None when no queue
+        stamps). Default (p50, p99). ``skip`` drops the first N frames
+        (warm-up exclusion for paced measurements)."""
+        pop = self.admitted_latencies if base == "admitted" else self.latencies
+        vals = list(pop)[skip:]
         if not vals:
             return None
         qs = qs or (50.0, 99.0)
@@ -163,6 +184,7 @@ class FileSink(Element):
     golden-output pattern: run pipeline, byte-compare the dump."""
 
     ELEMENT_NAME = "filesink"
+    DEVICE_PASSTHROUGH = True  # chain's own to_host is the fetch point
     PROPERTIES = {**Element.PROPERTIES, "location": None, "append": False}
 
     def __init__(self, name=None, **props):
@@ -207,6 +229,7 @@ class FakeSink(Element):
     """Discard buffers (gst fakesink); counts them for tests."""
 
     HANDLES_DEFERRED = True  # discards buffers; never forces the D2H
+    DEVICE_PASSTHROUGH = True  # ditto for resident payloads
 
     ELEMENT_NAME = "fakesink"
     PROPERTIES = {**Element.PROPERTIES, "sync": False}

@@ -19,6 +19,7 @@ from nnstreamer_tpu.tensors.buffer import is_device_array
 @subplugin(ELEMENT, "tensor_split")
 class TensorSplit(Element):
     ELEMENT_NAME = "tensor_split"
+    DEVICE_PASSTHROUGH = True  # slicing stays lazy on device arrays
     PROPERTIES = {**Element.PROPERTIES, "tensorseg": None, "dimension": 0}
 
     def __init__(self, name=None, **props):

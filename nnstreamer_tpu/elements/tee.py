@@ -14,6 +14,7 @@ from nnstreamer_tpu.registry import ELEMENT, subplugin
 @subplugin(ELEMENT, "tee")
 class Tee(Element):
     ELEMENT_NAME = "tee"
+    DEVICE_PASSTHROUGH = True  # pure fan-out: never reads tensor bytes
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)

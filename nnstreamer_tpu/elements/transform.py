@@ -153,6 +153,7 @@ class _TransformSpec:
 @subplugin(ELEMENT, "tensor_transform")
 class TensorTransform(Element):
     ELEMENT_NAME = "tensor_transform"
+    DEVICE_PASSTHROUGH = True  # device inputs take the jitted path
     PROPERTIES = {
         **Element.PROPERTIES,
         "mode": None,

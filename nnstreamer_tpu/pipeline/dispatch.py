@@ -94,7 +94,11 @@ class DispatchWindow:
     def admit(self, tensors: List[Any],
               stash: Optional[list] = None) -> None:
         """Register a just-dispatched batch; fence the oldest entries
-        until at most ``inflight`` remain outstanding."""
+        until at most ``inflight`` remain outstanding. Accepts a raw
+        tensor list or a whole (Device)Buffer — a device-resident input
+        arrived with no H2D stage and no pool stash, so its entry is
+        purely an ordering fence."""
+        tensors = getattr(tensors, "tensors", tensors)
         self._entries.append((list(tensors), stash))
         limit = self._inflight()
         while len(self._entries) > limit:
@@ -111,7 +115,10 @@ class DispatchWindow:
             hist.observe(time.monotonic() - t0)
         if stash:
             # the fenced dispatch (and the H2D feeding it) is complete:
-            # its pooled host staging buffers have no readers left
+            # its pooled host staging buffers have no readers left —
+            # except a stash array adopted as a DeviceBuffer's cached
+            # host view, which the pool keeps pinned (release refuses it)
+            # until that wrapper dies
             from nnstreamer_tpu.tensors.pool import get_pool
 
             get_pool().release_many(stash)

@@ -35,7 +35,11 @@ from typing import Any, Callable, Dict, List, Optional
 from nnstreamer_tpu.log import get_logger
 from nnstreamer_tpu.obs import get_registry
 from nnstreamer_tpu.pipeline.caps import ANY, Caps, CapsList
-from nnstreamer_tpu.tensors.buffer import TensorBuffer
+from nnstreamer_tpu.tensors.buffer import (
+    DeviceBuffer,
+    TensorBuffer,
+    record_residency_entry,
+)
 from nnstreamer_tpu.utils.stats import InvokeStats
 
 
@@ -184,6 +188,17 @@ class Pad:
 
     def __repr__(self):
         return f"Pad({self.element.name}.{self.name}:{self.direction.value})"
+
+
+def peer_device_capable(pad: "Pad") -> bool:
+    """True when the element behind ``pad``'s peer forwards device-resident
+    buffers without a host materialization at entry — emission sites
+    (fused regions, device filters) use this to decide whether wrapping
+    their output as a DeviceBuffer buys anything."""
+    peer = pad.peer
+    if peer is None:
+        return False
+    return bool(getattr(peer.element, "DEVICE_PASSTHROUGH", False))
 
 
 # --------------------------------------------------------------------------
@@ -345,6 +360,15 @@ class Element:
     #: order and ``chain_list`` consumes it in order.
     HANDLES_LIST = False
 
+    #: Elements that route/hold/compute without reading tensor bytes on the
+    #: host (queue, tee, mux, demux, split, aggregator, device-capable
+    #: filters/transforms, sinks with their own sanctioned fetch point) set
+    #: this True: a :class:`~nnstreamer_tpu.tensors.buffer.DeviceBuffer`
+    #: then crosses their pads without materializing. Everything else gets
+    #: the buffer host-materialized at pad entry — one sanctioned
+    #: ``to_host()`` whose cost lands in that element's chain stats.
+    DEVICE_PASSTHROUGH = False
+
     def _obs_labels(self) -> Dict[str, str]:
         """Stable metric labels: ``{pipeline=..., element=...}`` (the
         ``nns_<element>_<metric>`` naming scheme's label half)."""
@@ -378,7 +402,19 @@ class Element:
         t0 = _time.monotonic()
         try:
             try:
-                if buf.finalize is not None and not self.HANDLES_DEFERRED:
+                if isinstance(buf, DeviceBuffer):
+                    # a resident buffer stays resident across elements that
+                    # declared passthrough (finalize-free payloads) or that
+                    # keep deferred work lazy (they own their fetch point,
+                    # so device payloads cross them untouched, exactly as
+                    # before residency); otherwise this entry is the
+                    # sanctioned (cached) materialization point
+                    resident = self.HANDLES_DEFERRED or (
+                        self.DEVICE_PASSTHROUGH and buf.finalize is None)
+                    record_residency_entry(resident)
+                    if not resident:
+                        buf = buf.to_host()
+                elif buf.finalize is not None and not self.HANDLES_DEFERRED:
                     # blocking D2H + host finalize — inside the timed span
                     # so the element paying the sync is the one whose
                     # stats show it
@@ -405,9 +441,18 @@ class Element:
         t0 = _time.monotonic()
         try:
             try:
-                if not self.HANDLES_DEFERRED:
-                    bufs = [b.to_host() if b.finalize is not None else b
-                            for b in bufs]
+                entered = []
+                for b in bufs:
+                    if isinstance(b, DeviceBuffer):
+                        resident = self.HANDLES_DEFERRED or (
+                            self.DEVICE_PASSTHROUGH and b.finalize is None)
+                        record_residency_entry(resident)
+                        if not resident:
+                            b = b.to_host()
+                    elif b.finalize is not None and not self.HANDLES_DEFERRED:
+                        b = b.to_host()
+                    entered.append(b)
+                bufs = entered
                 ret = self.chain_list(pad, bufs)
             except FlowError:
                 raise

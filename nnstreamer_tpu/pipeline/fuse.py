@@ -35,7 +35,9 @@ from nnstreamer_tpu.pipeline.element import (
     Event,
     FlowError,
     Pad,
+    peer_device_capable,
 )
+from nnstreamer_tpu.tensors.buffer import as_device_buffer
 
 log = get_logger("fuse")
 
@@ -108,6 +110,9 @@ class FusedRegion(Element):
     #: each buffer dispatches immediately (async), the dispatch window
     #: paces the batch, so a backlog becomes back-to-back device work
     HANDLES_LIST = True
+    #: the jitted program consumes jax.Arrays directly — a DeviceBuffer
+    #: input skips H2D staging and the ingest pool entirely
+    DEVICE_PASSTHROUGH = True
     PROPERTIES = {**Element.PROPERTIES, "inflight": 2}
 
     def __init__(self, members: Sequence[Element], name=None, **props):
@@ -277,6 +282,12 @@ class FusedRegion(Element):
         out_buf = buf.with_tensors(list(out))
         if finalize is not None:
             out_buf = out_buf.replace(finalize=finalize)
+        if peer_device_capable(self.srcpad):
+            # downstream forwards resident buffers — emit a DeviceBuffer so
+            # region→queue→region chains cross zero host copies (a
+            # non-capable peer gets the plain buffer and materializes at
+            # its own pace, exactly the pre-residency behavior)
+            out_buf = as_device_buffer(out_buf)
         return self.srcpad.push(out_buf)
 
     def _fallback(self, buf):

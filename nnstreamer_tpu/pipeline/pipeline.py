@@ -120,8 +120,17 @@ class Queue(Element):
 
     ELEMENT_NAME = "queue"
     HANDLES_DEFERRED = True  # pure hand-off: finalize stays lazy across it
+    DEVICE_PASSTHROUGH = True  # never reads tensor bytes on the host
     PROPERTIES = {**Element.PROPERTIES, "max_size_buffers": 16, "leaky": "no",
                   "prefetch_host": False, "prefetch_device": False,
+                  # stamp_admission: record meta["admitted_t"] when a buffer
+                  # is accepted into the FIFO. A leaky ingress queue is the
+                  # admission-control point of a saturated pipeline: sinks
+                  # report latency from this stamp (base="admitted") so the
+                  # saturation-phase p99 measures service time of frames the
+                  # pipeline actually served, not the unbounded backlog wait
+                  # a free-running source builds before the drop point.
+                  "stamp_admission": False,
                   # materialize_host: drain in groups and hand HOST buffers
                   # downstream (one overlapped D2H flush per backlog; the
                   # deferred finalize is applied here). For sink-bound
@@ -263,10 +272,17 @@ class Queue(Element):
                 # the previous frame's compute; on a tunneled chip the
                 # per-call transfer RPC otherwise serializes into every
                 # dispatch)
+                from nnstreamer_tpu.tensors.buffer import as_device_buffer
                 from nnstreamer_tpu.tensors.pool import get_pool
 
                 stash = [t for t in buf.tensors if get_pool().owns(t)]
+                host_src = list(buf.tensors)
                 buf = buf.to_device()
+                # the uploaded copy is the payload from here on; the
+                # pre-upload host arrays become the wrapper's zero-copy
+                # host view (a later to_host costs nothing), and any
+                # pool-owned ones are pinned against explicit release
+                buf = as_device_buffer(buf, host_view=host_src)
                 if stash:
                     # pooled staging arrays must survive until the
                     # dispatch that consumes the uploaded copies has
@@ -285,6 +301,8 @@ class Queue(Element):
             # link; the zero rows are synthesized on device now
             if buf.meta.get("pad_rows"):
                 buf = buf.pad_rows_device()
+        if self.get_property("stamp_admission"):
+            buf.meta.setdefault("admitted_t", time.monotonic())
         if self._worker is None:  # not started: degenerate passthrough
             return self.srcpad.push(buf)
         if self.get_property("leaky") == "downstream":

@@ -17,7 +17,10 @@ the query client id used by the distributed serversink to route results
 from __future__ import annotations
 
 import dataclasses
+import os
+import threading
 import time
+import weakref
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -29,6 +32,100 @@ from nnstreamer_tpu.tensors.types import (
 
 #: Sentinel for "no timestamp" (reference GST_CLOCK_TIME_NONE).
 CLOCK_NONE: Optional[int] = None
+
+
+def residency_enabled() -> bool:
+    """Global off-switch for the device-residency layer. With
+    ``NNSTPU_RESIDENT=0`` no :class:`DeviceBuffer` is ever created and
+    every element sees plain host-materialized buffers, which is the
+    byte-equality reference the residency tests compare against."""
+    return os.environ.get("NNSTPU_RESIDENT", "1").strip().lower() not in (
+        "0", "false", "no", "off"
+    )
+
+
+# -- transfer accounting ------------------------------------------------------
+# Process-wide tallies of explicit host<->device copies plus the pad-entry
+# residency split, mirrored into obs/ as nns_transfer_h2d_bytes_total /
+# nns_transfer_d2h_bytes_total counters and the nns_buffer_resident_ratio
+# gauge. bench.py reads transfer_snapshot() deltas per run (d2h_per_frame).
+_xfer_lock = threading.Lock()
+_xfer: Dict[str, float] = {
+    "h2d_bytes": 0.0, "h2d_events": 0.0,
+    "d2h_bytes": 0.0, "d2h_events": 0.0,
+    "resident_entries": 0.0, "materialized_entries": 0.0,
+}
+_xfer_metrics: Optional[Dict[str, Any]] = None
+
+
+def _xfer_obs() -> Dict[str, Any]:
+    global _xfer_metrics
+    if _xfer_metrics is None:
+        from nnstreamer_tpu.obs import get_registry
+
+        reg = get_registry()
+        _xfer_metrics = {
+            "h2d": reg.counter(
+                "nns_transfer_h2d_bytes_total",
+                "Bytes explicitly uploaded host->device "
+                "(TensorBuffer.to_device)"),
+            "d2h": reg.counter(
+                "nns_transfer_d2h_bytes_total",
+                "Bytes explicitly materialized device->host (to_host)"),
+        }
+        reg.gauge(
+            "nns_buffer_resident_ratio",
+            "Fraction of DeviceBuffer pad entries forwarded without host "
+            "materialization",
+            fn=lambda: resident_ratio() or 0.0)
+    return _xfer_metrics
+
+
+def _record_h2d(nbytes: int) -> None:
+    if nbytes <= 0:
+        return
+    _xfer_obs()["h2d"].inc(nbytes)
+    with _xfer_lock:
+        _xfer["h2d_bytes"] += nbytes
+        _xfer["h2d_events"] += 1
+
+
+def _record_d2h(nbytes: int) -> None:
+    if nbytes <= 0:
+        return
+    _xfer_obs()["d2h"].inc(nbytes)
+    with _xfer_lock:
+        _xfer["d2h_bytes"] += nbytes
+        _xfer["d2h_events"] += 1
+
+
+def record_residency_entry(resident: bool) -> None:
+    """Tally one DeviceBuffer pad entry: ``resident`` means the element
+    declared DEVICE_PASSTHROUGH and the buffer crossed the pad without a
+    host copy (the numerator of ``nns_buffer_resident_ratio``)."""
+    _xfer_obs()  # the gauge is registered with the counters
+    with _xfer_lock:
+        key = "resident_entries" if resident else "materialized_entries"
+        _xfer[key] += 1
+
+
+def resident_ratio() -> Optional[float]:
+    with _xfer_lock:
+        r = _xfer["resident_entries"]
+        m = _xfer["materialized_entries"]
+    total = r + m
+    return (r / total) if total else None
+
+
+def transfer_snapshot() -> Dict[str, float]:
+    """Copy of the cumulative transfer tallies (bytes + event counts +
+    entry split); callers diff two snapshots for per-run numbers."""
+    with _xfer_lock:
+        return dict(_xfer)
+
+
+def _device_nbytes(t) -> int:
+    return int(np.prod(t.shape, dtype=np.int64)) * np.dtype(t.dtype).itemsize
 
 
 import functools
@@ -135,9 +232,15 @@ class TensorBuffer:
     def to_host(self) -> "TensorBuffer":
         """Materialize all tensors as numpy arrays (blocking D2H if needed),
         then apply the deferred ``finalize`` hook if one is attached."""
-        out = []
+        out, moved = [], 0
         for t in self.tensors:
-            out.append(np.asarray(t) if not isinstance(t, np.ndarray) else t)
+            if isinstance(t, np.ndarray):
+                out.append(t)
+            else:
+                out.append(np.asarray(t))
+                moved += _device_nbytes(t)
+        if moved:
+            _record_d2h(moved)
         buf = self.replace(tensors=out, finalize=None)
         if self.finalize is not None:
             buf = self.finalize(buf)
@@ -148,8 +251,12 @@ class TensorBuffer:
         import jax
 
         tgt = sharding if sharding is not None else device
+        moved = sum(_device_nbytes(t) for t in self.tensors
+                    if not is_device_array(t))
         out = [jax.device_put(t, tgt) if tgt is not None else jax.device_put(t)
                for t in self.tensors]
+        if moved:
+            _record_h2d(moved)
         return self.replace(tensors=out)
 
     def pad_rows_device(self) -> "TensorBuffer":
@@ -199,3 +306,119 @@ class TensorBuffer:
         )
         dev = "dev" if self.on_device() else "host"
         return f"TensorBuffer([{shapes}] {dev} pts={self.pts})"
+
+
+# -- device residency ---------------------------------------------------------
+def _unpin_tokens(tokens) -> None:
+    """weakref.finalize target for a dead DeviceBuffer's pinned host-view
+    slabs (module-level so the finalizer holds no reference to the buffer)."""
+    from nnstreamer_tpu.tensors.pool import get_pool
+
+    pool = get_pool()
+    for t in tokens:
+        pool.unpin(t)
+
+
+class DeviceBuffer(TensorBuffer):
+    """A device-resident frame: live ``jax.Array`` payloads that cross pad
+    boundaries without touching the host.
+
+    Elements that declare ``DEVICE_PASSTHROUGH`` forward these untouched;
+    everything else gets a host-materialized copy at pad entry (see
+    ``Element._chain_entry``). The host side is *lazy and cached*:
+
+    - the first :meth:`to_host` call is the one sanctioned D2H site (lint
+      NNS108) — it materializes once, applies ``finalize``, and caches;
+      every later call returns the SAME host buffer object;
+    - a ``host_view`` — the pre-upload host arrays a prefetching queue
+      already holds — makes that first call a zero-copy re-wrap. Pool-owned
+      host-view arrays are *pinned* so an explicit ``BufferPool.release``
+      (sink/dispatch fence) can never recycle a slab this cache still
+      reads; the pin lifts when the wrapper itself dies.
+    """
+
+    def __init__(self, tensors=None, pts=None, dts=None, duration=None,
+                 meta=None, finalize=None, host_view=None):
+        super().__init__(tensors=list(tensors or []), pts=pts, dts=dts,
+                         duration=duration, meta=dict(meta or {}),
+                         finalize=finalize)
+        self._host_cache: Optional[TensorBuffer] = None
+        self._host_src: Optional[List[Any]] = None
+        if host_view is not None and len(host_view) == len(self.tensors):
+            self._adopt_host_view(list(host_view))
+
+    def _adopt_host_view(self, host: List[Any]) -> None:
+        from nnstreamer_tpu.tensors.pool import get_pool
+
+        self._host_src = host
+        pool = get_pool()
+        tokens = tuple(id(a) for a in host if pool.pin(a))
+        if tokens:
+            weakref.finalize(self, _unpin_tokens, tokens)
+
+    def to_host(self) -> TensorBuffer:
+        """The sanctioned materialization point: one D2H (or zero, when a
+        pre-upload host view was adopted), finalize applied once, result
+        cached and shared by every later caller."""
+        cached = self._host_cache
+        if cached is not None:
+            return cached
+        if self._host_src is not None:
+            host = list(self._host_src)  # zero-copy: pre-upload bytes
+        else:
+            host, moved = [], 0
+            for t in self.tensors:
+                if isinstance(t, np.ndarray):
+                    host.append(t)
+                else:
+                    host.append(np.asarray(t))
+                    moved += _device_nbytes(t)
+            if moved:
+                _record_d2h(moved)
+        buf = TensorBuffer(tensors=host, pts=self.pts, dts=self.dts,
+                           duration=self.duration, meta=dict(self.meta),
+                           finalize=None)
+        if self.finalize is not None:
+            buf = self.finalize(buf)
+        self._host_cache = buf
+        return buf
+
+    def replace(self, **kw) -> TensorBuffer:
+        """Stays a :class:`DeviceBuffer` while the payload stays on device
+        (so routing elements' ``replace()``/``with_tensors()`` don't
+        silently demote residency); an unchanged payload keeps the adopted
+        host view. The materialized-host cache is never carried over —
+        meta/finalize edits would make it stale."""
+        fields = dict(
+            tensors=list(self.tensors),
+            pts=self.pts,
+            dts=self.dts,
+            duration=self.duration,
+            meta=dict(self.meta),
+            finalize=self.finalize,
+        )
+        fields.update(kw)
+        tensors = fields["tensors"]
+        if tensors and all(is_device_array(t) for t in tensors):
+            host_view = self._host_src if "tensors" not in kw else None
+            return DeviceBuffer(host_view=host_view, **fields)
+        return TensorBuffer(**fields)
+
+    def __repr__(self):
+        base = super().__repr__()
+        state = ("view" if self._host_src is not None else
+                 "cached" if self._host_cache is not None else "lazy")
+        return base.replace("TensorBuffer(", f"DeviceBuffer(host={state} ", 1)
+
+
+def as_device_buffer(buf: TensorBuffer, host_view=None) -> TensorBuffer:
+    """Wrap an all-device buffer as a :class:`DeviceBuffer`; returns the
+    input unchanged when residency is disabled, the payload is not fully
+    on device, or it is already wrapped."""
+    if isinstance(buf, DeviceBuffer) or not residency_enabled():
+        return buf
+    if not buf.on_device():
+        return buf
+    return DeviceBuffer(tensors=buf.tensors, pts=buf.pts, dts=buf.dts,
+                        duration=buf.duration, meta=buf.meta,
+                        finalize=buf.finalize, host_view=host_view)

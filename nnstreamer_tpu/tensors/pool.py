@@ -70,6 +70,12 @@ class BufferPool:
         self._free: Dict[int, List[np.ndarray]] = {}
         #: id(view) → (class, slab, finalizer) for live pool-owned views
         self._out: Dict[int, Tuple[int, np.ndarray, Any]] = {}
+        #: id(view) → pin count: views adopted as a DeviceBuffer's cached
+        #: host view; explicit release is refused while pinned (the
+        #: refcount guard alone cannot see the cache — the cache keeps the
+        #: *view* alive, so the view's own `.base` still accounts for the
+        #: slab ref the guard expects from a dying array)
+        self._pinned: Dict[int, int] = {}
         self.hits = 0
         self.misses = 0
         self.grows = 0
@@ -147,6 +153,9 @@ class BufferPool:
         import sys
 
         with self._lock:
+            # the view is dead, so any pin on it is moot (wrapper and view
+            # can die in the same GC pass, finalizer order undefined)
+            self._pinned.pop(token, None)
             entry = self._out.pop(token, None)
             if entry is None:
                 return
@@ -167,13 +176,43 @@ class BufferPool:
         with self._lock:
             return id(arr) in self._out
 
+    def pin(self, arr) -> bool:
+        """Pin a pool-owned view against explicit release: a DeviceBuffer
+        adopted it as its lazy host-view cache, so the usual "the staging
+        array is dead after the fence" contract no longer holds — the
+        sink/dispatch release sites must NOT hand its slab to the next
+        acquire while the cache can still be read. A pinned view's slab
+        only recycles through the GC fallback once the view truly dies.
+        Returns False (no-op) for arrays this pool does not own."""
+        with self._lock:
+            token = id(arr)
+            if token not in self._out:
+                return False
+            self._pinned[token] = self._pinned.get(token, 0) + 1
+            return True
+
+    def unpin(self, token: int) -> None:
+        """Drop one pin (the adopting wrapper died). ``token`` is the
+        ``id()`` of the pinned view — the wrapper's finalizer cannot hold
+        the array itself."""
+        with self._lock:
+            n = self._pinned.get(token, 0)
+            if n <= 1:
+                self._pinned.pop(token, None)
+            else:
+                self._pinned[token] = n - 1
+
     def release(self, arr) -> bool:
         """Explicitly return ``arr``'s slab to the free list. Only call
         when no other reader (host or in-flight device transfer) can
-        still touch the memory. Unknown arrays are ignored (False)."""
+        still touch the memory. Unknown arrays are ignored (False).
+        Pinned arrays (a DeviceBuffer host-view cache reads them) are
+        refused — their slab recycles via GC when the view dies."""
         import sys
 
         with self._lock:
+            if id(arr) in self._pinned:
+                return False
             entry = self._out.pop(id(arr), None)
             if entry is None:
                 return False
@@ -204,9 +243,11 @@ class BufferPool:
         with self._lock:
             free = sum(len(v) for v in self._free.values())
             out = len(self._out)
+            pinned = len(self._pinned)
         rate = self.hit_rate()
         return {"hits": self.hits, "misses": self.misses,
                 "grows": self.grows, "outstanding": out, "free": free,
+                "pinned": pinned,
                 "hit_rate": None if rate is None else round(rate, 4)}
 
     def clear(self) -> None:

@@ -9,7 +9,12 @@ CPU pipeline (the EdgeTPU `device_type:dummy` pattern). Gates:
   byte-identical per-frame outputs in the same order;
 - the metrics endpoint exports the overlap series
   (``nns_filter_inflight``, ``nns_filter_fence_wait_seconds``,
-  ``nns_pool_*``, ``nns_queue_drain_size``).
+  ``nns_pool_*``, ``nns_queue_drain_size``) and the residency series
+  (``nns_transfer_h2d_bytes_total``, ``nns_transfer_d2h_bytes_total``,
+  ``nns_buffer_resident_ratio``);
+- the device-resident tensor plane keeps the smoke pipeline's D2H
+  traffic at its floor: at most one materialization per sink-delivered
+  frame (``d2h_per_frame`` ≤ number of sinks).
 """
 
 import re
@@ -22,6 +27,7 @@ from nnstreamer_tpu.filters.jax_backend import (
     is_jax_model_registered,
     register_jax_model,
 )
+from nnstreamer_tpu.tensors.buffer import transfer_snapshot
 
 DESC = (
     "videotestsrc pattern=ball num-buffers=12 width=16 height=16 ! "
@@ -111,5 +117,24 @@ def test_metrics_endpoint_exports_overlap_series():
                    "nns_pool_hits_total",
                    "nns_pool_misses_total",
                    "nns_queue_drain_size",
-                   "nns_fuse_retraces_total"):
+                   "nns_fuse_retraces_total",
+                   "nns_transfer_h2d_bytes_total",
+                   "nns_transfer_d2h_bytes_total",
+                   "nns_buffer_resident_ratio"):
         assert series in body, f"{series} missing from /metrics"
+
+
+def test_d2h_per_frame_at_floor():
+    """The residency plane's whole point: with every element between the
+    upload queue and the sink device-passthrough, the ONLY D2H events a
+    run may add are the sink's per-frame materializations — one per
+    delivered frame per sink (this pipeline has exactly one sink)."""
+    before = transfer_snapshot()
+    _pipe, outs = _run(inflight=2)
+    after = transfer_snapshot()
+    frames = len(outs)
+    assert frames == 3
+    d2h_per_frame = (after["d2h_events"] - before["d2h_events"]) / frames
+    assert d2h_per_frame <= 1.0, d2h_per_frame
+    # and the run actually exercised the resident path
+    assert after["resident_entries"] > before["resident_entries"]

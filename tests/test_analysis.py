@@ -306,6 +306,42 @@ class TestAstLint:
                "        buf.tensors[0])\n")
         assert by_code(lint_source(src, "x.py"), "NNS107") == []
 
+    def test_nns108_direct_tensor_materialization(self):
+        src = ("import numpy as np\n"
+               "def render(buf):\n"
+               "    return np.asarray(buf.tensors[0])\n")
+        assert "NNS108" in codes(lint_source(src, "x.py"))
+
+    def test_nns108_device_get_and_addressable_data(self):
+        src = ("import jax\n"
+               "def render(buf):\n"
+               "    a = jax.device_get(buf.tensors)\n"
+               "    b = buf.tensors[0].addressable_data(0)\n")
+        assert by_code(lint_source(src, "x.py"), "NNS108") != []
+        assert len(by_code(lint_source(src, "x.py"), "NNS108")) == 2
+
+    def test_nns108_loose_array_ok(self):
+        # np.asarray on a plain local array is NNS107's business (hot
+        # paths only), never NNS108's
+        src = ("import numpy as np\n"
+               "def render(x):\n"
+               "    return np.asarray(x)\n")
+        assert by_code(lint_source(src, "x.py"), "NNS108") == []
+
+    def test_nns108_sanctioned_to_host_ok(self):
+        src = ("import numpy as np\n"
+               "def to_host(self):\n"
+               "    return np.asarray(self.tensors[0])\n")
+        assert by_code(lint_source(src, "x.py"), "NNS108") == []
+
+    def test_nns108_pragma_suppressible(self):
+        src = ("import numpy as np\n"
+               "def render(buf):\n"
+               "    return np.asarray(  # nns-lint: disable=NNS108 -- "
+               "host payload by construction\n"
+               "        buf.tensors[0])\n")
+        assert by_code(lint_source(src, "x.py"), "NNS108") == []
+
     def test_pragma_suppresses_with_reason(self):
         src = ("import time\n"
                "d = time.time()  # nns-lint: disable=NNS101 -- epoch "
