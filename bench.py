@@ -60,6 +60,7 @@ How to read the bound fields (the report's own limiter analysis):
 
 from __future__ import annotations
 
+import gc
 import json
 import os
 import sys
@@ -96,6 +97,21 @@ BATCH = int(os.environ.get("BENCH_BATCH", "8"))
 #: K device batches may be outstanding before the producer fences, so the
 #: host prepares batch N+1 while the chip runs batch N. 0 = synchronous.
 INFLIGHT = int(os.environ.get("BENCH_INFLIGHT", "2"))
+
+#: parallel ingest lanes (pipeline/lanes.py): the replicable pre-queue
+#: host segment runs across N worker lanes with in-order reassembly.
+#: Applies to the flagship AND the interleaved ingest-ceiling probe
+#: (identical topology contract), so ingest_bound_fps is recomputed
+#: under the same lane count the flagship runs with. NNSTPU_LANES
+#: overrides; 1 restores the serial ingest path.
+LANES = int(os.environ.get("BENCH_LANES", "4"))
+
+#: fixed-length warmup drain (buffers of `batch` frames) run once before
+#: the measured repeats: absorbs the jit compile, tunnel stream setup,
+#: pool/lane-arena priming and the first fused-region trace so run 1 of
+#: the repeat loop starts from the same steady state as run N — the
+#: other half (with the gc fence in _collect) of taming spread_warm
+WARMUP_DRAIN = int(os.environ.get("BENCH_WARMUP_DRAIN", "4"))
 
 
 def _device_fence() -> None:
@@ -255,6 +271,7 @@ def build_pipeline(batch: int = BATCH, live_fps: int = 0,
         f"queue max-size-buffers={drain_n} materialize-host=true ! "
         "tensor_sink name=sink to-host=true"
     )
+    pipe.lanes = LANES
     return pipe
 
 
@@ -533,7 +550,19 @@ class _Arrivals(list):
 def _collect(pipe, sink_name="sink", timeout=600):
     frame_t = _Arrivals()
     pipe.get(sink_name).connect(lambda b: frame_t.append(time.monotonic()))
-    msg = pipe.run(timeout=timeout)
+    # gc fence around the timed region: collect the inter-run garbage NOW
+    # (previous pipeline graphs, drained buffers) and keep the cyclic
+    # collector from firing mid-run — observed warm-run spread (1.19)
+    # correlates with collector pauses landing inside some windows and
+    # not others. Refcount-driven finalizers (pool slab recycling) are
+    # unaffected. gc.enable() unconditionally is correct here: the bench
+    # process never runs with the collector deliberately off.
+    gc.collect()
+    gc.disable()
+    try:
+        msg = pipe.run(timeout=timeout)
+    finally:
+        gc.enable()
     if msg is None or msg.kind != "eos":
         raise RuntimeError(f"bench pipeline failed: {msg}")
     # end-of-run device fence + per-run interleave guard: EOS drains the
@@ -1143,6 +1172,12 @@ def main():
         _emit(EXTRA_CONFIGS[config]())
         return
 
+    # fixed-length warmup drain (WARMUP_DRAIN buffers): compile, tunnel
+    # stream setup, fused-region trace and pool/lane-arena priming all
+    # land here, off the clock, so the repeat loop below measures only
+    # steady state. fps_cold still reports run 1 separately — after this
+    # drain its remaining "coldness" is link weather, not compile.
+    _collect(build_pipeline(BATCH, n_frames=WARMUP_DRAIN * BATCH))
     # each flagship run is paired with an ingest-ceiling sample from the
     # SAME weather window: norm_runs = fps/ceiling is the
     # tunnel-insensitive score (spread target <0.2 where raw fps spreads
@@ -1197,6 +1232,7 @@ def main():
         "vs_baseline": round(stats["fps"] / baseline, 3),
         "batch": BATCH,
         "inflight": INFLIGHT,
+        "lanes": _effective_lanes(),
         "pool_hit_rate": _pool_hit_rate(),
         # end-to-end per-frame latency under 30 fps realtime pacing (the
         # north-star latency); the *_sat_* fields are the same measurement
@@ -1264,6 +1300,17 @@ def _resident_ratio():
         return None if r is None else round(r, 3)
     except Exception:  # noqa: BLE001 — informative field only
         return None
+
+
+def _effective_lanes() -> int:
+    """The lane count the runs actually used (NNSTPU_LANES overrides
+    BENCH_LANES — pipeline/lanes.py)."""
+    try:
+        from nnstreamer_tpu.pipeline.lanes import effective_lanes
+
+        return effective_lanes(LANES)
+    except Exception:  # noqa: BLE001 — informative field only
+        return LANES
 
 
 def _pool_hit_rate():

@@ -30,6 +30,12 @@ These rules encode invariants this codebase has already been burned by
   ``DeviceBuffer`` caches its host view there, so a direct fetch copies
   the same bytes again AND dodges the transfer counters the bench and
   the ``nns_buffer_resident_ratio`` gauge rely on.
+- NNS109: a class that declares ``REORDER_SAFE = True`` while its
+  per-frame ``chain``/``chain_list`` mutates ``self`` state: the ingest
+  lane planner (``pipeline/lanes.py``) replicates such elements across
+  parallel worker lanes and processes frames out of order — per-frame
+  mutable attributes make each lane's clone diverge from the serial
+  element, so the "byte-identical to lanes=1" contract silently breaks.
 
 Findings are suppressed per-line with::
 
@@ -179,6 +185,10 @@ class _FileLinter(ast.NodeVisitor):
 
     def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
         self._rule_nns104(node)
+        self.generic_visit(node)
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._rule_nns109(node)
         self.generic_visit(node)
 
     # -- rules ---------------------------------------------------------------
@@ -333,6 +343,74 @@ class _FileLinter(ast.NodeVisitor):
             f"miss the fetch",
             hint="call buf.to_host() (cached, counted) or justify a "
                  "host-only payload with a pragma")
+
+    def _rule_nns109(self, node: ast.ClassDef) -> None:
+        declares = False
+        for stmt in node.body:
+            targets: List[ast.AST] = []
+            if isinstance(stmt, ast.Assign):
+                targets = stmt.targets
+            elif isinstance(stmt, ast.AnnAssign):
+                targets = [stmt.target]
+            else:
+                continue
+            value = stmt.value
+            if any(isinstance(t, ast.Name) and t.id == "REORDER_SAFE"
+                   for t in targets) and \
+                    isinstance(value, ast.Constant) and value.value is True:
+                declares = True
+                break
+        if not declares:
+            return
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and stmt.name in ("chain", "chain_list"):
+                for mut, what in self._self_mutations(stmt):
+                    self.emit(
+                        "NNS109", mut,
+                        f"{node.name} declares REORDER_SAFE but its "
+                        f"per-frame {stmt.name}() mutates {what} — lane "
+                        f"clones processing frames out of order will "
+                        f"diverge from the serial element",
+                        hint="drop the REORDER_SAFE flag, move the state "
+                             "out of the per-frame path, or justify a "
+                             "frame-order-independent mutation with a "
+                             "pragma")
+
+    @staticmethod
+    def _self_mutations(func: ast.AST):
+        """(node, description) for each per-frame ``self`` state mutation
+        in a chain body: attribute (re)binds (``self.x = ...``,
+        ``self.x += ...``), subscript stores (``self.d[k] = ...``), and
+        in-place container calls (``self.acc.append(...)``)."""
+        mutators = {"append", "extend", "add", "update", "pop", "clear",
+                    "insert", "setdefault", "appendleft", "popleft",
+                    "remove", "discard"}
+
+        def _is_self_attr(n: ast.AST) -> bool:
+            return (isinstance(n, ast.Attribute)
+                    and isinstance(n.value, ast.Name)
+                    and n.value.id == "self")
+
+        for sub in ast.walk(func):
+            targets: List[ast.AST] = []
+            if isinstance(sub, ast.Assign):
+                targets = sub.targets
+            elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                targets = [sub.target]
+            elif isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr in mutators and \
+                    _is_self_attr(sub.func.value):
+                yield sub, (f"self.{sub.func.value.attr}"
+                            f".{sub.func.attr}(...)")
+                continue
+            for t in targets:
+                if _is_self_attr(t):
+                    yield sub, f"self.{t.attr}"
+                elif isinstance(t, ast.Subscript) and \
+                        _is_self_attr(t.value):
+                    yield sub, f"self.{t.value.attr}[...]"
 
     @staticmethod
     def _touches_buffer_tensors(arg: ast.AST) -> bool:

@@ -242,6 +242,12 @@ def main(argv=None) -> int:
                          "(tensor_filter and fused regions); 0 forces "
                          "fully synchronous dispatch, the default is 2 "
                          "(see docs/profiling.md, Overlap tuning)")
+    ap.add_argument("--lanes", type=int, default=None, metavar="N",
+                    help="run the replicable pre-queue ingest segment "
+                         "across N parallel worker lanes with in-order "
+                         "reassembly (byte-identical output); 1 is the "
+                         "serial path, NNSTPU_LANES overrides (see "
+                         "docs/profiling.md, Ingest scaling)")
     args = ap.parse_args(argv)
 
     if args.confchk:
@@ -295,6 +301,8 @@ def main(argv=None) -> int:
         for el in pipe.elements:
             if "inflight" in el._props:
                 el.set_property("inflight", max(0, args.inflight))
+    if args.lanes is not None:
+        pipe.lanes = max(1, args.lanes)
 
     if args.verbose:
         for el in pipe.elements:
@@ -361,6 +369,10 @@ def _print_stats(pipe) -> None:
         print(f"-- ingest pool: hit-rate {pool['hit_rate']:.1%} "
               f"({pool['hits']} hits / {pool['misses']} misses, "
               f"{pool['outstanding']} outstanding)")
+    for name, s in (full.get("lanes") or {}).items():
+        print(f"-- ingest lanes {name}: {s['lanes']} lanes, "
+              f"{s['forwarded']} frames, {s['ingest_fps']:.0f} fps, "
+              f"reorder stall {s.get('reorder_stall_s', 0.0):.3f}s")
 
 
 if __name__ == "__main__":

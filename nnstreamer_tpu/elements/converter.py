@@ -61,6 +61,18 @@ class TensorConverter(Element):
         self._custom = None
         self._frame_idx = 0
 
+    def reorder_safe(self):
+        # per-buffer conversion regimes (frames_per_tensor=1, no octet
+        # re-chunking via input_dim, no custom adapter) map each input
+        # buffer to exactly one output buffer with no cross-frame state
+        # (_pending/_frame_acc stay empty, _frame_idx is unused when the
+        # upstream source stamps pts) — replicable across lanes. The
+        # batching/re-chunking regimes fold multiple frames and must see
+        # the stream in order.
+        return (int(self.get_property("frames_per_tensor") or 1) <= 1
+                and not self.get_property("mode")
+                and not self.get_property("input_dim"))
+
     # -- negotiation ---------------------------------------------------------
     def transform_caps(self, pad, caps):
         self._in_caps = caps

@@ -7,6 +7,7 @@ are used throughout the reference's SSAT golden tests (dump + byte-compare).
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from collections import deque
@@ -17,6 +18,47 @@ import numpy as np
 from nnstreamer_tpu.pipeline.element import Element, EosEvent, FlowReturn
 from nnstreamer_tpu.registry import ELEMENT, subplugin
 from nnstreamer_tpu.tensors.buffer import TensorBuffer
+
+
+class LatencyReservoir:
+    """Bounded latency sample population with exact percentiles up to
+    ``cap`` and uniform reservoir sampling (Vitter's algorithm R) beyond.
+
+    A ``deque(maxlen=N)`` is a *sliding window*: on a long run it
+    silently discards the oldest samples and the reported p50/p99 drift
+    toward recent traffic only. A reservoir keeps every sample equally
+    likely to be in the population regardless of stream length, so the
+    percentiles describe the WHOLE run at O(cap) memory — and below the
+    cap the population is complete, so percentiles are exact. The RNG is
+    seeded so repeated runs of a deterministic pipeline report identical
+    stats."""
+
+    __slots__ = ("cap", "count", "_vals", "_rng")
+
+    def __init__(self, cap: int = 65_536, seed: int = 0x5EED):
+        self.cap = int(cap)
+        self.count = 0  # samples OFFERED (not retained) — honest stream n
+        self._vals: List[float] = []
+        self._rng = random.Random(seed)
+
+    def append(self, v: float) -> None:
+        self.count += 1
+        if len(self._vals) < self.cap:
+            self._vals.append(v)
+            return
+        j = self._rng.randrange(self.count)
+        if j < self.cap:
+            self._vals[j] = v
+
+    def __len__(self) -> int:
+        return len(self._vals)
+
+    def __iter__(self):
+        return iter(self._vals)
+
+    def clear(self) -> None:
+        self.count = 0
+        self._vals.clear()
 
 
 @subplugin(ELEMENT, "tensor_sink")
@@ -55,8 +97,10 @@ class TensorSink(Element):
         #: queue (leaky ingress): the served-traffic population — under
         #: saturation `latencies` still includes pre-admission backlog
         #: wait, which measures the source's free-running pace, not the
-        #: pipeline's service time
-        self.admitted_latencies: deque = deque(maxlen=100_000)
+        #: pipeline's service time. Reservoir-bounded (not a sliding
+        #: window): long runs keep a uniform sample of the WHOLE stream,
+        #: exact percentiles up to the cap.
+        self.admitted_latencies = LatencyReservoir()
         self._m_e2e = None  # lazy: labels need the owning pipeline's name
 
     def _obs_e2e(self):
@@ -149,7 +193,10 @@ class TensorSink(Element):
         ``base="admitted"`` from the upstream stamp_admission queue's
         accept point (served-traffic latency — None when no queue
         stamps). Default (p50, p99). ``skip`` drops the first N frames
-        (warm-up exclusion for paced measurements)."""
+        (warm-up exclusion for paced measurements; meaningful for the
+        chronological ``create`` population — the admitted population is
+        reservoir-sampled past its cap, where positional skipping no
+        longer maps to stream order)."""
         pop = self.admitted_latencies if base == "admitted" else self.latencies
         vals = list(pop)[skip:]
         if not vals:

@@ -35,6 +35,9 @@ class VideoTestSrc(SourceElement):
     """
 
     ELEMENT_NAME = "videotestsrc"
+    # frames are pure functions of (pattern, frame index) and pts is
+    # stamped at create() — lane workers may process them out of order
+    REORDER_SAFE = True
     PROPERTIES = {
         **SourceElement.PROPERTIES,
         "num_buffers": -1,
@@ -172,6 +175,9 @@ class AudioTestSrc(SourceElement):
     """Deterministic sine-wave audio source (gst audiotestsrc equivalent)."""
 
     ELEMENT_NAME = "audiotestsrc"
+    # each window is sample-index-addressed (phase derived from buffer
+    # index), so generation order never changes the bytes
+    REORDER_SAFE = True
     PROPERTIES = {
         **SourceElement.PROPERTIES,
         "num_buffers": -1,
@@ -268,6 +274,8 @@ class MultiFileSrc(SourceElement):
     pattern (``img_%03d.raw``) or glob; one buffer per file."""
 
     ELEMENT_NAME = "multifilesrc"
+    # one file per buffer, pts stamped with the file index at create()
+    REORDER_SAFE = True
     PROPERTIES = {**SourceElement.PROPERTIES, "location": None,
                   "start_index": 0, "stop_index": -1, "caps": None}
 
@@ -465,6 +473,9 @@ class TensorSrcIIO(SourceElement):
     """
 
     ELEMENT_NAME = "tensor_src_iio"
+    # mock mode synthesizes index-addressed sines with pts stamped at
+    # create(); device mode reads a live devnode, where the acquisition
+    # snapshot depends on read timing — keep that serial
     PROPERTIES = {
         **SourceElement.PROPERTIES,
         "mode": "mock",  # "device" reads sysfs+devnode; "mock" synthesizes
@@ -486,6 +497,9 @@ class TensorSrcIIO(SourceElement):
         self._chan_offsets: list[int] = []
         self._scan_bytes = 0
         self._fh = None
+
+    def reorder_safe(self):
+        return self.get_property("mode") == "mock"
 
     # -- sysfs probing -------------------------------------------------------
     def _device_dir(self) -> str:

@@ -154,6 +154,9 @@ class _TransformSpec:
 class TensorTransform(Element):
     ELEMENT_NAME = "tensor_transform"
     DEVICE_PASSTHROUGH = True  # device inputs take the jitted path
+    # every output is a pure function of (input buffer, properties); the
+    # compiled-spec cache in _get_spec is caps-keyed, not frame-keyed
+    REORDER_SAFE = True
     PROPERTIES = {
         **Element.PROPERTIES,
         "mode": None,

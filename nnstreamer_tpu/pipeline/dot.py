@@ -48,7 +48,8 @@ def pipeline_to_dot(pipe) -> str:
         f'  label="{_esc(pipe.name)} ({pipe.state.value})";',
     ]
     regions = [r for r in (pipe._regions or ()) if not r._dead]
-    nodes = list(pipe.elements) + regions
+    lane_execs = list(getattr(pipe, "_lane_execs", None) or ())
+    nodes = list(pipe.elements) + regions + lane_execs
 
     def node_id(el) -> str:
         return f"n{id(el):x}"
@@ -73,6 +74,18 @@ def pipeline_to_dot(pipe) -> str:
         lines.append(
             f'  {node_id(r)} [label="{_esc(r.name)}" shape=cds '
             f"color=blue];")
+    for ex in lane_execs:
+        # the ingest lane executor spliced between source and the rest of
+        # the graph (pipeline/lanes.py): a routing node like the regions',
+        # plus a dashed edge to the template segment it replicates per lane
+        lines.append(
+            f'  {node_id(ex)} [label="{_esc(ex.name)}\\n'
+            f'({ex.n} ingest lanes)" shape=cds color=darkgreen];')
+        if ex.segment:
+            lines.append(
+                f"  {node_id(ex)} -> {node_id(ex.segment[0])} "
+                f'[label="replicates ×{ex.n}" style=dashed '
+                f"color=darkgreen];")
     for el in nodes:
         for sp in el.srcpads:
             peer = sp.peer

@@ -369,6 +369,25 @@ class Element:
     #: ``to_host()`` whose cost lands in that element's chain stats.
     DEVICE_PASSTHROUGH = False
 
+    #: Elements whose per-buffer output is a pure function of the input
+    #: buffer and their (fixed) properties — no per-frame mutable state —
+    #: set this True: the ingest lane planner (``pipeline/lanes.py``) may
+    #: replicate them across parallel worker lanes, process frames out of
+    #: order, and reassemble by sequence number without changing a byte.
+    #: On a SourceElement the flag means each ``create()`` output is
+    #: self-contained (pts stamped at the source, no downstream feedback),
+    #: so stamped sequence numbers fully determine stream order. The
+    #: NNS109 lint rule statically audits declarations against per-frame
+    #: ``chain`` state mutations.
+    REORDER_SAFE = False
+
+    def reorder_safe(self) -> bool:
+        """Instance-level lane-replicability check; defaults to the class
+        flag. Elements that are only conditionally stateless
+        (tensor_converter: per-buffer regimes yes, cross-frame adapters
+        no) override this with a property-aware answer."""
+        return bool(self.REORDER_SAFE)
+
     def _obs_labels(self) -> Dict[str, str]:
         """Stable metric labels: ``{pipeline=..., element=...}`` (the
         ``nns_<element>_<metric>`` naming scheme's label half)."""

@@ -92,6 +92,17 @@ def _stage_of(el: Element) -> Optional[DeviceStage]:
         return None
 
 
+def device_foldable(el: Element) -> bool:
+    """Whether this element currently offers a device stage — i.e. whether
+    ``fuse_pipeline`` could fold its per-frame math into a region's jitted
+    program. The ingest lane planner (``pipeline/lanes.py``) consults this
+    to report the device-side preprocessing preamble: a stage-capable
+    ``tensor_transform`` adjacent to a filter runs inside the fused region
+    (zero host math in the lanes) when fusion is on, and stays host-side
+    lane work when it is off."""
+    return _single_io(el) and _stage_of(el) is not None
+
+
 class FusedRegion(Element):
     """Replaces a run of fusible elements with one jitted dispatch.
 

@@ -1,0 +1,537 @@
+"""Parallel ingest lanes — ordered multi-worker ingest.
+
+Everything upstream of a pipeline's first ``queue`` runs on ONE streaming
+thread (the source's ``run_loop`` drives the chain as plain calls). After
+the dispatch window (PR 3) and the device-resident plane (PR 4), that
+serial host segment — frame acquisition → ``tensor_converter`` →
+host-side ``tensor_transform`` — is the flagship bench's dominant
+bottleneck (``ingest_bound_fps`` 486 vs a ~1798 fps device ceiling).
+NNStreamer's answer to the same problem is multiple streaming threads per
+pipeline (arxiv 1901.04985); ours replicates the *replicable* part of the
+pre-queue segment across N worker lanes while keeping the stream order
+contract exact:
+
+- The source keeps its single ``create()`` loop (acquisition is cheap and
+  inherently ordered); every frame is stamped with a **monotone sequence
+  number** at the executor's sink pad and round-robined to a lane.
+- Each lane owns private **clones** of the segment elements (same type,
+  same properties) so no per-frame state is ever shared, plus a private
+  :func:`~nnstreamer_tpu.tensors.pool.get_lane_pool` arena: the first
+  thing a lane does is copy the frame into a pooled staging slab —
+  GIL-releasing ``memcpy`` work that parallelizes even when the
+  downstream math was folded on-device.
+- Outputs reassemble through a **bounded reorder buffer**; a single drain
+  pushes them downstream strictly in sequence order, so the bytes, the
+  order, and the EOS drain are identical to the serial path.
+
+Which elements replicate is decided by :meth:`Element.reorder_safe`
+(class flag ``REORDER_SAFE``, audited statically by lint rule NNS109):
+the walk from the source stops at the first stateful / multi-pad element,
+queue, or fused region. Ordering after fusion is the **device-side
+preprocessing preamble**: a ``tensor_transform`` adjacent to a filter has
+already been folded into the region's jitted program by ``fuse_pipeline``
+by the time lanes plan, so lane workers spend their time in numpy/copy
+code and the cast/normalize math rides the region's one XLA dispatch.
+
+``lanes=1`` (or the ``NNSTPU_LANES=1`` kill switch) leaves the pipeline
+untouched — the exact serial code path. Observability:
+``nns_lane_occupancy`` (busy lanes), ``nns_lane_reorder_stall_seconds``
+(worker time blocked on a full reorder buffer — head-of-line pressure),
+and ``nns_ingest_fps`` (frames forwarded downstream per second), all in
+``Pipeline.metrics_snapshot()`` under ``lanes`` and on ``/metrics``.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import queue as _queue
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from nnstreamer_tpu.log import get_logger
+from nnstreamer_tpu.obs import get_registry
+from nnstreamer_tpu.pipeline.element import (
+    CapsEvent,
+    Element,
+    EosEvent,
+    Event,
+    FlowError,
+    FlowReturn,
+    Pad,
+)
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
+
+log = get_logger("lanes")
+
+#: sequence stamp carried in buffer meta (observability; the reorder
+#: machinery itself threads (seq, buf) pairs explicitly)
+LANE_SEQ_META = "lane_seq"
+
+#: how long a serialized EOS may wait for the reorder drain (mirrors
+#: Queue's serialized-EOS timeout)
+_EOS_DRAIN_TIMEOUT_S = 30.0
+
+
+def lanes_override() -> Optional[int]:
+    """The ``NNSTPU_LANES`` env override: ``1`` is the kill switch that
+    restores the serial path regardless of configuration, higher values
+    force that lane count. Unset/invalid → None (use the configured
+    value)."""
+    raw = os.environ.get("NNSTPU_LANES", "").strip()
+    if not raw:
+        return None
+    try:
+        return max(1, int(raw))
+    except ValueError:
+        log.warning("NNSTPU_LANES=%r is not an int; ignoring", raw)
+        return None
+
+
+def effective_lanes(requested: int) -> int:
+    """The lane count a pipeline actually runs: env override first, then
+    the pipeline's configured ``lanes``."""
+    env = lanes_override()
+    if env is not None:
+        return env
+    try:
+        return max(1, int(requested or 1))
+    except (TypeError, ValueError):
+        return 1
+
+
+def _single_io(el: Element) -> bool:
+    return len(el.sinkpads) == 1 and len(el.srcpads) == 1
+
+
+class _LaneTail(Element):
+    """Terminal collector of one lane's clone chain: records everything
+    the segment emits (buffers AND events, in emission order) so the
+    worker can hand the frame's complete output to the reorder buffer as
+    one ordered unit."""
+
+    ELEMENT_NAME = "lane_tail"
+    HANDLES_DEFERRED = True   # never force a deferred finalize
+    DEVICE_PASSTHROUGH = True  # never materialize a resident payload
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad("sink")
+        #: ("buf", TensorBuffer) | ("event", Event), single-threaded per
+        #: lane (only that lane's worker — or the negotiating source
+        #: thread, never both at once — drives this chain)
+        self.items: List[Tuple[str, Any]] = []
+
+    def chain(self, pad, buf):
+        self.items.append(("buf", buf))
+        return FlowReturn.OK
+
+    def sink_event(self, pad, event):
+        self.items.append(("event", event))
+
+    def take(self) -> List[Tuple[str, Any]]:
+        out, self.items = self.items, []
+        return out
+
+
+class IngestLanes(Element):
+    """The lane executor, spliced between a source and its replicable
+    segment's downstream peer (same splice mechanics as
+    :class:`~nnstreamer_tpu.pipeline.fuse.FusedRegion`). The original
+    segment elements stay in the pipeline but no buffers flow through
+    them; per-lane clones do the work."""
+
+    ELEMENT_NAME = "ingest_lanes"
+    HANDLES_DEFERRED = True
+    DEVICE_PASSTHROUGH = True
+    PROPERTIES = {**Element.PROPERTIES,
+                  #: reorder-buffer capacity in frames ahead of the next
+                  #: in-order sequence; 0 = auto (2× lane count, min 8)
+                  "reorder_capacity": 0}
+
+    def __init__(self, source: Element, segment: List[Element],
+                 lanes: int, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+        self.source = source
+        self.segment: List[Element] = list(segment)
+        self.n = max(2, int(lanes))
+        # lane machinery (built per start(): a restart picks up property
+        # edits on the originals and starts from clean clone state)
+        self._heads: List[Element] = []
+        self._tails: List[_LaneTail] = []
+        self._clones: List[List[Element]] = []
+        self._lane_qs: List[_queue.Queue] = []
+        self._pools: List[Any] = []
+        self._busy: List[bool] = []
+        self._workers: List[threading.Thread] = []
+        self._drainer: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+        # sequence / reorder state — _seq is written only by the single
+        # upstream streaming thread; _pending/_next under _cv
+        self._seq = 0
+        self._next = 0
+        #: slots fully pushed downstream (bumped AFTER _forward returns —
+        #: _next alone would let a serialized EOS overtake the final
+        #: frame, which the drain thread pops before it pushes)
+        self._delivered = 0
+        self._pending: Dict[int, List[Tuple[str, Any]]] = {}
+        self._cv = threading.Condition()
+        self._forwarded = 0
+        self._fwd_times: collections.deque = collections.deque(maxlen=256)
+        self._last_caps_str: Optional[str] = None
+        self._m_stall = None  # lazy: labels need the owning pipeline
+
+    # -- capacity ------------------------------------------------------------
+    def _capacity(self) -> int:
+        cap = int(self.get_property("reorder_capacity") or 0)
+        return cap if cap > 0 else max(8, 2 * self.n)
+
+    # -- obs -----------------------------------------------------------------
+    def _obs_init(self) -> None:
+        import weakref
+
+        reg = get_registry()
+        labels = self._obs_labels()
+        self._m_stall = reg.counter(
+            "nns_lane_reorder_stall_seconds",
+            "Cumulative lane-worker time blocked on a full reorder "
+            "buffer (head-of-line pressure from a slow lane)", **labels)
+        ref = weakref.ref(self)
+        reg.gauge(
+            "nns_lane_occupancy",
+            "Lane workers currently processing a frame",
+            fn=lambda: (sum(ref()._busy) if ref() is not None else 0),
+            **labels)
+        reg.gauge(
+            "nns_ingest_fps",
+            "Frames the lane executor forwarded downstream per second "
+            "(recent window)",
+            fn=lambda: (ref()._ingest_fps() if ref() is not None else 0.0),
+            **labels)
+
+    def _ingest_fps(self) -> float:
+        times = list(self._fwd_times)
+        if len(times) < 2:
+            return 0.0
+        span = times[-1] - times[0]
+        return (len(times) - 1) / span if span > 0 else 0.0
+
+    def obs_snapshot(self):
+        out = super().obs_snapshot()
+        with self._cv:
+            reorder_depth = len(self._pending)
+        out.update({
+            "lanes": self.n,
+            "occupancy": sum(self._busy),
+            "reorder_depth": reorder_depth,
+            "reorder_capacity": self._capacity(),
+            "forwarded": self._forwarded,
+            "ingest_fps": round(self._ingest_fps(), 2),
+        })
+        if self._m_stall is not None:
+            out["reorder_stall_s"] = round(self._m_stall.value, 4)
+        return out
+
+    # -- lane construction ---------------------------------------------------
+    def _clone_of(self, el: Element, lane: int) -> Element:
+        props = {k: v for k, v in el._props.items() if k != "name"}
+        clone = type(el)(name=f"{el.name}~l{lane}", **props)
+        clone.pipeline = self.pipeline  # metric labels / error context
+        return clone
+
+    def _build_lanes(self) -> None:
+        from nnstreamer_tpu.tensors.pool import get_lane_pool, pool_enabled
+
+        self._heads, self._tails, self._clones = [], [], []
+        self._lane_qs, self._pools = [], []
+        self._busy = [False] * self.n
+        for k in range(self.n):
+            clones = [self._clone_of(el, k) for el in self.segment]
+            tail = _LaneTail(name=f"{self.name}~tail{k}")
+            tail.pipeline = self.pipeline
+            for a, b in zip(clones, clones[1:]):
+                a.srcpads[0].link(b.sinkpads[0])
+            clones[-1].srcpads[0].link(tail.sinkpads[0])
+            for c in clones:
+                c.start()
+            self._clones.append(clones)
+            self._heads.append(clones[0])
+            self._tails.append(tail)
+            # small per-lane feed queue: enough to keep the lane busy,
+            # small enough that backpressure reaches the source promptly
+            self._lane_qs.append(_queue.Queue(maxsize=4))
+            self._pools.append(get_lane_pool(k) if pool_enabled() else None)
+
+    # -- state ---------------------------------------------------------------
+    def start(self):
+        super().start()
+        self._stop_evt.clear()
+        self._seq = 0
+        self._next = 0
+        self._delivered = 0
+        self._pending = {}
+        self._forwarded = 0
+        self._fwd_times.clear()
+        self._last_caps_str = None
+        self._build_lanes()
+        if self._m_stall is None:
+            self._obs_init()
+        self._workers = []
+        for k in range(self.n):
+            t = threading.Thread(target=self._worker, args=(k,),
+                                 name=f"{self.name}-lane{k}", daemon=True)
+            self._workers.append(t)
+            t.start()
+        self._drainer = threading.Thread(target=self._drain_loop,
+                                         name=f"{self.name}-drain",
+                                         daemon=True)
+        self._drainer.start()
+
+    def stop(self):
+        self._stop_evt.set()
+        with self._cv:
+            self._cv.notify_all()
+        for t in self._workers:
+            t.join(timeout=5)
+        self._workers = []
+        if self._drainer is not None:
+            self._drainer.join(timeout=5)
+            self._drainer = None
+        for clones in self._clones:
+            for c in clones:
+                c.stop()
+        super().stop()
+
+    # -- splicing ------------------------------------------------------------
+    def splice(self, pipe) -> None:
+        self.pipeline = pipe
+        first, last = self.segment[0], self.segment[-1]
+        up_src = first.sinkpads[0].peer  # the source's src pad
+        down_sink = last.srcpads[0].peer
+        if up_src is not None:
+            up_src.unlink()
+            up_src.link(self.sinkpad)
+        if down_sink is not None:
+            last.srcpads[0].unlink()
+            self.srcpad.link(down_sink)
+        log.info("ingest lanes: %s (%d lanes over [%s])", self.name,
+                 self.n, "+".join(el.name for el in self.segment))
+
+    # -- hot path ------------------------------------------------------------
+    def chain(self, pad, buf):
+        seq = self._seq
+        self._seq = seq + 1
+        buf.meta[LANE_SEQ_META] = seq
+        q = self._lane_qs[seq % self.n]
+        while not self._stop_evt.is_set():
+            try:
+                q.put((seq, buf), timeout=0.1)
+                return FlowReturn.OK
+            except _queue.Full:
+                continue
+        return FlowReturn.EOS
+
+    def _stage_copy(self, buf: TensorBuffer, pool) -> TensorBuffer:
+        """Copy host payloads into this lane's private pool arena: the
+        GIL-releasing memcpy that makes lane parallelism real even when
+        the per-frame math was folded on-device, and the reason a source
+        frame (possibly a shared cached array or another pool's slab)
+        never couples lanes through slab refcounts."""
+        if pool is None or not buf.tensors:
+            return buf
+        if not all(isinstance(t, np.ndarray) for t in buf.tensors):
+            return buf  # resident payloads stage nothing on the host
+        staged = []
+        for t in buf.tensors:
+            view = pool.acquire(t.shape, t.dtype)
+            np.copyto(view, t)
+            staged.append(view)
+        return buf.with_tensors(staged)
+
+    def _worker(self, k: int) -> None:
+        head, tail = self._heads[k], self._tails[k]
+        q, pool = self._lane_qs[k], self._pools[k]
+        sink = head.sinkpads[0]
+        while not self._stop_evt.is_set():
+            try:
+                seq, buf = q.get(timeout=0.1)
+            except _queue.Empty:
+                continue
+            self._busy[k] = True
+            try:
+                head._chain_entry(sink, self._stage_copy(buf, pool))
+                items = tail.take()
+            except Exception as e:  # noqa: BLE001 — a lane failure must
+                # reach the bus (and stop the peers), not die silently
+                self._busy[k] = False
+                self.post_error(e if isinstance(e, FlowError)
+                                else FlowError(f"{self.name}: lane {k}: {e}"))
+                self._stop_evt.set()
+                with self._cv:
+                    self._cv.notify_all()
+                return
+            self._busy[k] = False
+            self._reorder_put(seq, items)
+
+    def _reorder_put(self, seq: int, items: List[Tuple[str, Any]]) -> None:
+        cap = self._capacity()
+        t0 = None
+        with self._cv:
+            while seq - self._next >= cap and not self._stop_evt.is_set():
+                if t0 is None:
+                    t0 = time.monotonic()
+                self._cv.wait(timeout=0.1)
+            if t0 is not None and self._m_stall is not None:
+                self._m_stall.inc(time.monotonic() - t0)
+            self._pending[seq] = items
+            self._cv.notify_all()
+
+    def _drain_loop(self) -> None:
+        while not self._stop_evt.is_set():
+            with self._cv:
+                items = self._pending.pop(self._next, None)
+                if items is None:
+                    self._cv.wait(timeout=0.1)
+                    continue
+                self._next += 1
+                self._cv.notify_all()
+            try:
+                self._forward(items)
+                with self._cv:
+                    self._delivered += 1
+                    self._cv.notify_all()
+            except Exception as e:  # noqa: BLE001 — downstream failures
+                # must reach the bus, not silently kill the drain thread
+                self.post_error(e if isinstance(e, FlowError)
+                                else FlowError(f"{self.name}: {e}"))
+                self._stop_evt.set()
+                with self._cv:
+                    self._cv.notify_all()
+                return
+
+    def _forward(self, items: List[Tuple[str, Any]]) -> None:
+        """Push one sequence slot's output downstream, in emission order.
+        Single consumer (the drain thread, or the streaming thread during
+        negotiation when no frames are in flight) — downstream elements
+        see exactly one pushing thread, like the serial path."""
+        for kind, payload in items:
+            if kind == "buf":
+                self._forwarded += 1
+                self._fwd_times.append(time.monotonic())
+                self.srcpad.push(payload)
+            else:
+                if isinstance(payload, CapsEvent):
+                    # every lane announces the same lazily-derived caps;
+                    # the serial path announces once — dedupe to match
+                    key = str(payload.caps)
+                    if key == self._last_caps_str:
+                        continue
+                    self._last_caps_str = key
+                self.srcpad.push_event(payload)
+
+    # -- events --------------------------------------------------------------
+    def _wait_drained(self, target: int, timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._delivered < target and not self._stop_evt.is_set():
+                if time.monotonic() >= deadline:
+                    return False
+                self._cv.wait(timeout=0.1)
+        return True
+
+    def sink_event(self, pad, event):
+        if isinstance(event, CapsEvent):
+            # (re)negotiation is a barrier: flush in-flight frames, then
+            # run the caps through every lane's clone chain so each is
+            # negotiated; forward lane 0's announcement (all identical)
+            self._wait_drained(self._seq, timeout=_EOS_DRAIN_TIMEOUT_S)
+            first_items: List[Tuple[str, Any]] = []
+            for k in range(self.n):
+                head = self._heads[k]
+                head._event_entry(head.sinkpads[0], CapsEvent(event.caps))
+                items = self._tails[k].take()
+                if k == 0:
+                    first_items = items
+            self._forward(first_items)
+            return
+        if isinstance(event, EosEvent):
+            # serialized EOS: every stamped frame drains through the
+            # reorder buffer before EOS crosses downstream
+            if not self._wait_drained(self._seq,
+                                      timeout=_EOS_DRAIN_TIMEOUT_S):
+                self.log.warning(
+                    "%s: EOS drain timed out with %d slot(s) undelivered",
+                    self.name, self._seq - self._delivered)
+            self.srcpad.push_event(event)
+            return
+        # any other serialized event: give it a sequence slot so it never
+        # overtakes (or falls behind) the frames around it
+        seq = self._seq
+        self._seq = seq + 1
+        self._reorder_put(seq, [("event", event)])
+
+    def __repr__(self):
+        names = "+".join(el.name for el in self.segment)
+        return f"<IngestLanes {self.name!r} n={self.n} over [{names}]>"
+
+
+# --------------------------------------------------------------------------
+# planning
+# --------------------------------------------------------------------------
+def plan_lane_segments(pipe) -> List[Tuple[Element, List[Element]]]:
+    """Find, per eligible source, the maximal replicable pre-queue
+    segment: single-src-pad REORDER_SAFE source, then the downstream run
+    of single-io ``reorder_safe()`` elements, stopping at the first
+    queue, fused region, multi-pad, or stateful element. Runs after
+    ``fuse_pipeline`` so a transform folded into a region (the
+    device-side preprocessing preamble) is already out of the segment."""
+    from nnstreamer_tpu.pipeline.fuse import FusedRegion, device_foldable
+    from nnstreamer_tpu.pipeline.pipeline import Queue, SourceElement
+
+    plans: List[Tuple[Element, List[Element]]] = []
+    for src in pipe.elements:
+        if not isinstance(src, SourceElement):
+            continue
+        if len(src.srcpads) != 1 or not src.reorder_safe():
+            continue
+        segment: List[Element] = []
+        peer = src.srcpads[0].peer
+        cur = peer.element if peer is not None else None
+        while (cur is not None and _single_io(cur)
+               and not isinstance(cur, (Queue, SourceElement, FusedRegion))
+               and getattr(cur, "_fused_region", None) is None
+               and cur.reorder_safe()):
+            segment.append(cur)
+            nxt = cur.srcpads[0].peer
+            cur = nxt.element if nxt is not None else None
+        if not segment:
+            continue
+        if isinstance(cur, FusedRegion):
+            log.info("lane segment for %s ends at %s — preprocessing "
+                     "runs device-side inside the fused region", src.name,
+                     cur.name)
+        elif cur is not None and device_foldable(cur):
+            log.info("lane segment for %s ends at stage-capable %s left "
+                     "host-side (enable NNSTPU_FUSE to fold it on-device)",
+                     src.name, cur.name)
+        plans.append((src, segment))
+    return plans
+
+
+def splice_lanes(pipe, lanes: int) -> List[IngestLanes]:
+    """Splice an :class:`IngestLanes` executor behind every source with a
+    replicable segment. ``lanes <= 1`` is the serial path: nothing is
+    planned, nothing is touched."""
+    if lanes <= 1:
+        return []
+    execs: List[IngestLanes] = []
+    for src, segment in plan_lane_segments(pipe):
+        ex = IngestLanes(src, segment, lanes, name=f"{src.name}-lanes")
+        ex.splice(pipe)
+        execs.append(ex)
+    return execs

@@ -331,15 +331,20 @@ def _build_element(node: LaunchNode) -> Element:
     return el
 
 
-def parse_launch(description: str, pipeline: Optional[Pipeline] = None
-                 ) -> Pipeline:
+def parse_launch(description: str, pipeline: Optional[Pipeline] = None,
+                 lanes: Optional[int] = None) -> Pipeline:
     """Build a Pipeline from a gst-launch-style description.
 
     Two-pass like gst_parse_launch: first build all elements and record the
     link structure (so ``... ! mux.`` may reference an element defined later
     in the description), then resolve links.
+
+    ``lanes`` sets the pipeline's ingest lane count (``pipeline/lanes.py``);
+    None leaves the pipeline's configured value (serial by default).
     """
     pipe = pipeline or Pipeline()
+    if lanes is not None:
+        pipe.lanes = max(1, int(lanes))
 
     # -- pass 1: nodes & chains (syntax via parse_description) ---------------
     # node: ("el", Element) | ("ref", name) | ("refpad", name, pad)

@@ -442,7 +442,8 @@ class Queue(Element):
 class Pipeline:
     """Element container + scheduler + bus."""
 
-    def __init__(self, name: str = "pipeline", fuse: bool = True):
+    def __init__(self, name: str = "pipeline", fuse: bool = True,
+                 lanes: int = 1):
         self.name = name
         self.elements: List[Element] = []
         self.by_name: Dict[str, Element] = {}
@@ -453,6 +454,10 @@ class Pipeline:
         self._lock = threading.Lock()
         self._fuse = fuse
         self._regions: Optional[list] = None
+        #: requested ingest lane count (pipeline/lanes.py); 1 = serial
+        #: path, NNSTPU_LANES env overrides at start time
+        self.lanes = lanes
+        self._lane_execs: Optional[list] = None
         # export per-element latency/throughput gauges at scrape time
         # (weakref-bound: a collected pipeline unregisters itself)
         register_pipeline_collector(self)
@@ -520,6 +525,11 @@ class Pipeline:
             # aggregators share it); surfaced here so one snapshot answers
             # "is the hot path recycling or allocating?"
             out["pool"] = get_pool().snapshot()
+        if self._lane_execs:
+            # lane executors are spliced, not in self.elements — surface
+            # them the way fused regions surface through element stats
+            out["lanes"] = {ex.name: ex.obs_snapshot()
+                            for ex in self._lane_execs}
         return out
 
     # -- state ----------------------------------------------------------------
@@ -540,6 +550,16 @@ class Pipeline:
             self._regions = fuse_pipeline(self)
         for r in self._regions or ():
             r.start()
+        # ingest lane splicing after fusion (pipeline/lanes.py): a
+        # transform folded into a region is already out of the replicable
+        # segment, so its math runs device-side while lanes parallelize
+        # what host work remains; splices persist across restarts
+        from nnstreamer_tpu.pipeline.lanes import effective_lanes, splice_lanes
+
+        if self._lane_execs is None:
+            self._lane_execs = splice_lanes(self, effective_lanes(self.lanes))
+        for ex in self._lane_execs:
+            ex.start()
         for el in sources:
             el.start()
         self.state = State.PLAYING
@@ -567,6 +587,10 @@ class Pipeline:
         for t in self._threads:
             t.join(timeout=10)
         self._threads.clear()
+        # lane executors stop after the source threads (their upstream)
+        # are parked and before the elements they feed shut down
+        for ex in self._lane_execs or ():
+            ex.stop()
         for el in self.elements:
             if not isinstance(el, SourceElement):
                 el.stop()

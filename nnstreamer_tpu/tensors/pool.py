@@ -270,3 +270,24 @@ def get_pool() -> BufferPool:
             if _default is None:
                 _default = BufferPool()
     return _default
+
+
+_lane_pools: Dict[int, BufferPool] = {}
+_lane_pools_lock = threading.Lock()
+
+
+def get_lane_pool(lane: int) -> BufferPool:
+    """Per-lane staging arena for the ingest lane executor
+    (``pipeline/lanes.py``): each worker lane copies its frames into its
+    own pool so N lanes never serialize on one free-list lock or trip
+    each other's slab refcount guards. Keyed process-wide by lane index
+    (lane k of every pipeline shares arena k) so metric label
+    cardinality stays bounded by the lane count, not pipeline count."""
+    pool = _lane_pools.get(lane)
+    if pool is None:
+        with _lane_pools_lock:
+            pool = _lane_pools.get(lane)
+            if pool is None:
+                pool = BufferPool(name=f"ingest-lane{lane}")
+                _lane_pools[lane] = pool
+    return pool

@@ -342,6 +342,53 @@ class TestAstLint:
                "        buf.tensors[0])\n")
         assert by_code(lint_source(src, "x.py"), "NNS108") == []
 
+    def test_nns109_stateful_chain_with_flag(self):
+        src = ("class BadElement:\n"
+               "    REORDER_SAFE = True\n"
+               "    def chain(self, pad, buf):\n"
+               "        self.count += 1\n"
+               "        self.acc.append(buf)\n"
+               "        return buf\n")
+        assert codes(lint_source(src, "x.py")) == ["NNS109", "NNS109"]
+
+    def test_nns109_subscript_store_counts(self):
+        src = ("class BadElement:\n"
+               "    REORDER_SAFE = True\n"
+               "    def chain_list(self, pad, bufs):\n"
+               "        self.seen[bufs[0].pts] = True\n")
+        assert "NNS109" in codes(lint_source(src, "x.py"))
+
+    def test_nns109_no_flag_ok(self):
+        # stateful chain without the declaration is the normal case —
+        # the planner simply won't replicate it
+        src = ("class Stateful:\n"
+               "    def chain(self, pad, buf):\n"
+               "        self.count += 1\n"
+               "        return buf\n")
+        assert by_code(lint_source(src, "x.py"), "NNS109") == []
+
+    def test_nns109_flag_with_clean_chain_ok(self):
+        # locals and reads of self are fine; only per-frame self
+        # mutations break lane replication
+        src = ("class PureElement:\n"
+               "    REORDER_SAFE = True\n"
+               "    def chain(self, pad, buf):\n"
+               "        scale = self.get_property('scale')\n"
+               "        out = buf.tensors[0] * scale\n"
+               "        return out\n"
+               "    def start(self):\n"
+               "        self.warm = True\n")
+        assert by_code(lint_source(src, "x.py"), "NNS109") == []
+
+    def test_nns109_pragma_suppressible(self):
+        src = ("class Counted:\n"
+               "    REORDER_SAFE = True\n"
+               "    def chain(self, pad, buf):\n"
+               "        self.n += 1  # nns-lint: disable=NNS109 -- "
+               "stats only, never touches payload\n"
+               "        return buf\n")
+        assert by_code(lint_source(src, "x.py"), "NNS109") == []
+
     def test_pragma_suppresses_with_reason(self):
         src = ("import time\n"
                "d = time.time()  # nns-lint: disable=NNS101 -- epoch "

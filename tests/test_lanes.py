@@ -1,0 +1,345 @@
+"""Parallel ingest lanes: ordered multi-worker ingest (pipeline/lanes.py).
+
+The contract under test: replicating the pre-queue host segment across N
+worker lanes must be OBSERVABLY free — output bytes, ordering, and EOS
+semantics identical to the serial path at every lane count, even when
+individual lanes run with randomized per-frame delays; per-lane pool
+arenas never recycle each other's slabs; ``NNSTPU_LANES=1`` restores the
+exact serial code path (no executor spliced at all); and the ``nns_lane_*``
+metrics surface through ``metrics_snapshot()`` and the registry.
+"""
+
+import random
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import parse_launch
+from nnstreamer_tpu.pipeline.element import Element, FlowReturn
+from nnstreamer_tpu.pipeline.lanes import (
+    IngestLanes,
+    effective_lanes,
+    plan_lane_segments,
+)
+from nnstreamer_tpu.pipeline.pipeline import Pipeline, SourceElement
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
+from nnstreamer_tpu.tensors.pool import get_lane_pool
+from nnstreamer_tpu.tensors.types import TensorsConfig
+
+# -- helpers ------------------------------------------------------------------
+
+GOLDEN = ("videotestsrc pattern=ball num-buffers=16 width=16 height=16 ! "
+          "tensor_converter ! "
+          "tensor_transform mode=arithmetic "
+          "option=typecast:float32,add:-3.0 acceleration=false ! "
+          "tensor_sink name=out")
+
+
+class _SeqSrc(SourceElement):
+    """Index-stamped 4-elem tensors; REORDER_SAFE by construction."""
+
+    ELEMENT_NAME = "_laneseqsrc"
+    REORDER_SAFE = True
+    PROPERTIES = {**SourceElement.PROPERTIES, "num_buffers": 24}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.i = 0
+
+    def negotiate(self):
+        cfg = TensorsConfig.from_arrays([np.zeros((4,), np.float32)])
+        self.srcpad.set_caps(cfg.to_caps())
+
+    def create(self):
+        if self.i >= self.get_property("num_buffers"):
+            return None
+        buf = TensorBuffer(
+            [np.full((4,), float(self.i), np.float32)], pts=self.i * 1000)
+        self.i += 1
+        return buf
+
+
+class _Jitter(Element):
+    """Pure transform (x*2+1) with a randomized per-frame delay: frames
+    finish out of order across lanes, so in-order delivery downstream
+    proves the reorder buffer, not scheduling luck."""
+
+    ELEMENT_NAME = "_lanejitter"
+    REORDER_SAFE = True
+    PROPERTIES = {**Element.PROPERTIES, "max_delay_ms": 4.0}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+
+    def chain(self, pad, buf):
+        delay = random.uniform(0.0, self.get_property("max_delay_ms"))
+        time.sleep(delay / 1e3)
+        out = buf.with_tensors([t * 2.0 + 1.0 for t in buf.tensors])
+        self.srcpad.push(out)
+        return FlowReturn.OK
+
+
+def _run_jitter_pipeline(lanes, n=24, seed=7):
+    random.seed(seed)
+    pipe = Pipeline(name=f"lanes-jitter-{lanes}", lanes=lanes)
+    src = _SeqSrc(num_buffers=n)
+    jit = _Jitter()
+    from nnstreamer_tpu.elements.sink import TensorSink
+
+    sink = TensorSink(name="out")
+    pipe.add_linked(src, jit, sink)
+    outs = []
+    sink.connect(lambda b: outs.append(
+        (b.pts, [np.asarray(t).copy() for t in b.tensors])))
+    msg = pipe.run(timeout=60)
+    assert msg is not None and msg.kind == "eos"
+    return outs, pipe
+
+
+# -- ordered reassembly under randomized per-lane delays ----------------------
+
+
+class TestOrderedReassembly:
+    def test_byte_equality_vs_serial_under_jitter(self):
+        serial, _ = _run_jitter_pipeline(lanes=1)
+        laned, pipe = _run_jitter_pipeline(lanes=4)
+        assert len(pipe._lane_execs) == 1
+        assert len(serial) == len(laned) == 24
+        for (p1, t1), (p2, t2) in zip(serial, laned):
+            assert p1 == p2
+            for a, b in zip(t1, t2):
+                assert a.dtype == b.dtype
+                assert np.array_equal(a, b)
+
+    def test_delivery_is_in_sequence_order(self):
+        outs, _ = _run_jitter_pipeline(lanes=8, n=40)
+        pts = [p for p, _ in outs]
+        assert pts == sorted(pts)
+        assert len(pts) == len(set(pts)) == 40
+
+    def test_eos_drains_reorder_buffer(self):
+        # large jitter + many lanes: EOS arrives while frames are still
+        # in flight in lane queues and the reorder buffer — every frame
+        # must still be delivered, before EOS, in order
+        outs, pipe = _run_jitter_pipeline(lanes=8, n=32)
+        assert len(outs) == 32
+        sink = pipe.get("out")
+        assert sink.eos
+        ex = pipe._lane_execs[0]
+        assert ex._delivered == ex._seq  # nothing stranded
+        with ex._cv:
+            assert ex._pending == {}
+
+
+# -- lane-count parity on the golden pipeline ---------------------------------
+
+
+class TestLaneCountParity:
+    def _run_golden(self, lanes):
+        pipe = parse_launch(GOLDEN, lanes=lanes)
+        outs = []
+        pipe.get("out").connect(lambda b: outs.append(
+            (b.pts, [np.asarray(t).copy() for t in b.tensors])))
+        msg = pipe.run(timeout=60)
+        assert msg is not None and msg.kind == "eos"
+        return outs
+
+    @pytest.mark.parametrize("lanes", [2, 8])
+    def test_parity_with_serial(self, lanes):
+        serial = self._run_golden(1)
+        laned = self._run_golden(lanes)
+        assert len(serial) == len(laned) == 16
+        for (p1, t1), (p2, t2) in zip(serial, laned):
+            assert p1 == p2
+            for a, b in zip(t1, t2):
+                assert a.dtype == b.dtype and a.shape == b.shape
+                assert np.array_equal(a, b)
+
+
+# -- planning -----------------------------------------------------------------
+
+
+class TestPlanning:
+    def test_plan_covers_converter_and_transform(self):
+        pipe = parse_launch(GOLDEN, lanes=2)
+        plans = plan_lane_segments(pipe)
+        assert len(plans) == 1
+        src, segment = plans[0]
+        assert src.ELEMENT_NAME == "videotestsrc"
+        assert [el.ELEMENT_NAME for el in segment] == [
+            "tensor_converter", "tensor_transform"]
+
+    def test_stateful_converter_stops_replication(self):
+        # frames_per_tensor=2 accumulates across frames — reorder_safe()
+        # is False, the walk stops at the source, no executor splices
+        desc = ("videotestsrc pattern=ball num-buffers=8 width=8 height=8 "
+                "! tensor_converter frames-per-tensor=2 ! tensor_sink "
+                "name=out")
+        pipe = parse_launch(desc, lanes=4)
+        assert plan_lane_segments(pipe) == []
+        msg = pipe.run(timeout=30)
+        assert msg is not None and msg.kind == "eos"
+        assert pipe._lane_execs == []
+
+    def test_queue_bounds_the_segment(self):
+        desc = ("videotestsrc pattern=ball num-buffers=4 width=8 height=8 "
+                "! tensor_converter ! queue ! "
+                "tensor_transform mode=arithmetic option=add:1.0 "
+                "acceleration=false ! tensor_sink name=out")
+        pipe = parse_launch(desc, lanes=2)
+        plans = plan_lane_segments(pipe)
+        assert len(plans) == 1
+        _, segment = plans[0]
+        assert [el.ELEMENT_NAME for el in segment] == ["tensor_converter"]
+
+    def test_serial_lane_count_splices_nothing(self):
+        pipe = parse_launch(GOLDEN)  # lanes defaults to 1
+        pipe.run(timeout=30)
+        assert pipe._lane_execs == []
+
+
+# -- env override / kill switch -----------------------------------------------
+
+
+class TestEnvOverride:
+    def test_kill_switch_restores_serial_path(self, monkeypatch):
+        monkeypatch.setenv("NNSTPU_LANES", "1")
+        pipe = parse_launch(GOLDEN, lanes=8)
+        msg = pipe.run(timeout=30)
+        assert msg is not None and msg.kind == "eos"
+        assert pipe._lane_execs == []
+        # and the serial graph is untouched: source feeds the converter
+        src = next(e for e in pipe.elements
+                   if e.ELEMENT_NAME == "videotestsrc")
+        assert src.srcpad.peer.element.ELEMENT_NAME == "tensor_converter"
+
+    def test_env_forces_lane_count(self, monkeypatch):
+        monkeypatch.setenv("NNSTPU_LANES", "3")
+        pipe = parse_launch(GOLDEN)
+        pipe.run(timeout=30)
+        assert len(pipe._lane_execs) == 1
+        assert pipe._lane_execs[0].n == 3
+
+    def test_effective_lanes_semantics(self, monkeypatch):
+        monkeypatch.delenv("NNSTPU_LANES", raising=False)
+        assert effective_lanes(4) == 4
+        assert effective_lanes(0) == 1
+        monkeypatch.setenv("NNSTPU_LANES", "2")
+        assert effective_lanes(8) == 2
+        monkeypatch.setenv("NNSTPU_LANES", "bogus")
+        assert effective_lanes(5) == 5
+
+
+# -- per-lane pool isolation --------------------------------------------------
+
+
+class TestLanePoolIsolation:
+    def test_lane_pools_are_distinct_arenas(self):
+        p0, p1 = get_lane_pool(0), get_lane_pool(1)
+        assert p0 is not p1
+        assert p0 is get_lane_pool(0)  # stable per index
+        assert p0.name != p1.name
+
+    def test_no_cross_lane_slab_recycle(self):
+        p0, p1 = get_lane_pool(0), get_lane_pool(1)
+        p0.clear()
+        p1.clear()
+        a = p0.acquire((64,), np.float32)
+        a[:] = 1.0
+        assert p0.release(a)
+        # no slab references held (release's refcount guard would drop
+        # instead of recycle) — the slab must land on lane 0's free list
+        del a
+        assert p0.snapshot()["free"] == 1
+        # lane 1 must NOT see lane 0's freed slab: its acquire allocates
+        # fresh (a miss) and lane 0's arena stays untouched
+        misses1 = p1.snapshot()["misses"]
+        b = p1.acquire((64,), np.float32)
+        assert b is not None
+        assert p1.snapshot()["misses"] == misses1 + 1
+        assert p0.snapshot()["free"] == 1  # lane 0's arena untouched
+
+    def test_lanes_stage_through_their_own_pool(self):
+        for k in range(2):
+            get_lane_pool(k).clear()
+        outs, pipe = _run_jitter_pipeline(lanes=2, n=16)
+        assert len(outs) == 16
+        from nnstreamer_tpu.tensors.pool import pool_enabled
+
+        if pool_enabled():
+            # both lane arenas saw traffic (16 frames round-robined)
+            for k in range(2):
+                snap = get_lane_pool(k).snapshot()
+                assert snap["hits"] + snap["misses"] >= 8
+
+
+# -- metrics ------------------------------------------------------------------
+
+
+class TestLaneMetrics:
+    def test_metrics_snapshot_has_lanes_section(self):
+        _, pipe = _run_jitter_pipeline(lanes=4, n=20)
+        snap = pipe.metrics_snapshot()
+        assert "lanes" in snap
+        (name, s), = snap["lanes"].items()
+        assert s["lanes"] == 4
+        assert s["forwarded"] == 20
+        assert s["reorder_depth"] == 0
+        assert s["reorder_stall_s"] >= 0.0
+
+    def test_registry_series_exist(self):
+        from nnstreamer_tpu.obs import get_registry
+
+        _, pipe = _run_jitter_pipeline(lanes=2, n=8)
+        reg = get_registry()
+        labels = pipe._lane_execs[0]._obs_labels()
+        assert reg.get("nns_lane_reorder_stall_seconds", **labels) \
+            is not None
+        assert reg.get("nns_lane_occupancy", **labels) is not None
+        assert reg.get("nns_ingest_fps", **labels) is not None
+
+    def test_serial_snapshot_has_no_lanes_section(self):
+        pipe = parse_launch(GOLDEN)
+        pipe.run(timeout=30)
+        assert "lanes" not in pipe.metrics_snapshot()
+
+
+# -- restart ------------------------------------------------------------------
+
+
+class TestRestart:
+    def test_splice_persists_and_state_resets_across_restart(self):
+        # NOTE: core pad semantics latch `pad.eos` permanently after the
+        # first EOS (see test_fuse's restart test, which pushes through a
+        # persistent appsrc graph without reflowing past latched pads), so
+        # a restart cannot reflow data. What the splice DOES promise across
+        # stop()/start(): the executor object persists (spliced exactly
+        # once, regions-style) and its per-run lane state — sequence
+        # counters, reorder buffer, worker threads — resets cleanly.
+        pipe = Pipeline(name="lanes-restart", lanes=2)
+        src = _SeqSrc(num_buffers=6)
+        jit = _Jitter(max_delay_ms=0.5)
+        from nnstreamer_tpu.elements.sink import TensorSink
+
+        sink = TensorSink(name="out")
+        pipe.add_linked(src, jit, sink)
+        outs = []
+        sink.connect(lambda b: outs.append(float(np.asarray(
+            b.tensors[0])[0])))
+        assert pipe.run(timeout=30).kind == "eos"
+        assert outs == [1.0, 3.0, 5.0, 7.0, 9.0, 11.0]
+        assert len(pipe._lane_execs) == 1
+        ex = pipe._lane_execs[0]
+        assert ex._seq == 6 and ex._delivered == 6
+        pipe.start()  # second cycle: splice reused, counters reset
+        try:
+            assert pipe._lane_execs[0] is ex  # spliced once, reused
+            assert ex._seq == 0 and ex._next == 0 and ex._delivered == 0
+            assert ex._pending == {}
+            assert len(ex._workers) == ex.n
+            assert all(t.is_alive() for t in ex._workers)
+        finally:
+            pipe.stop()
