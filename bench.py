@@ -49,6 +49,18 @@ How to read the bound fields (the report's own limiter analysis):
   — the pre-admission wait of a free-running source is backlog depth,
   not pipeline latency); ``latency_dropped_frames`` counts what the
   queue shed instead.
+- ``fps_median`` / ``spread_mad``: robust companions to ``value`` /
+  ``spread_warm`` — true median of the warm runs and median absolute
+  deviation over it. The max−min ``spread_warm`` moves by a wild run's
+  full excursion; the MAD barely notices it, so perf GATES should
+  compare medians and read ``spread_mad`` for stability.
+- ``slo_budget_ms`` / ``admitted_fps`` / ``shed_ratio``: the SLO
+  scheduler's report card (``BENCH_SLO_BUDGET_MS`` > 0 attaches
+  serving/scheduler.py to the saturated runs). ``admitted_fps`` is the
+  served ADMITTED population per wall second; ``shed_ratio`` the share
+  of offered traffic turned away (door rejections + post-stamp sheds).
+  The SLO contract to check: ``latency_sat_p99_ms`` ≤ 2x budget while
+  ``admitted_fps`` stays ≥80% of the unscheduled saturation rate.
 - ``d2h_per_frame`` / ``resident_ratio``: device-residency health.
   Explicit device→host materializations per frame (sink-only
   materialization in the stock topology ⇒ one grouped fetch per
@@ -112,6 +124,14 @@ LANES = int(os.environ.get("BENCH_LANES", "4"))
 #: the repeat loop starts from the same steady state as run N — the
 #: other half (with the gc fence in _collect) of taming spread_warm
 WARMUP_DRAIN = int(os.environ.get("BENCH_WARMUP_DRAIN", "4"))
+
+#: SLO budget in ms for the saturated runs (serving/scheduler.py): >0
+#: attaches the deadline scheduler — admission control at the leaky
+#: ingress, EDF ordering, shed-late-first, feedback-tuned batch cap —
+#: and the JSON grows admitted_fps / shed_ratio / slo_budget_ms. 0
+#: (default) is the kill switch: no scheduler object is built and the
+#: pipeline runs the exact pre-scheduler path.
+SLO_BUDGET_MS = float(os.environ.get("BENCH_SLO_BUDGET_MS", "0") or 0)
 
 
 def _device_fence() -> None:
@@ -272,6 +292,10 @@ def build_pipeline(batch: int = BATCH, live_fps: int = 0,
         "tensor_sink name=sink to-host=true"
     )
     pipe.lanes = LANES
+    # saturation-only knob: live runs are paced by the source clock and
+    # never shed, so a budget there would only add admission bookkeeping
+    if SLO_BUDGET_MS > 0 and not live_fps:
+        pipe.slo_budget_ms = SLO_BUDGET_MS
     return pipe
 
 
@@ -462,15 +486,42 @@ def _ingress_drops(pipe) -> float:
     return float(c.value) if c is not None else 0.0
 
 
+def _sched_counts(pipe) -> dict:
+    """Cumulative scheduler + admission counters for this pipeline's
+    labels (same diff-two-reads contract as :func:`_ingress_drops` — the
+    obs registry is global and repeats reuse the labels)."""
+    from nnstreamer_tpu.obs import get_registry
+
+    reg = get_registry()
+    name = getattr(pipe, "name", "") or ""
+
+    def val(metric, **labels):
+        c = reg.get(metric, **labels)
+        return float(c.value) if c is not None else 0.0
+
+    return {
+        "rejected": val("nns_sched_rejected_total", pipeline=name),
+        "shed": (val("nns_sched_shed_total", pipeline=name, reason="late")
+                 + val("nns_sched_shed_total", pipeline=name,
+                       reason="capacity")),
+        "stamped": val("nns_queue_admitted_total", pipeline=name,
+                       element="q_ingress"),
+        "revoked": val("nns_queue_admitted_revoked_total", pipeline=name,
+                       element="q_ingress"),
+    }
+
+
 def measure_pipeline(batch: int = BATCH) -> dict:
     from nnstreamer_tpu.tensors.buffer import transfer_snapshot
 
     pipe = build_pipeline(batch)
     drops0 = _ingress_drops(pipe)
+    sched0 = _sched_counts(pipe)
     xfer0 = transfer_snapshot()
     frame_t = _collect(pipe)
     xfer1 = transfer_snapshot()
     drops = _ingress_drops(pipe) - drops0
+    sched = {k: v - sched0[k] for k, v in _sched_counts(pipe).items()}
     warmup_arrivals = max(1, WARMUP // batch) if batch > 1 else WARMUP
     steady = frame_t[warmup_arrivals:]
     if len(steady) >= 2:
@@ -501,11 +552,27 @@ def measure_pipeline(batch: int = BATCH) -> dict:
     inv_p99 = filt._obs_invoke()["invoke"].percentile(99)
     frames = len(frame_t) * batch
     d2h_events = xfer1["d2h_events"] - xfer0["d2h_events"]
+    # scheduler-facing accounting over the same first-arrival→EOS window
+    # _steady_fps uses: admitted_fps is the SERVED admitted population
+    # (stamped frames that reached the sink) per wall second; shed_ratio
+    # is the offered traffic the admission point turned away — door
+    # rejections plus post-stamp sheds/drops over everything offered.
+    eos_t = getattr(frame_t, "eos_t", None)
+    span = (((eos_t if eos_t is not None else frame_t[-1]) - frame_t[0])
+            if len(frame_t) >= 2 else 0.0)
+    served_admitted = int(sink.admitted_latencies.count)
+    offered = sched["stamped"] + sched["rejected"]
     return dict(fps=_steady_fps(frame_t, frames_per_buffer=batch),
                 p50_ms=p50_ms, p90_ms=p90_ms,
                 latency_p50_ms=round(lat[0], 2) if lat else None,
                 latency_p99_ms=round(lat[1], 2) if lat else None,
                 latency_dropped_frames=int(drops),
+                admitted_fps=(round(served_admitted / span, 2)
+                              if span > 0 and served_admitted else None),
+                shed_ratio=(round((sched["rejected"] + sched["revoked"])
+                                  / offered, 4) if offered else None),
+                sched_rejected=int(sched["rejected"]),
+                sched_shed=int(sched["shed"]),
                 # explicit host materializations per frame — sink-only
                 # materialization in the stock pipeline means one grouped
                 # fetch per sink-bound buffer (= 1/batch per frame)
@@ -1200,6 +1267,15 @@ def main():
     warm_fps = [round(r["fps"], 2) for r in warm_sorted]
     spread = ((warm_fps[-1] - warm_fps[0]) / stats["fps"]
               if stats["fps"] else 0.0)
+    # robust spread companions (used by the perf gates): fps_median is
+    # the true median of the warm runs (interpolated for even counts —
+    # `value` stays the conservative lower-middle RUN so the headline
+    # keeps its full stats row), and spread_mad is the median absolute
+    # deviation over the median — one wild warm run moves the max-min
+    # spread_warm by its full excursion but barely dents the MAD
+    fps_median = float(np.median([r["fps"] for r in warm]))
+    mad = float(np.median([abs(r["fps"] - fps_median) for r in warm]))
+    spread_mad = round(mad / fps_median, 3) if fps_median else 0.0
     # weather-normalized score: median of the warm per-run fps/ceiling
     # ratios (each ratio uses the ingest sample adjacent to its run)
     warm_norm = sorted(n for n in norm_seq[1:] or norm_seq if n)
@@ -1246,6 +1322,14 @@ def main():
         "latency_sat_p50_ms": stats["latency_p50_ms"],
         "latency_sat_p99_ms": stats["latency_p99_ms"],
         "latency_dropped_frames": stats["latency_dropped_frames"],
+        # SLO scheduler (BENCH_SLO_BUDGET_MS > 0): throughput of the
+        # SERVED admitted population and the share of offered traffic
+        # the admission point turned away (door rejections + sheds).
+        # Without a budget shed_ratio still reports the leaky ingress's
+        # blind tail-drop ratio under saturation.
+        "slo_budget_ms": SLO_BUDGET_MS if SLO_BUDGET_MS > 0 else None,
+        "admitted_fps": stats["admitted_fps"],
+        "shed_ratio": stats["shed_ratio"],
         # residency: explicit D2H materializations per frame (sink-only
         # materialization ⇒ 1/batch) and the session-wide share of
         # DeviceBuffer pad crossings that stayed resident
@@ -1256,7 +1340,9 @@ def main():
         "frames": stats["frames"],
         "fps_cold": fps_seq[0],
         "fps_runs": fps_seq,
+        "fps_median": round(fps_median, 2),
         "spread_warm": round(spread, 3),
+        "spread_mad": spread_mad,
         # weather-normalized: fps over the SAME-window ingest ceiling —
         # the cross-round comparison that survives tunnel drift
         "value_norm": value_norm,

@@ -36,6 +36,13 @@ These rules encode invariants this codebase has already been burned by
   parallel worker lanes and processes frames out of order — per-frame
   mutable attributes make each lane's clone diverge from the serial
   element, so the "byte-identical to lanes=1" contract silently breaks.
+- NNS110: a blocking sleep or unbounded wait (``.wait()``/``.get()``/
+  ``.acquire()``/``.join()`` with no timeout) inside a scheduler or
+  dispatch hot path (admission, EDF drain, feedback-controller step —
+  see ``_SCHED_HOT_FUNCS``): the SLO scheduler's whole deadline math
+  assumes these paths are event-driven and O(work); one
+  ``time.sleep``-style pacing loop or forever-wait turns every
+  admission decision stale and stalls EOS/teardown behind it.
 
 Findings are suppressed per-line with::
 
@@ -77,6 +84,18 @@ _SYNC_CALLS = {"np.asarray", "numpy.asarray", "np.array", "numpy.array",
 #: per-frame hot-path function names where a hidden sync defeats the
 #: inflight dispatch window (pipeline/dispatch.py)
 _HOT_FUNCS = {"chain", "chain_list", "_chain_locked", "device_stage"}
+
+#: scheduler/dispatch hot-path function names (NNS110): the admission,
+#: EDF-drain and feedback-control paths the SLO scheduler's deadline
+#: math assumes are event-driven — a sleep or forever-wait here makes
+#: every admission decision stale and wedges EOS behind it
+_SCHED_HOT_FUNCS = {"admit", "admit_request", "decide", "note_shed",
+                    "observe_service", "observe_completion", "maybe_step",
+                    "record_completion", "_apply_knobs",
+                    "_chain_scheduled", "_shed_one_locked", "_flush_edf",
+                    "_drain_sched", "_drain", "dispatch", "fence"}
+#: attribute calls that block forever unless given a timeout
+_UNBOUNDED_WAIT_ATTRS = {"wait", "wait_for", "acquire", "join", "get"}
 
 #: direct-materialization callables (NNS108): fetch device bytes while
 #: bypassing the cached, counted to_host() path
@@ -181,6 +200,7 @@ class _FileLinter(ast.NodeVisitor):
         self._rule_nns106(node, dotted)
         self._rule_nns107(node, dotted)
         self._rule_nns108(node, dotted)
+        self._rule_nns110(node, dotted)
         self.generic_visit(node)
 
     def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
@@ -343,6 +363,41 @@ class _FileLinter(ast.NodeVisitor):
             f"miss the fetch",
             hint="call buf.to_host() (cached, counted) or justify a "
                  "host-only payload with a pragma")
+
+    def _rule_nns110(self, node: ast.Call, dotted: str) -> None:
+        if not any(f in _SCHED_HOT_FUNCS for f in self._func_stack):
+            return
+        what: Optional[str] = None
+        if dotted == "time.sleep":
+            what = "time.sleep()"
+        elif isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _UNBOUNDED_WAIT_ATTRS and \
+                not self._is_bounded_wait(node):
+            what = f".{node.func.attr}() without a timeout"
+        if what is None:
+            return
+        self.emit(
+            "NNS110", node,
+            f"{what} in a scheduler/dispatch hot path — deadline "
+            f"admission assumes these paths are event-driven; a sleep or "
+            f"forever-wait makes every admission decision stale and "
+            f"stalls EOS/teardown behind it",
+            hint="bound the wait (timeout=...), restructure around a "
+                 "wake token/condition with a deadline, or justify with "
+                 "a pragma")
+
+    @staticmethod
+    def _is_bounded_wait(node: ast.Call) -> bool:
+        """A wait call is bounded when it passes any timeout: a
+        ``timeout=`` kwarg, or a positional argument (``ev.wait(0.5)``,
+        ``cv.wait_for(pred, 0.5)`` — and ``d.get(key[, default])`` /
+        ``sem.acquire(False)`` stop being forever-blocking calls at
+        all, so any-positional is the conservative no-finding side)."""
+        if any(kw.arg == "timeout" for kw in node.keywords):
+            return True
+        if node.func.attr == "wait_for":
+            return len(node.args) > 1
+        return bool(node.args)
 
     def _rule_nns109(self, node: ast.ClassDef) -> None:
         declares = False

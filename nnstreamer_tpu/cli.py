@@ -248,6 +248,15 @@ def main(argv=None) -> int:
                          "reassembly (byte-identical output); 1 is the "
                          "serial path, NNSTPU_LANES overrides (see "
                          "docs/profiling.md, Ingest scaling)")
+    ap.add_argument("--slo-budget-ms", type=float, default=None,
+                    metavar="MS",
+                    help="pipeline-wide SLO latency budget: activates "
+                         "the serving scheduler (deadline admission "
+                         "control, earliest-deadline-first ordering, "
+                         "late-first load shedding, feedback-tuned "
+                         "batch forming) on the admission-point queues; "
+                         "unset/0 keeps the plain FIFO path (see "
+                         "docs/profiling.md, SLO tuning)")
     args = ap.parse_args(argv)
 
     if args.confchk:
@@ -303,6 +312,8 @@ def main(argv=None) -> int:
                 el.set_property("inflight", max(0, args.inflight))
     if args.lanes is not None:
         pipe.lanes = max(1, args.lanes)
+    if args.slo_budget_ms is not None:
+        pipe.slo_budget_ms = max(0.0, args.slo_budget_ms)
 
     if args.verbose:
         for el in pipe.elements:
@@ -373,6 +384,15 @@ def _print_stats(pipe) -> None:
         print(f"-- ingest lanes {name}: {s['lanes']} lanes, "
               f"{s['forwarded']} frames, {s['ingest_fps']:.0f} fps, "
               f"reorder stall {s.get('reorder_stall_s', 0.0):.3f}s")
+    sched = full.get("scheduler")
+    if sched:
+        print(f"-- slo scheduler: budget {sched['budget_ms']:.0f}ms, "
+              f"{sched['admitted']} admitted / {sched['rejected']} "
+              f"rejected / {sched['shed_late'] + sched['shed_capacity']} "
+              f"shed, p99 {sched['p99_ms']:.1f}ms, "
+              f"batch-cap {sched['batch_cap']}, "
+              f"inflight {sched['inflight_target']}, "
+              f"lanes-hint {sched['lanes_hint']}")
 
 
 if __name__ == "__main__":

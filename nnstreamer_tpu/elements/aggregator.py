@@ -79,6 +79,11 @@ class TensorAggregator(Element):
         #: windows — emitted as meta["create_ts"] so end-to-end latency
         #: under micro-batching includes each frame's batch-window wait
         self._create_ts: List[float] = []
+        #: admission stamps (meta["admitted_t"] from a stamp-admission
+        #: queue upstream), in lockstep with the windows like _create_ts
+        #: — emitted as meta["admitted_ts"] so the sink's served-traffic
+        #: latency population survives micro-batching
+        self._admit_ts: List[float] = []
         #: budget clock per queued unit frame: its create stamp when one
         #: flowed (end-to-end budget), else its aggregator arrival time
         self._held_since: List[float] = []
@@ -171,6 +176,13 @@ class TensorAggregator(Element):
             deficit = max(0, len(self._windows[0]) - len(self._create_ts))
             self._create_ts.extend([None] * deficit)
             self._create_ts.extend(stamps if stamps else [None] * n)
+        adm = buf.meta.get("admitted_t")
+        if adm is not None or self._admit_ts:
+            # same alignment discipline as _create_ts: the buffer's one
+            # admission stamp covers each of its unit frames
+            deficit = max(0, len(self._windows[0]) - len(self._admit_ts))
+            self._admit_ts.extend([None] * deficit)
+            self._admit_ts.extend([adm] * n)
         budget = float(self.get_property("latency_budget_ms"))
         if budget > 0:
             now = time.monotonic()
@@ -196,11 +208,17 @@ class TensorAggregator(Element):
                           if s is not None]
                 if out_ts:
                     meta["create_ts"] = out_ts
+            if self._admit_ts:
+                out_adm = [s for s in self._admit_ts[:fout]
+                           if s is not None]
+                if out_adm:
+                    meta["admitted_ts"] = out_adm
             ret = self.srcpad.push(
                 TensorBuffer(outs, pts=self._pts, meta=meta)
             )
             self._windows = [w[flush:] for w in self._windows]
             self._create_ts = self._create_ts[flush:]
+            self._admit_ts = self._admit_ts[flush:]
             self._held_since = self._held_since[flush:]
             self._pts = buf.pts
         if budget > 0 and self._held_since and \
@@ -309,9 +327,13 @@ class TensorAggregator(Element):
         out_ts = [s for s in self._create_ts[:k] if s is not None]
         if out_ts:
             meta["create_ts"] = out_ts
+        out_adm = [s for s in self._admit_ts[:k] if s is not None]
+        if out_adm:
+            meta["admitted_ts"] = out_adm
         ret = self.srcpad.push(TensorBuffer(outs, pts=self._pts, meta=meta))
         self._windows = [[] for _ in self._windows]
         self._create_ts = []
+        self._admit_ts = []
         self._held_since = []
         self._pts = None
         return ret
@@ -324,5 +346,6 @@ class TensorAggregator(Element):
                 self._emit_partial()
             self._windows.clear()
             self._create_ts.clear()
+            self._admit_ts.clear()
             self._held_since.clear()
             self._pts = None

@@ -327,7 +327,17 @@ class TensorFilter(Element):
         stash = buf.meta.pop(POOL_STASH_META, None)
         t0 = _time.monotonic()
         outputs = fw.invoke(model_inputs)
-        obs["invoke"].observe(_time.monotonic() - t0)
+        dt = _time.monotonic() - t0
+        obs["invoke"].observe(dt)
+        sched = getattr(self.pipeline, "_slo_scheduler", None)
+        if sched is not None:
+            # feed the admission controller's service-rate EWMA; the
+            # leading dim of a micro-batched input is its frame count
+            # (frames-dim concat), a single frame estimates as 1
+            shape = getattr(model_inputs[0], "shape", None) \
+                if model_inputs else None
+            frames = shape[0] if shape else 1
+            sched.observe_service(dt, frames=int(frames))
 
         out_comb = self._combination("output_combination")
         if out_comb is not None:

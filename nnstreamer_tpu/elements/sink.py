@@ -170,13 +170,31 @@ class TensorSink(Element):
                 for t in stamps:
                     self.latencies.append(now - t)
                     hist.observe(now - t)
-            adm = buf.meta.get("admitted_t")
-            if adm is not None:
-                # one admission stamp covers the (possibly aggregated)
-                # buffer; count it once per constituent frame so the
-                # served population weighs frames like `latencies` does
-                for _ in range(max(len(stamps), 1)):
-                    self.admitted_latencies.append(now - adm)
+            # aggregated buffers carry one admission stamp per
+            # constituent frame (meta["admitted_ts"], kept in lockstep
+            # with create_ts by tensor_aggregator); unaggregated ones
+            # the single stamp the queue wrote
+            adm_list = buf.meta.get("admitted_ts")
+            if adm_list is None:
+                adm = buf.meta.get("admitted_t")
+                if adm is not None:
+                    # one stamp covers the buffer; count it once per
+                    # constituent frame so the served population weighs
+                    # frames like `latencies` does
+                    adm_list = [adm] * max(len(stamps), 1)
+            if adm_list:
+                frames = len(adm_list)
+                for t in adm_list:
+                    self.admitted_latencies.append(now - t)
+                adm = adm_list[0]
+                sched = getattr(self.pipeline, "_slo_scheduler", None)
+                if sched is not None:
+                    # completion feed: drives the drain-rate estimate
+                    # (covers fused pipelines where the filter chain
+                    # never runs) and the feedback controller's p99 —
+                    # event-driven, the controller has no polling thread
+                    sched.observe_completion(now - adm, now,
+                                             frames=frames)
         with self._cv:
             if len(self.buffers) < int(self.get_property("max_stored")):
                 self.buffers.append(buf)

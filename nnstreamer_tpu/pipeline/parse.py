@@ -332,7 +332,8 @@ def _build_element(node: LaunchNode) -> Element:
 
 
 def parse_launch(description: str, pipeline: Optional[Pipeline] = None,
-                 lanes: Optional[int] = None) -> Pipeline:
+                 lanes: Optional[int] = None,
+                 slo_budget_ms: Optional[float] = None) -> Pipeline:
     """Build a Pipeline from a gst-launch-style description.
 
     Two-pass like gst_parse_launch: first build all elements and record the
@@ -341,10 +342,16 @@ def parse_launch(description: str, pipeline: Optional[Pipeline] = None,
 
     ``lanes`` sets the pipeline's ingest lane count (``pipeline/lanes.py``);
     None leaves the pipeline's configured value (serial by default).
+    ``slo_budget_ms`` sets the pipeline-wide SLO budget
+    (``serving/scheduler.py``): deadline admission, EDF ordering and
+    feedback-tuned batch forming on the admission-point queues; None/0
+    leaves the scheduler off entirely (byte-identical FIFO path).
     """
     pipe = pipeline or Pipeline()
     if lanes is not None:
         pipe.lanes = max(1, int(lanes))
+    if slo_budget_ms is not None:
+        pipe.slo_budget_ms = max(0.0, float(slo_budget_ms))
 
     # -- pass 1: nodes & chains (syntax via parse_description) ---------------
     # node: ("el", Element) | ("ref", name) | ("refpad", name, pad)

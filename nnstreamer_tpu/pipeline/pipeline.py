@@ -18,6 +18,7 @@ to the application. This module provides the same capability:
 from __future__ import annotations
 
 import enum
+import heapq
 import queue as _queue
 import threading
 import time
@@ -141,9 +142,21 @@ class Queue(Element):
                   # (whatever is ALREADY queued — it never waits). Runs of
                   # data buffers go to HANDLES_LIST peers as one list;
                   # 1 disables gathering entirely.
-                  "drain_batch": 64}
+                  "drain_batch": 64,
+                  # slo_budget_ms: per-queue SLO budget (ms). >0 makes
+                  # this queue an admission point of the pipeline's
+                  # SloScheduler (serving/scheduler.py): deadline
+                  # admission at chain(), EDF ordering instead of FIFO,
+                  # late-first shedding on overflow, and batch forming
+                  # capped by the feedback controller. 0 (default) with
+                  # no pipeline-level budget = the exact pre-scheduler
+                  # path (no scheduler object is even built).
+                  "slo_budget_ms": 0.0}
 
     _EOS = object()
+    #: worker wake token for scheduler mode — data rides the EDF heap,
+    #: the FIFO carries only ordering (tokens/events/EOS)
+    _TOKEN = object()
 
     #: rate limit for the leaky-drop warning (seconds between warnings)
     DROP_WARN_INTERVAL_S = 5.0
@@ -166,6 +179,15 @@ class Queue(Element):
         self._undelivered = 0
         self._last_drop_warn_t = 0.0
         self._drops_since_warn = 0
+        #: SLO scheduler binding (serving/scheduler.py), resolved at
+        #: start(); None = plain FIFO queue, the kill-switch path
+        self._sched = None
+        self._budget_ms = 0.0
+        self._edf: list = []          # (deadline_t, seq, buf) heap
+        self._edf_lock = threading.Lock()
+        self._edf_seq = 0             # FIFO tiebreak for equal deadlines
+        self._m_admitted = None       # stamp_admission accept counter
+        self._m_adm_revoked = None    # admitted-then-dropped counter
 
     def _obs_init(self):
         """Queue metrics: depth gauge (sampled), drop counter, blocked
@@ -184,12 +206,18 @@ class Queue(Element):
             "nns_queue_drain_size",
             "Data buffers the worker drained per wake (backlog batching)",
             buckets=(1, 2, 4, 8, 16, 32, 64), **labels)
+        self._m_admitted = reg.counter(
+            "nns_queue_admitted_total",
+            "Buffers accepted at a stamp_admission point", **labels)
+        self._m_adm_revoked = reg.counter(
+            "nns_queue_admitted_revoked_total",
+            "Admitted buffers later dropped before delivery (the "
+            "admitted population nets these out)", **labels)
         import weakref
 
         ref = weakref.ref(self)
         reg.gauge("nns_queue_depth", "Buffers currently queued",
-                  fn=lambda: ((ref()._q.qsize() + ref()._undelivered)
-                              if ref() is not None else 0),
+                  fn=lambda: (ref()._depth() if ref() is not None else 0),
                   **labels)
 
     def _count_drop(self) -> None:
@@ -208,9 +236,19 @@ class Queue(Element):
             self._last_drop_warn_t = now
             self._drops_since_warn = 0
 
+    def _depth(self) -> int:
+        """Occupancy: FIFO (or EDF heap in scheduler mode) + popped but
+        undelivered."""
+        if self._sched is not None:
+            with self._edf_lock:
+                queued = len(self._edf)
+        else:
+            queued = self._q.qsize()
+        return queued + self._undelivered
+
     def obs_snapshot(self):
         out = super().obs_snapshot()
-        out["depth"] = self._q.qsize() + self._undelivered
+        out["depth"] = self._depth()
         if self._m_drops is not None:
             out["drops"] = int(self._m_drops.value)
             out["blocked_s"] = round(self._m_blocked.value, 4)
@@ -223,11 +261,35 @@ class Queue(Element):
         self._stop_evt.clear()
         self._eos_done.clear()
         self._undelivered = 0
-        self._q = _queue.Queue(maxsize=int(self.get_property("max_size_buffers")))
+        # scheduler binding: this queue is an admission point when the
+        # pipeline has an SloScheduler AND this queue either stamps
+        # admission or carries its own budget. No scheduler (budget
+        # unset anywhere) = the exact pre-scheduler FIFO path.
+        own_budget = float(self.get_property("slo_budget_ms") or 0.0)
+        sched = getattr(self.pipeline, "_slo_scheduler", None)
+        if sched is not None and (own_budget > 0
+                                  or self.get_property("stamp_admission")):
+            self._sched = sched
+            self._budget_ms = own_budget if own_budget > 0 \
+                else sched.budget_ms
+        else:
+            self._sched = None
+        if self._sched is not None:
+            # data rides the EDF heap (bounded by max_size_buffers in
+            # _chain_scheduled); the FIFO carries only wake tokens and
+            # serialized events, so it must never block a producer
+            self._edf = []
+            self._edf_seq = 0
+            self._q = _queue.Queue()
+        else:
+            self._q = _queue.Queue(
+                maxsize=int(self.get_property("max_size_buffers")))
         if self._m_drops is None:
             self._obs_init()
         self._worker = threading.Thread(
-            target=self._drain, name=f"{self.name}-worker", daemon=True
+            target=self._drain_sched if self._sched is not None
+            else self._drain,
+            name=f"{self.name}-worker", daemon=True
         )
         self._worker.start()
 
@@ -250,6 +312,10 @@ class Queue(Element):
         batch) beats stacking more dispatches onto a saturated link."""
         if self._worker is None:
             return True
+        if self._sched is not None:
+            with self._edf_lock:
+                return len(self._edf) < \
+                    int(self.get_property("max_size_buffers"))
         maxsize = self._q.maxsize
         return maxsize <= 0 or self._q.qsize() < maxsize
 
@@ -301,8 +367,15 @@ class Queue(Element):
             # link; the zero rows are synthesized on device now
             if buf.meta.get("pad_rows"):
                 buf = buf.pad_rows_device()
+        if self._sched is not None and self._worker is not None:
+            # SLO path: deadline admission + EDF heap; rejected frames
+            # never carry an admission stamp and are dropped here
+            return self._chain_scheduled(buf)
         if self.get_property("stamp_admission"):
-            buf.meta.setdefault("admitted_t", time.monotonic())
+            if "admitted_t" not in buf.meta:
+                buf.meta["admitted_t"] = time.monotonic()
+                if self._m_admitted is not None:
+                    self._m_admitted.inc()
         if self._worker is None:  # not started: degenerate passthrough
             return self.srcpad.push(buf)
         if self.get_property("leaky") == "downstream":
@@ -312,8 +385,19 @@ class Queue(Element):
                     return FlowReturn.OK
                 except _queue.Full:
                     try:
-                        self._q.get_nowait()  # drop oldest
+                        dropped = self._q.get_nowait()  # drop oldest
                         self._count_drop()
+                        # a frame dropped AFTER stamp_admission leaves
+                        # the admitted population: revoke the stamp (a
+                        # shared-meta consumer — tee branch, aggregated
+                        # window — must not report it as a served-latency
+                        # outlier) and count the revocation so admitted
+                        # accounting nets out
+                        if not (dropped is self._EOS
+                                or isinstance(dropped, Event)) and \
+                                dropped.meta.pop("admitted_t",
+                                                 None) is not None:
+                            self._m_adm_revoked.inc()
                     except _queue.Empty:
                         pass
         else:
@@ -438,12 +522,149 @@ class Queue(Element):
                 self._eos_done.set()  # unblock a waiting EOS pusher
                 return
 
+    # -- SLO scheduler mode (serving/scheduler.py) ---------------------------
+    def _chain_scheduled(self, buf) -> FlowReturn:
+        """Producer side of scheduler mode: deadline admission, EDF
+        enqueue, late-first shedding on overflow. With a uniform budget
+        deadlines are monotone in arrival order, so an unloaded queue's
+        pop order equals FIFO — byte-identical output."""
+        sched = self._sched
+        now = time.monotonic()
+        with self._edf_lock:
+            backlog = len(self._edf) + self._undelivered
+        if not sched.admit(buf, now=now, backlog=backlog,
+                           budget_ms=self._budget_ms):
+            self._count_drop()
+            return FlowReturn.OK  # rejected at the door, never admitted
+        if self._m_admitted is not None:
+            self._m_admitted.inc()
+        cap = int(self.get_property("max_size_buffers"))
+        shed = None
+        with self._edf_lock:
+            self._edf_seq += 1
+            heapq.heappush(self._edf,
+                           (buf.meta["deadline_t"], self._edf_seq, buf))
+            if cap > 0 and len(self._edf) > cap:
+                shed = self._shed_one_locked(now)
+        if shed is not None:
+            sched.note_shed(shed, now)
+            self._m_adm_revoked.inc()
+            self._count_drop()
+        self._q.put_nowait(self._TOKEN)  # wake the worker (unbounded)
+        return FlowReturn.OK
+
+    def _shed_one_locked(self, now: float):
+        """Pick the overflow victim (caller holds ``_edf_lock``):
+        late-first — the MOST-late frame (earliest past deadline, i.e.
+        the heap root) sheds before any on-time one; with nothing late
+        yet, the least-urgent (latest-deadline) frame goes."""
+        if self._edf[0][0] <= now:
+            return heapq.heappop(self._edf)[2]
+        i = max(range(len(self._edf)), key=lambda j: self._edf[j][0])
+        victim = self._edf[i][2]
+        last = self._edf.pop()
+        if i < len(self._edf):
+            self._edf[i] = last
+            heapq.heapify(self._edf)
+        return victim
+
+    def _flush_edf(self, limit: Optional[int],
+                   group_host: bool) -> None:
+        """Batch former: pop up to ``limit`` admitted frames in EDF
+        order and deliver them as one run (``push_list`` to
+        HANDLES_LIST peers — the downstream DispatchWindow's fence is
+        the free-slot backpressure: a full window blocks this worker, so
+        new batches only form when a dispatch slot frees).
+
+        Frames whose deadline passed while they sat in the heap are
+        shed HERE, not delivered: serving them would burn device time on
+        work that already missed its SLO and then report the miss as an
+        admitted-latency outlier (the EOS flush after a stall was the
+        worst case: every parked frame surfaced at once, hundreds of ms
+        late). On the sequential hand-off path the deadline is re-tested
+        per frame right before its push — a stall INSIDE the run (a slow
+        peer, GIL contention) makes frames that were on time when the
+        batch formed go late while they wait behind it. A HANDLES_LIST
+        peer gets the whole run in one hand-off instead: the frames
+        become in-flight together, so there is no serial wait to re-test
+        for. An unloaded pipeline never goes late, so the byte-
+        identical-to-FIFO contract is untouched."""
+        now = time.monotonic()
+        shed: list = []
+        with self._edf_lock:
+            n = len(self._edf) if limit is None \
+                else min(max(1, limit), len(self._edf))
+            run = []
+            while self._edf and len(run) < n:
+                deadline_t, _seq, buf = heapq.heappop(self._edf)
+                if deadline_t <= now:
+                    shed.append(buf)
+                else:
+                    run.append(buf)
+        if run:
+            self._undelivered += len(run)
+            if self._m_drain is not None:
+                self._m_drain.observe(len(run))
+            if group_host:
+                for it in run:
+                    for t in it.tensors:
+                        start_async = getattr(t, "copy_to_host_async",
+                                              None)
+                        if start_async is not None:
+                            start_async()
+            peer = self.srcpad.peer
+            if len(run) > 1 and not group_host and peer is not None \
+                    and getattr(peer.element, "HANDLES_LIST", False):
+                self._flush_run(run)
+            else:
+                for it in run:
+                    if it.meta["deadline_t"] <= time.monotonic():
+                        self._undelivered -= 1
+                        shed.append(it)
+                        continue
+                    self._flush_run([it])
+        for buf in shed:
+            self._sched.note_shed(buf, time.monotonic())
+            self._m_adm_revoked.inc()
+            self._count_drop()
+
+    def _drain_sched(self):
+        """Scheduler-mode worker: wake tokens pop EDF batches capped by
+        the feedback controller; events/EOS flush all pending data first
+        (EDF order) so serialized-event semantics hold — an event never
+        overtakes data queued ahead of it."""
+        group_host = bool(self.get_property("materialize_host"))
+        sched = self._sched
+        while not self._stop_evt.is_set():
+            try:
+                item = self._q.get(timeout=0.1)
+            except _queue.Empty:
+                continue
+            try:
+                if item is self._EOS or isinstance(item, Event):
+                    self._flush_edf(None, group_host)
+                    if item is self._EOS:
+                        self.srcpad.push_event(EosEvent())
+                        self._eos_done.set()
+                        return
+                    self.srcpad.push_event(item)
+                else:
+                    # a shed frame leaves its wake token behind — the
+                    # token then pops an empty heap, a cheap no-op
+                    self._flush_edf(sched.batch_cap(), group_host)
+            except Exception as e:  # noqa: BLE001 — downstream failures
+                # must reach the bus, not silently kill this worker
+                self.post_error(e if isinstance(e, FlowError)
+                                else FlowError(f"{self.name}: {e}"))
+                self._eos_done.set()
+                return
+
 
 class Pipeline:
     """Element container + scheduler + bus."""
 
     def __init__(self, name: str = "pipeline", fuse: bool = True,
-                 lanes: int = 1):
+                 lanes: int = 1, slo_budget_ms: float = 0.0):
         self.name = name
         self.elements: List[Element] = []
         self.by_name: Dict[str, Element] = {}
@@ -458,6 +679,12 @@ class Pipeline:
         #: path, NNSTPU_LANES env overrides at start time
         self.lanes = lanes
         self._lane_execs: Optional[list] = None
+        #: pipeline-wide SLO budget in ms (serving/scheduler.py); >0
+        #: activates deadline admission + EDF + feedback control on the
+        #: admission-point queues at start(). 0/unset = no scheduler
+        #: object at all — the byte-identical pre-scheduler path.
+        self.slo_budget_ms = float(slo_budget_ms or 0.0)
+        self._slo_scheduler = None
         # export per-element latency/throughput gauges at scrape time
         # (weakref-bound: a collected pipeline unregisters itself)
         register_pipeline_collector(self)
@@ -530,6 +757,8 @@ class Pipeline:
             # them the way fused regions surface through element stats
             out["lanes"] = {ex.name: ex.obs_snapshot()
                             for ex in self._lane_execs}
+        if self._slo_scheduler is not None:
+            out["scheduler"] = self._slo_scheduler.snapshot()
         return out
 
     # -- state ----------------------------------------------------------------
@@ -540,6 +769,17 @@ class Pipeline:
             return self
         sources = [e for e in self.elements if isinstance(e, SourceElement)]
         others = [e for e in self.elements if not isinstance(e, SourceElement)]
+        # SLO scheduler before any element starts: admission-point
+        # queues bind to it in their start(). The budget check runs
+        # before the import so the default (no budget anywhere) path
+        # never even loads the serving package.
+        if self._slo_scheduler is None and (
+                self.slo_budget_ms > 0
+                or any(float(el._props.get("slo_budget_ms") or 0.0) > 0
+                       for el in self.elements)):
+            from nnstreamer_tpu.serving.scheduler import ensure_scheduler
+
+            ensure_scheduler(self)
         for el in others:
             el.start()
         # region fusion after backends opened, before any buffer flows

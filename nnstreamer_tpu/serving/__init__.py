@@ -7,28 +7,37 @@ generation streams share ONE batched, KV-cached decode program. Admission
 happens at dispatch boundaries; each stream owns a batch slot of the
 device-resident cache; the hot loop is a single jitted multi-step decode
 whose shapes never change, so XLA compiles it exactly once.
+
+``serving/scheduler.py`` adds the SLO layer shared by the frame pipeline
+and this engine: deadline admission control, EDF ordering, late-first
+shedding, and a feedback controller over batch-cap/inflight (see
+docs/profiling.md, "SLO tuning"). The engine module is imported lazily:
+the scheduler attaches to plain frame pipelines that never touch the LM
+stack, and must not drag the transformer models in with it.
 """
 
 import threading
 from typing import Dict, Optional
 
-from nnstreamer_tpu.serving.engine import (
-    ContinuousBatchingEngine,
-    GenerationStream,
+from nnstreamer_tpu.serving.scheduler import (
+    FeedbackController,
+    ServiceRateEstimator,
+    SloRejected,
+    SloScheduler,
 )
 
 #: name → engine, so pipeline elements (tensor_lm_serve) can reference an
 #: app-constructed engine by property — the register_jax_model pattern
-_ENGINES: Dict[str, ContinuousBatchingEngine] = {}
+_ENGINES: Dict[str, "ContinuousBatchingEngine"] = {}
 _ENGINES_LOCK = threading.Lock()
 
 
-def register_engine(name: str, engine: ContinuousBatchingEngine) -> None:
+def register_engine(name: str, engine) -> None:
     with _ENGINES_LOCK:
         _ENGINES[name] = engine
 
 
-def get_engine(name: str) -> Optional[ContinuousBatchingEngine]:
+def get_engine(name: str):
     with _ENGINES_LOCK:
         return _ENGINES.get(name)
 
@@ -38,5 +47,17 @@ def unregister_engine(name: str) -> bool:
         return _ENGINES.pop(name, None) is not None
 
 
+def __getattr__(name: str):
+    # lazy: engine.py pulls the transformer model stack; a frame
+    # pipeline that only needs the SLO scheduler must not pay for it
+    if name in ("ContinuousBatchingEngine", "GenerationStream"):
+        from nnstreamer_tpu.serving import engine as _engine
+
+        return getattr(_engine, name)
+    raise AttributeError(name)
+
+
 __all__ = ["ContinuousBatchingEngine", "GenerationStream",
-           "register_engine", "get_engine", "unregister_engine"]
+           "register_engine", "get_engine", "unregister_engine",
+           "SloScheduler", "SloRejected", "ServiceRateEstimator",
+           "FeedbackController"]

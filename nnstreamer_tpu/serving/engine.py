@@ -262,7 +262,8 @@ class ContinuousBatchingEngine:
                  prefill_chunk: Optional[int] = None,
                  kv_quant: Optional[str] = None,
                  prefix_cache: int = 0,
-                 attention: str = "auto"):
+                 attention: str = "auto",
+                 slo_budget_ms: float = 0.0):
         import jax
         import jax.numpy as jnp
 
@@ -404,6 +405,15 @@ class ContinuousBatchingEngine:
             "submit() to batch-slot admission wait",
             engine=self.obs_name)
         register_engine_collector(self)
+        #: request-path SLO admission (serving/scheduler.py): submit()
+        #: rejects prompts whose deadline is unmeetable under the EWMA
+        #: per-request service estimate; 0 = admit everything (default)
+        self._slo = None
+        if float(slo_budget_ms or 0.0) > 0:
+            from nnstreamer_tpu.serving.scheduler import SloScheduler
+
+            self._slo = SloScheduler(budget_ms=float(slo_budget_ms),
+                                     name=self.obs_name)
         self.prefix_cache = int(prefix_cache)
         if self.prefix_cache < 0:
             raise ValueError(
@@ -621,9 +631,18 @@ class ContinuousBatchingEngine:
                 raise RuntimeError(
                     "serving: engine is not running — call start() first "
                     "(a submit with no loop thread would never complete)")
+            if self._slo is not None:
+                # backlog ahead of this request: queued + active streams
+                # (raises SloRejected before any slot/queue capacity is
+                # consumed — overload is turned away at the door, not
+                # discovered as a latency outlier)
+                backlog = self._pending.qsize() + sum(
+                    1 for s in self._slots if s is not None)
+                self._slo.admit_request(_time.monotonic(), backlog)
             sid = self._next_id
             self._next_id += 1
             stream = GenerationStream(sid, prompt.size)
+            stream.submit_t = _time.monotonic()  # → SLO service estimate
             self._pending.put(_PendingRequest(prompt, int(max_new_tokens),
                                               stream))
         self._wake.set()
@@ -863,6 +882,17 @@ class ContinuousBatchingEngine:
         observes its stream done also observes the slot released."""
         st = self._slots[slot]
         self._budget[slot] -= 1
+        done = (self.eos_id is not None and tok == self.eos_id) or \
+            self._budget[slot] <= 0
+        if done and self._slo is not None:
+            t0 = getattr(st, "submit_t", None)
+            if t0 is not None:
+                # whole-request service time feeds the admission EWMA
+                # (and the controller's p99 window) — per-REQUEST, since
+                # the engine's admission unit is a request, not a frame
+                now = _time.monotonic()
+                self._slo.observe_completion(now - t0, now, frames=1)
+                self._slo.observe_service(now - t0, frames=1)
         if self.eos_id is not None and tok == self.eos_id:
             self._slots[slot] = None
             st._finish("eos")

@@ -1,0 +1,452 @@
+"""SloScheduler — deadline admission, EDF ordering, and feedback-tuned
+batch forming for the serving plane.
+
+BENCH_r05's saturation p99 was ~5 s against a 50 ms budget: the only
+overload defense was the leaky ingress queue's blind tail-drop, which
+sheds whichever frame happens to be oldest with no notion of deadline.
+This module owns the request population between ingress and device
+dispatch instead:
+
+- **Deadlines.** Every admitted frame carries ``meta["deadline_t"]`` —
+  either a per-request override stamped upstream, or
+  ``admitted_t + slo_budget_ms`` from the pipeline/queue budget.
+- **Admission control.** A frame whose deadline cannot be met given the
+  current service-rate estimate (EWMA over
+  ``nns_tensor_filter_invoke_seconds`` observations plus the sink's
+  completion spacing — the *slower* of the two governs, so a fused
+  pipeline whose filter chain never runs is still covered) is rejected
+  at the door: it never consumes queue capacity, device batches, or a
+  slot in the admitted-latency population.
+- **EDF ordering.** The admission queue (``pipeline/pipeline.py`` Queue
+  in scheduler mode) replaces FIFO with an earliest-deadline-first heap;
+  with a uniform budget deadlines are monotone in arrival order, so an
+  unloaded pipeline's output is byte-identical to FIFO — the kill
+  switch (budget unset) doesn't even build the scheduler.
+- **Load shedding.** On overflow the queue sheds already-late frames
+  first (they will miss regardless); only when nothing is late does it
+  drop the least-urgent (latest-deadline) frame. The batch former also
+  sheds any frame whose deadline passed while it sat in the heap —
+  late work is never dispatched (serving it would burn device time on
+  a guaranteed miss and then report the miss as an admitted-latency
+  outlier). A shed frame's admission stamp is revoked so the admitted
+  population nets out.
+- **Batch forming.** The queue worker re-forms device batches from
+  whatever is admitted each wake, capped by the feedback controller's
+  ``batch_cap`` (kept a power of two so re-formed batches land on the
+  fused region's bucketed shapes instead of forcing retraces). The
+  DispatchWindow's fence provides the free-slot backpressure: a full
+  window blocks the pushing worker, so batches are only formed when a
+  dispatch slot frees.
+- **Feedback control.** An event-driven AIMD controller (no polling
+  thread, no sleeps — NNS110 enforces that for every scheduler hot
+  path) steps ``batch_cap`` and the filters' ``inflight`` toward max
+  admitted throughput subject to p99 ≤ ``p99_factor`` x budget, reading
+  the same completion population the bench's ``latency_sat_p99_ms``
+  reports. ``lanes`` is start-time-static (pipeline/lanes.py splices
+  once), so the controller publishes its lane recommendation as the
+  ``nns_sched_lanes_hint`` gauge for the next launch instead of lying
+  about a live retune.
+
+Exported series: ``nns_sched_admitted_total``, ``nns_sched_rejected_total``,
+``nns_sched_shed_total{reason}``, ``nns_sched_deadline_slack_seconds``,
+``nns_sched_batch_cap``, ``nns_sched_inflight_target``,
+``nns_sched_service_time_ms``, ``nns_sched_p99_ms``,
+``nns_sched_lanes_hint``. See docs/profiling.md, "SLO tuning".
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Optional
+
+from nnstreamer_tpu.log import get_logger
+
+log = get_logger("scheduler")
+
+
+class SloRejected(RuntimeError):
+    """Raised by request-path admission (serving engine) when the
+    deadline is unmeetable under the current service-rate estimate."""
+
+    def __init__(self, message: str, slack_s: float = 0.0):
+        super().__init__(message)
+        self.slack_s = slack_s
+
+
+class ServiceRateEstimator:
+    """EWMA per-frame service time from two independent witnesses.
+
+    ``observe_invoke`` feeds backend invoke latencies (the unfused
+    filter's hot path); ``observe_completion`` feeds the sink-side
+    completion spacing (frames delivered per second of wall progress),
+    which covers fused pipelines where the filter chain never runs and —
+    unlike invoke timing — includes queueing between the dispatch and
+    the materialization. Admission uses the SLOWER estimate: admitting
+    on an optimistic rate re-creates exactly the late-frame pileup this
+    subsystem exists to prevent."""
+
+    def __init__(self, alpha: float = 0.2):
+        self.alpha = float(alpha)
+        self._lock = threading.Lock()
+        self._invoke_s: Optional[float] = None      # per-frame, EWMA
+        self._drain_s: Optional[float] = None       # per-frame, EWMA
+        self._last_completion_t: Optional[float] = None
+
+    def observe_invoke(self, seconds: float, frames: int = 1) -> None:
+        if seconds < 0 or frames < 1:
+            return
+        per = seconds / frames
+        with self._lock:
+            self._invoke_s = per if self._invoke_s is None else \
+                (1 - self.alpha) * self._invoke_s + self.alpha * per
+
+    def observe_completion(self, now: float, frames: int = 1) -> None:
+        if frames < 1:
+            return
+        with self._lock:
+            last = self._last_completion_t
+            self._last_completion_t = now
+            if last is None:
+                return
+            gap = now - last
+            # a multi-second gap is a stall/warmup artifact, not steady
+            # service; folding it in would poison admission for minutes
+            if not (0.0 <= gap <= 5.0):
+                return
+            per = gap / frames
+            self._drain_s = per if self._drain_s is None else \
+                (1 - self.alpha) * self._drain_s + self.alpha * per
+
+    def service_time_s(self) -> float:
+        """Per-frame service-time estimate; 0.0 while cold (admit-all —
+        rejecting on no evidence would deadlock a cold pipeline)."""
+        with self._lock:
+            cands = [v for v in (self._invoke_s, self._drain_s)
+                     if v is not None]
+        return max(cands) if cands else 0.0
+
+    def service_fps(self) -> float:
+        s = self.service_time_s()
+        return (1.0 / s) if s > 0 else 0.0
+
+
+class FeedbackController:
+    """Event-driven AIMD over ``batch_cap`` and ``inflight``.
+
+    Stepped from the observation path (``maybe_step`` — at most one step
+    per ``interval_s``), never from a polling thread: the scheduler's
+    own lint rule (NNS110) bans blocking sleeps in this subsystem.
+    Policy: completion p99 above ``p99_factor`` x budget is an overload
+    signal → multiplicative decrease (halve batch_cap, step inflight
+    down); p99 at or under budget is headroom → additive-ish increase
+    (double batch_cap toward the bucket ceiling, step inflight up).
+    ``batch_cap`` stays a power of two so re-formed batches hit the
+    fused region's already-traced bucketed shapes."""
+
+    def __init__(self, budget_s: float, p99_factor: float = 2.0,
+                 interval_s: float = 0.25, batch_cap: int = 8,
+                 batch_cap_max: int = 64, inflight: int = 2,
+                 inflight_max: int = 8, window: int = 512):
+        self.budget_s = float(budget_s)
+        self.p99_factor = float(p99_factor)
+        self.interval_s = float(interval_s)
+        self.batch_cap_max = int(batch_cap_max)
+        self.inflight_max = int(inflight_max)
+        self._lock = threading.Lock()
+        self.batch_cap = max(1, int(batch_cap))
+        self.inflight = max(1, int(inflight))
+        self.steps = 0
+        self.last_p99_s: Optional[float] = None
+        self._last_step_t = 0.0
+        self._lat: deque = deque(maxlen=int(window))
+
+    def record_completion(self, latency_s: float) -> None:
+        with self._lock:
+            self._lat.append(latency_s)
+
+    def _p99_locked(self) -> Optional[float]:
+        if len(self._lat) < 8:
+            return None
+        vals = sorted(self._lat)
+        return vals[min(len(vals) - 1, int(0.99 * (len(vals) - 1)))]
+
+    def maybe_step(self, now: float) -> bool:
+        """One AIMD step if the interval elapsed and enough completions
+        accumulated. Returns True when the knobs changed."""
+        with self._lock:
+            if now - self._last_step_t < self.interval_s:
+                return False
+            p99 = self._p99_locked()
+            if p99 is None:
+                return False
+            self._last_step_t = now
+            self.last_p99_s = p99
+            self.steps += 1
+            cap0, inf0 = self.batch_cap, self.inflight
+            if p99 > self.p99_factor * self.budget_s:
+                self.batch_cap = max(1, self.batch_cap // 2)
+                self.inflight = max(1, self.inflight - 1)
+            elif p99 <= self.budget_s:
+                self.batch_cap = min(self.batch_cap_max, self.batch_cap * 2)
+                self.inflight = min(self.inflight_max, self.inflight + 1)
+            # between budget and p99_factor*budget: hold — the dead band
+            # keeps the knobs from oscillating around the target
+            return (self.batch_cap, self.inflight) != (cap0, inf0)
+
+
+class SloScheduler:
+    """Owns the admitted population between ingress and device dispatch.
+
+    Attach point: ``Pipeline.start()`` builds one per pipeline when
+    ``slo_budget_ms`` is set (pipeline-level or on any queue); admission
+    queues bind to it in their ``start()``. Also usable standalone by
+    the serving engine (``pipeline=None``) for request-path admission.
+    """
+
+    def __init__(self, budget_ms: float, pipeline=None, name: str = "",
+                 p99_factor: float = 2.0, step_interval_s: float = 0.25,
+                 batch_cap: int = 8, batch_cap_max: int = 64,
+                 inflight_max: int = 8):
+        self.budget_ms = float(budget_ms)
+        self.budget_s = self.budget_ms / 1e3
+        self.pipeline = pipeline
+        self.name = name or getattr(pipeline, "name", "") or "scheduler"
+        self.estimator = ServiceRateEstimator()
+        inflight0 = 2
+        if pipeline is not None:
+            for el in pipeline.elements:
+                if "inflight" in el._props:
+                    inflight0 = max(1, int(el.get_property("inflight")))
+                    break
+        self.controller = FeedbackController(
+            budget_s=self.budget_s, p99_factor=p99_factor,
+            interval_s=step_interval_s, batch_cap=batch_cap,
+            batch_cap_max=batch_cap_max, inflight=inflight0,
+            inflight_max=inflight_max)
+        self._lanes_hint = self._current_lanes()
+        self._obs_ready = False
+        self._m: Dict[str, Any] = {}
+        self._obs_init()
+
+    # -- metrics --------------------------------------------------------------
+    def _obs_init(self) -> None:
+        from nnstreamer_tpu.obs import get_registry
+
+        reg = get_registry()
+        labels = {"pipeline": self.name}
+        self._m = {
+            "admitted": reg.counter(
+                "nns_sched_admitted_total",
+                "Frames/requests admitted under the SLO budget", **labels),
+            "rejected": reg.counter(
+                "nns_sched_rejected_total",
+                "Frames/requests rejected at admission (deadline "
+                "unmeetable under the service-rate estimate)", **labels),
+            "shed_late": reg.counter(
+                "nns_sched_shed_total",
+                "Admitted frames shed before dispatch",
+                reason="late", **labels),
+            "shed_capacity": reg.counter(
+                "nns_sched_shed_total",
+                "Admitted frames shed before dispatch",
+                reason="capacity", **labels),
+            "slack": reg.histogram(
+                "nns_sched_deadline_slack_seconds",
+                "Deadline slack at admission decision time (negative = "
+                "rejected)",
+                buckets=(-1.0, -0.1, -0.01, 0.0, 0.01, 0.05, 0.1,
+                         0.5, 1.0, 5.0), **labels),
+        }
+        # weakref-bound gauge callbacks: the registry holds fns forever,
+        # and a strong self would keep the whole pipeline alive with it
+        import weakref
+
+        ref = weakref.ref(self)
+
+        def _g(attr):
+            def read():
+                s = ref()
+                return float(attr(s)) if s is not None else 0.0
+            return read
+
+        reg.gauge("nns_sched_batch_cap",
+                  "Feedback controller's current batch-forming cap",
+                  fn=_g(lambda s: s.controller.batch_cap), **labels)
+        reg.gauge("nns_sched_inflight_target",
+                  "Feedback controller's current dispatch-window target",
+                  fn=_g(lambda s: s.controller.inflight), **labels)
+        reg.gauge("nns_sched_service_time_ms",
+                  "EWMA per-frame service-time estimate",
+                  fn=_g(lambda s: s.estimator.service_time_s() * 1e3),
+                  **labels)
+        reg.gauge("nns_sched_p99_ms",
+                  "Controller's last observed completion p99",
+                  fn=_g(lambda s: (s.controller.last_p99_s or 0.0) * 1e3),
+                  **labels)
+        reg.gauge("nns_sched_lanes_hint",
+                  "Recommended ingest lane count for the next launch "
+                  "(lanes are start-time-static)",
+                  fn=_g(lambda s: s._lanes_hint), **labels)
+        self._obs_ready = True
+
+    def _current_lanes(self) -> int:
+        try:
+            from nnstreamer_tpu.pipeline.lanes import effective_lanes
+
+            return effective_lanes(getattr(self.pipeline, "lanes", 1) or 1)
+        except Exception:  # noqa: BLE001 — advisory gauge only
+            return 1
+
+    # -- admission ------------------------------------------------------------
+    def decide(self, now: float, backlog: int,
+               deadline_t: Optional[float] = None,
+               budget_ms: Optional[float] = None):
+        """Admission decision without side effects on a buffer:
+        ``(admit, deadline_t, slack_s)``. ``backlog`` is the number of
+        frames already ahead of this one (queued + undelivered); the
+        estimated completion is ``now + (backlog + 1) * service_time``.
+        A cold estimator (service_time 0) admits everything."""
+        budget_s = (float(budget_ms) / 1e3 if budget_ms else self.budget_s)
+        if deadline_t is None:
+            deadline_t = now + budget_s
+        est_done = now + (max(0, backlog) + 1) * \
+            self.estimator.service_time_s()
+        slack = deadline_t - est_done
+        return slack >= 0.0, deadline_t, slack
+
+    def admit(self, buf, now: float, backlog: int,
+              budget_ms: Optional[float] = None) -> bool:
+        """Frame-path admission: decide, record, and stamp. On admit the
+        buffer carries ``admitted_t`` (the served-latency base the sink
+        reads) and ``deadline_t`` (the EDF key); on reject nothing is
+        stamped and the frame is the caller's to drop."""
+        ok, deadline_t, slack = self.decide(
+            now, backlog, deadline_t=buf.meta.get("deadline_t"),
+            budget_ms=budget_ms)
+        self._m["slack"].observe(slack)
+        if not ok:
+            self._m["rejected"].inc()
+            return False
+        buf.meta.setdefault("admitted_t", now)
+        buf.meta["deadline_t"] = deadline_t
+        self._m["admitted"].inc()
+        return True
+
+    def admit_request(self, now: float, backlog: int,
+                      deadline_t: Optional[float] = None) -> None:
+        """Request-path admission (serving engine): raises
+        :class:`SloRejected` when unmeetable, else counts the admit."""
+        ok, deadline_t, slack = self.decide(now, backlog,
+                                            deadline_t=deadline_t)
+        self._m["slack"].observe(slack)
+        if not ok:
+            self._m["rejected"].inc()
+            raise SloRejected(
+                f"{self.name}: deadline unmeetable — backlog {backlog} x "
+                f"{self.estimator.service_time_s() * 1e3:.1f} ms/frame "
+                f"overruns the budget by {-slack * 1e3:.1f} ms",
+                slack_s=slack)
+        self._m["admitted"].inc()
+
+    def note_shed(self, buf, now: float) -> None:
+        """An admitted frame was dropped before dispatch: revoke its
+        admission stamp (the admitted population must net out — a shed
+        frame must never surface as a served-latency sample through a
+        shared-meta path like a tee branch) and count it by reason."""
+        late = buf.meta.get("deadline_t", now) <= now
+        buf.meta.pop("admitted_t", None)
+        buf.meta.pop("deadline_t", None)
+        self._m["shed_late" if late else "shed_capacity"].inc()
+
+    # -- observation feeds ----------------------------------------------------
+    def observe_service(self, seconds: float, frames: int = 1) -> None:
+        """Backend invoke latency (elements/filter.py hot path)."""
+        self.estimator.observe_invoke(seconds, frames)
+
+    def observe_completion(self, latency_s: float, now: float,
+                           frames: int = 1) -> None:
+        """A served frame reached the sink: feed the drain-rate estimate
+        and the controller's p99 window, then give the controller its
+        event-driven chance to step."""
+        self.estimator.observe_completion(now, frames)
+        self.controller.record_completion(latency_s)
+        if self.controller.maybe_step(now):
+            self._apply_knobs()
+
+    # -- knob application -----------------------------------------------------
+    def batch_cap(self) -> int:
+        return self.controller.batch_cap
+
+    def inflight_target(self) -> int:
+        return self.controller.inflight
+
+    def _apply_knobs(self) -> None:
+        """Push the controller's inflight target onto every element that
+        has the knob. Writes ``_props`` directly: ``set_property`` would
+        invalidate the fused region's plan on every step, and perf_smoke
+        proves the window depth changes nothing the plan depends on —
+        the DispatchWindow reads the property live at each admit."""
+        pipe = self.pipeline
+        if pipe is None:
+            return
+        target = self.controller.inflight
+        for el in pipe.elements:
+            if "inflight" in el._props and el._props["inflight"] != target:
+                el._props["inflight"] = target
+        # lanes are spliced once at start(): publish the recommendation
+        # instead of pretending to retune a static knob. Healthy p99 with
+        # capacity sheds means ingest (not the device) is starving the
+        # budget — one more lane is the next launch's cheapest lever.
+        shed = (self._m["shed_capacity"].value
+                + self._m["shed_late"].value)
+        p99 = self.controller.last_p99_s or 0.0
+        cur = self._current_lanes()
+        self._lanes_hint = cur + 1 if (shed > 0 and p99 <= self.budget_s) \
+            else cur
+
+    # -- reporting ------------------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        c = self.controller
+        return {
+            "budget_ms": self.budget_ms,
+            "admitted": int(self._m["admitted"].value),
+            "rejected": int(self._m["rejected"].value),
+            "shed_late": int(self._m["shed_late"].value),
+            "shed_capacity": int(self._m["shed_capacity"].value),
+            "service_time_ms": round(
+                self.estimator.service_time_s() * 1e3, 3),
+            "batch_cap": c.batch_cap,
+            "inflight_target": c.inflight,
+            "controller_steps": c.steps,
+            "p99_ms": round((c.last_p99_s or 0.0) * 1e3, 3),
+            "lanes_hint": self._lanes_hint,
+        }
+
+    def shed_total(self) -> int:
+        return int(self._m["shed_late"].value
+                   + self._m["shed_capacity"].value)
+
+
+def ensure_scheduler(pipeline) -> Optional[SloScheduler]:
+    """Build (once) the pipeline's scheduler from its budget
+    configuration: the pipeline-level ``slo_budget_ms`` wins, else the
+    largest per-queue ``slo_budget_ms`` property. Returns None when no
+    budget is configured — the kill switch: no scheduler object exists
+    and every queue runs its exact pre-scheduler path."""
+    existing = getattr(pipeline, "_slo_scheduler", None)
+    if existing is not None:
+        return existing
+    budget = float(getattr(pipeline, "slo_budget_ms", 0.0) or 0.0)
+    if budget <= 0:
+        budget = max((float(el._props["slo_budget_ms"])
+                      for el in pipeline.elements
+                      if "slo_budget_ms" in el._props), default=0.0)
+    if budget <= 0:
+        return None
+    sched = SloScheduler(budget_ms=budget, pipeline=pipeline)
+    pipeline._slo_scheduler = sched
+    log.info("%s: SLO scheduler attached (budget %.1f ms)",
+             pipeline.name, budget)
+    return sched

@@ -196,7 +196,10 @@ class _BlockingPre(Element):
 @pytest.mark.slow
 def test_ingest_scaling_with_lanes():
     """The acceptance gate: on an ingest-bound pipeline, 4 lanes must
-    beat 1 lane by >1.3× frames/s (best of 2 runs each)."""
+    beat 1 lane by >1.3× frames/s (median of 3 runs each — warm-run fps
+    spreads past 1.6× on shared runners, so a single-run or best-of
+    comparison flakes where the median holds; same rationale as the
+    bench's ``fps_median``/``spread_mad`` fields)."""
     from nnstreamer_tpu.elements.sink import FakeSink
     from nnstreamer_tpu.elements.source import VideoTestSrc
     from nnstreamer_tpu.elements.converter import TensorConverter
@@ -221,6 +224,9 @@ def test_ingest_scaling_with_lanes():
             assert pipe._lane_execs, "segment did not replicate"
         return n_frames / dt
 
-    serial = max(fps(1), fps(1))
-    laned = max(fps(4), fps(4))
+    def median3(lanes: int) -> float:
+        return sorted(fps(lanes) for _ in range(3))[1]
+
+    serial = median3(1)
+    laned = median3(4)
     assert laned > 1.3 * serial, (serial, laned)

@@ -389,6 +389,43 @@ class TestAstLint:
                "        return buf\n")
         assert by_code(lint_source(src, "x.py"), "NNS109") == []
 
+    def test_nns110_sleep_in_sched_hot_path(self):
+        src = ("import time\n"
+               "def _drain_sched(self):\n"
+               "    time.sleep(0.01)\n")
+        assert "NNS110" in codes(lint_source(src, "x.py"))
+
+    def test_nns110_unbounded_waits_flagged_bounded_ok(self):
+        src = ("def _drain_sched(self):\n"
+               "    item = self._q.get()\n"
+               "def admit(self, buf):\n"
+               "    self._ev.wait()\n"
+               "    self._cv.wait_for(self._pred)\n")
+        assert len(by_code(lint_source(src, "x.py"), "NNS110")) == 3
+        src_ok = ("def _drain_sched(self):\n"
+                  "    item = self._q.get(timeout=0.1)\n"
+                  "def admit(self, buf):\n"
+                  "    self._ev.wait(0.5)\n"
+                  "    self._cv.wait_for(self._pred, 1.0)\n")
+        assert by_code(lint_source(src_ok, "x.py"), "NNS110") == []
+
+    def test_nns110_dict_get_and_cold_paths_ok(self):
+        # d.get(key) is not a blocking call, and the same forever-wait
+        # outside the scheduler/dispatch hot-path set is NNS102's (lock)
+        # or nobody's business
+        src = ("def admit(self, buf):\n"
+               "    t = buf.meta.get('deadline_t')\n"
+               "def shutdown(self):\n"
+               "    self._q.get()\n"
+               "    self._ev.wait()\n")
+        assert by_code(lint_source(src, "x.py"), "NNS110") == []
+
+    def test_nns110_pragma_suppressible(self):
+        src = ("def _flush_edf(self):\n"
+               "    self._ev.wait()  # nns-lint: disable=NNS110 -- "
+               "teardown-only flush, no admission live\n")
+        assert by_code(lint_source(src, "x.py"), "NNS110") == []
+
     def test_pragma_suppresses_with_reason(self):
         src = ("import time\n"
                "d = time.time()  # nns-lint: disable=NNS101 -- epoch "

@@ -1,0 +1,406 @@
+"""SLO scheduler (serving/scheduler.py + Queue scheduler mode).
+
+The contract under test:
+
+- admission rejects work whose deadline is unmeetable under the
+  service-rate estimate (and admits everything while cold);
+- the admission queue delivers in EDF order when frames carry jittered
+  deadlines, and sheds already-late frames first under overflow — with
+  every shed frame's admission stamp revoked so the admitted population
+  nets out (the PR's saturation-pacing fix);
+- budget unset is a kill switch: no scheduler object exists and the
+  pipeline's output is byte-identical to the pre-scheduler FIFO path;
+  budget set but unloaded must also be byte-identical (uniform budget
+  ⇒ monotone deadlines ⇒ EDF pop order == FIFO);
+- the serving engine's request path raises SloRejected instead of
+  queueing doomed requests.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.pipeline.element import Element, EosEvent, FlowReturn
+from nnstreamer_tpu.pipeline.pipeline import Pipeline, Queue, SourceElement
+from nnstreamer_tpu.serving.scheduler import (
+    FeedbackController,
+    ServiceRateEstimator,
+    SloRejected,
+    SloScheduler,
+)
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
+
+
+def _buf(i: int, deadline_t=None) -> TensorBuffer:
+    buf = TensorBuffer([np.array([float(i)], np.float32)], pts=i * 1000)
+    if deadline_t is not None:
+        buf.meta["deadline_t"] = deadline_t
+    return buf
+
+
+class _NumSrc(SourceElement):
+    ELEMENT_NAME = "_sched_numsrc"
+    PROPERTIES = {**SourceElement.PROPERTIES, "num_buffers": 5}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.i = 0
+
+    def negotiate(self):
+        from nnstreamer_tpu.tensors.types import TensorsConfig
+
+        cfg = TensorsConfig.from_arrays([np.zeros((1,), np.float32)])
+        self.srcpad.set_caps(cfg.to_caps())
+
+    def create(self):
+        if self.i >= self.get_property("num_buffers"):
+            return None
+        buf = _buf(self.i)
+        self.i += 1
+        return buf
+
+
+class _Gate(Element):
+    """Blocks the queue worker inside chain() until released — lets a
+    test park the drain loop while it stacks frames into the EDF heap."""
+
+    ELEMENT_NAME = "_sched_gate"
+    PROPERTIES = {}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+        self.entered = threading.Event()
+        self.release = threading.Event()
+
+    def chain(self, pad, buf):
+        self.entered.set()
+        assert self.release.wait(timeout=10)
+        return self.srcpads[0].push(buf)
+
+
+class _Collect(Element):
+    ELEMENT_NAME = "_sched_collect"
+    PROPERTIES = {}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad("sink")
+        self.buffers = []
+        self.got_eos = False
+
+    def chain(self, pad, buf):
+        self.buffers.append(buf)
+        return FlowReturn.OK
+
+    def sink_event(self, pad, event):
+        if isinstance(event, EosEvent):
+            self.got_eos = True
+
+
+# -- estimator / controller / admission units ---------------------------------
+
+
+class TestServiceRateEstimator:
+    def test_cold_admits_all(self):
+        est = ServiceRateEstimator()
+        assert est.service_time_s() == 0.0
+        assert est.service_fps() == 0.0
+
+    def test_slower_witness_governs(self):
+        est = ServiceRateEstimator()
+        est.observe_invoke(0.010)          # invoke says 10 ms/frame
+        est.observe_completion(100.0)
+        est.observe_completion(100.05)     # drain says 50 ms/frame
+        assert est.service_time_s() == pytest.approx(0.05)
+
+    def test_stall_gap_excluded(self):
+        est = ServiceRateEstimator()
+        est.observe_completion(10.0)
+        est.observe_completion(20.0)       # 10 s gap: warmup artifact
+        assert est.service_time_s() == 0.0
+        est.observe_completion(20.02)      # but the clock did advance
+        assert est.service_time_s() == pytest.approx(0.02)
+
+
+class TestAdmission:
+    def test_rejects_unmeetable_deadline(self):
+        sched = SloScheduler(budget_ms=50)
+        sched.observe_service(0.1)         # 100 ms/frame
+        ok, _dl, slack = sched.decide(now=10.0, backlog=0)
+        assert not ok and slack < 0
+        # backlog makes it worse, not better
+        ok, _dl, slack5 = sched.decide(now=10.0, backlog=5)
+        assert not ok and slack5 < slack
+
+    def test_admits_with_headroom_and_stamps(self):
+        sched = SloScheduler(budget_ms=500)
+        sched.observe_service(0.01)
+        buf = _buf(0)
+        assert sched.admit(buf, now=10.0, backlog=3)
+        assert buf.meta["admitted_t"] == 10.0
+        assert buf.meta["deadline_t"] == pytest.approx(10.5)
+
+    def test_request_path_raises_slo_rejected(self):
+        sched = SloScheduler(budget_ms=50)
+        sched.observe_service(0.1)
+        with pytest.raises(SloRejected) as ei:
+            sched.admit_request(now=10.0, backlog=2)
+        assert ei.value.slack_s < 0
+
+    def test_note_shed_revokes_stamp_and_counts_reason(self):
+        sched = SloScheduler(budget_ms=1000, name="shed-unit")
+        late = _buf(0)
+        ontime = _buf(1)
+        assert sched.admit(late, now=10.0, backlog=0)
+        assert sched.admit(ontime, now=10.0, backlog=0)
+        sched.note_shed(late, now=12.0)    # deadline 11.0 < now: late
+        sched.note_shed(ontime, now=10.5)  # still had slack: capacity
+        assert "admitted_t" not in late.meta
+        assert "deadline_t" not in late.meta
+        snap = sched.snapshot()
+        assert snap["shed_late"] == 1
+        assert snap["shed_capacity"] == 1
+
+
+class TestFeedbackController:
+    def test_aimd_steps_and_power_of_two_cap(self):
+        # window=16 so the recovery phase fully replaces the overload
+        # samples the p99 reads
+        ctl = FeedbackController(budget_s=0.05, batch_cap=8, inflight=2,
+                                 window=16)
+        for _ in range(16):                # p99 far past 2x budget
+            ctl.record_completion(0.5)
+        assert ctl.maybe_step(now=1.0)
+        assert ctl.batch_cap == 4 and ctl.inflight == 1
+        for _ in range(16):                # healthy again
+            ctl.record_completion(0.01)
+        assert ctl.maybe_step(now=2.0)
+        assert ctl.batch_cap == 8 and ctl.inflight == 2
+        # every value the controller visits stays a power of two
+        assert ctl.batch_cap & (ctl.batch_cap - 1) == 0
+
+    def test_dead_band_holds(self):
+        ctl = FeedbackController(budget_s=0.05, batch_cap=8, inflight=2)
+        for _ in range(64):                # between budget and 2x budget
+            ctl.record_completion(0.07)
+        assert not ctl.maybe_step(now=1.0)
+        assert ctl.batch_cap == 8 and ctl.inflight == 2
+
+    def test_interval_rate_limits_steps(self):
+        ctl = FeedbackController(budget_s=0.05, interval_s=0.25)
+        for _ in range(16):
+            ctl.record_completion(0.5)
+        assert ctl.maybe_step(now=1.0)
+        for _ in range(16):
+            ctl.record_completion(0.5)
+        assert not ctl.maybe_step(now=1.1)  # inside the interval
+
+
+# -- queue scheduler mode (EDF / shedding) ------------------------------------
+
+
+def _sched_pipe(name, budget_ms=10_000.0, max_size=32):
+    pipe = Pipeline(name=name, fuse=False, slo_budget_ms=budget_ms)
+    q = Queue(name="q", stamp_admission=True, max_size_buffers=max_size)
+    gate = _Gate(name="gate")
+    col = _Collect(name="col")
+    pipe.add_linked(q, gate, col)
+    pipe.start()
+    assert pipe._slo_scheduler is not None
+    assert q._sched is pipe._slo_scheduler
+    return pipe, q, gate, col
+
+
+class TestEdfQueue:
+    def test_edf_order_under_deadline_jitter(self):
+        pipe, q, gate, col = _sched_pipe("edf-jitter")
+        try:
+            now = time.monotonic()
+            # plug: parks the worker inside the gate with frame 0
+            q.chain(None, _buf(0, deadline_t=now + 9.0))
+            assert gate.entered.wait(timeout=5)
+            # jittered deadlines, arrival order != deadline order
+            q.chain(None, _buf(1, deadline_t=now + 3.0))
+            q.chain(None, _buf(2, deadline_t=now + 1.0))
+            q.chain(None, _buf(3, deadline_t=now + 2.0))
+            gate.release.set()
+            q.sink_event(None, EosEvent())  # blocks until drained
+            assert [b.pts for b in col.buffers] == [0, 2000, 3000, 1000]
+            assert col.got_eos
+        finally:
+            gate.release.set()
+            pipe.stop()
+
+    def test_shed_late_first_then_least_urgent(self):
+        from nnstreamer_tpu.obs import get_registry
+
+        pipe, q, gate, col = _sched_pipe("edf-shed", max_size=2)
+        try:
+            def revoked():
+                c = get_registry().get("nns_queue_admitted_revoked_total",
+                                       pipeline="edf-shed", element="q")
+                return float(c.value) if c is not None else 0.0
+
+            r0 = revoked()
+            now = time.monotonic()
+            q.chain(None, _buf(0, deadline_t=now + 9.0))  # plug
+            assert gate.entered.wait(timeout=5)
+            q.chain(None, _buf(1, deadline_t=now + 0.05))
+            q.chain(None, _buf(2, deadline_t=now + 5.0))
+            time.sleep(0.12)  # frame 1's deadline passes IN the heap
+            # overflow: the late frame sheds first, on-time ones survive
+            q.chain(None, _buf(3, deadline_t=time.monotonic() + 6.0))
+            snap = pipe._slo_scheduler.snapshot()
+            assert snap["shed_late"] == 1
+            # overflow with nothing late: least-urgent (latest deadline)
+            q.chain(None, _buf(4, deadline_t=time.monotonic() + 7.0))
+            snap = pipe._slo_scheduler.snapshot()
+            assert snap["shed_capacity"] == 1
+            # every shed revoked its admission stamp (population nets out)
+            assert revoked() - r0 == 2
+            gate.release.set()
+            q.sink_event(None, EosEvent())
+            # survivors in EDF order: plug, then 2 then 3 (4 was shed)
+            assert [b.pts for b in col.buffers] == [0, 2000, 3000]
+            for b in col.buffers:
+                assert "admitted_t" in b.meta
+        finally:
+            gate.release.set()
+            pipe.stop()
+
+    def test_cold_queue_rejects_once_estimator_says_unmeetable(self):
+        pipe, q, gate, col = _sched_pipe("edf-reject", budget_ms=50)
+        try:
+            pipe._slo_scheduler.observe_service(0.1)  # 100 ms/frame
+            gate.release.set()
+            q.chain(None, _buf(0))  # no override: budget deadline
+            q.sink_event(None, EosEvent())
+            assert col.buffers == []
+            assert pipe._slo_scheduler.snapshot()["rejected"] == 1
+        finally:
+            gate.release.set()
+            pipe.stop()
+
+
+# -- kill switch / byte-identical ---------------------------------------------
+
+
+def _run_numeric(budget_ms, n=6):
+    pipe = Pipeline(name=f"ident-{int(budget_ms)}", fuse=False,
+                    slo_budget_ms=budget_ms)
+    src = _NumSrc(num_buffers=n)
+    q = Queue(name="q", stamp_admission=True, max_size_buffers=16)
+    col = _Collect(name="col")
+    pipe.add_linked(src, q, col)
+    msg = pipe.run(timeout=30)
+    assert msg is not None and msg.kind == "eos", msg
+    vals = [np.asarray(b.tensors[0]).tobytes() for b in col.buffers]
+    return pipe, vals
+
+
+class TestKillSwitch:
+    def test_budget_unset_builds_no_scheduler(self):
+        pipe, vals = _run_numeric(0.0)
+        assert pipe._slo_scheduler is None
+        assert pipe.get("q")._sched is None
+        assert len(vals) == 6
+
+    def test_unloaded_output_byte_identical_to_fifo(self):
+        pipe0, base = _run_numeric(0.0)
+        pipe1, sched = _run_numeric(60_000.0)
+        assert pipe1._slo_scheduler is not None
+        assert sched == base
+        snap = pipe1._slo_scheduler.snapshot()
+        assert snap["admitted"] == 6
+        assert snap["rejected"] == 0
+        assert snap["shed_late"] == snap["shed_capacity"] == 0
+
+    def test_sched_series_exported(self):
+        from nnstreamer_tpu.obs import get_registry
+
+        _pipe, _vals = _run_numeric(60_000.0)
+        body = get_registry().render_prometheus()
+        for series in ("nns_sched_admitted_total",
+                       "nns_sched_batch_cap",
+                       "nns_sched_inflight_target",
+                       "nns_sched_service_time_ms",
+                       "nns_sched_lanes_hint",
+                       "nns_queue_admitted_total"):
+            assert series in body, f"{series} missing from registry"
+
+
+class TestAdmissionStampsSurviveAggregation:
+    """The bench's admitted-population accounting (admitted_fps /
+    latency_sat) reads admission stamps AT THE SINK — with a
+    tensor_aggregator between the stamping queue and the sink, the
+    stamps must ride the window (meta["admitted_ts"], one per
+    constituent frame, lockstep with create_ts)."""
+
+    def test_admitted_population_counted_through_aggregator(self):
+        from nnstreamer_tpu import parse_launch
+
+        pipe = parse_launch(
+            "videotestsrc num-buffers=16 width=8 height=8 ! "
+            "tensor_converter ! "
+            "queue name=q max-size-buffers=32 stamp-admission=true ! "
+            "tensor_aggregator frames-in=1 frames-out=4 frames-flush=4 "
+            "frames-dim=3 concat=true ! "
+            "tensor_sink name=sink to-host=true")
+        msg = pipe.run(timeout=60)
+        assert msg is not None and msg.kind == "eos"
+        sink = pipe.get("sink")
+        # every constituent frame of every window is one admitted sample
+        assert sink.admitted_latencies.count == 16
+        assert sink.latency_percentiles(99.0, base="admitted") is not None
+
+
+# -- serving engine request path ----------------------------------------------
+
+
+class TestEngineAdmission:
+    def test_submit_raises_when_unmeetable(self):
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.models.transformer import (
+            TransformerConfig,
+            init_params,
+        )
+        from nnstreamer_tpu.serving.engine import ContinuousBatchingEngine
+
+        cfg = TransformerConfig(vocab=97, d_model=64, n_heads=4,
+                                n_layers=2, d_ff=128, max_seq=64,
+                                dtype=jnp.float32)
+        params = init_params(cfg, seed=3)
+        eng = ContinuousBatchingEngine(cfg, params, max_streams=2,
+                                       steps_per_dispatch=4,
+                                       temperature=0.0,
+                                       slo_budget_ms=50).start()
+        try:
+            assert eng._slo is not None
+            # the estimate says 1 s/request against a 50 ms budget
+            eng._slo.estimator.observe_invoke(1.0)
+            with pytest.raises(SloRejected):
+                eng.submit([1, 2, 3], max_new_tokens=4)
+        finally:
+            eng.stop()
+
+    def test_no_budget_no_scheduler(self):
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.models.transformer import (
+            TransformerConfig,
+            init_params,
+        )
+        from nnstreamer_tpu.serving.engine import ContinuousBatchingEngine
+
+        cfg = TransformerConfig(vocab=97, d_model=64, n_heads=4,
+                                n_layers=2, d_ff=128, max_seq=64,
+                                dtype=jnp.float32)
+        params = init_params(cfg, seed=3)
+        eng = ContinuousBatchingEngine(cfg, params, max_streams=2,
+                                       steps_per_dispatch=4)
+        assert eng._slo is None
