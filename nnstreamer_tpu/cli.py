@@ -248,6 +248,14 @@ def main(argv=None) -> int:
                          "reassembly (byte-identical output); 1 is the "
                          "serial path, NNSTPU_LANES overrides (see "
                          "docs/profiling.md, Ingest scaling)")
+    ap.add_argument("--trace-out", metavar="FILE", default=None,
+                    help="record a per-frame lifecycle timeline (lanes, "
+                         "queue/EDF residency, dispatch fences, "
+                         "transfers, decode, sink) and write it as "
+                         "Perfetto/Chrome trace JSON to FILE at EOS; "
+                         "prints the per-stage latency breakdown. "
+                         "NNSTPU_TRACE=FILE does the same without the "
+                         "flag (see docs/profiling.md, Frame timelines)")
     ap.add_argument("--slo-budget-ms", type=float, default=None,
                     metavar="MS",
                     help="pipeline-wide SLO latency budget: activates "
@@ -321,6 +329,13 @@ def main(argv=None) -> int:
                 el.connect(lambda buf, name=el.name:
                            print(f"{name}: {buf!r}"))
 
+    trace_tl = None
+    if args.trace_out is not None:
+        from nnstreamer_tpu.obs import timeline as _timeline
+
+        trace_tl = _timeline.activate()
+        trace_tl.export_path = args.trace_out
+
     metrics_srv = None
     if args.metrics_port is not None:
         from nnstreamer_tpu.obs import MetricsServer
@@ -352,12 +367,47 @@ def main(argv=None) -> int:
 
         if not args.quiet:
             _print_stats(pipe)
+        if trace_tl is not None:
+            try:
+                trace_tl.export_chrome(args.trace_out)
+            except OSError as e:
+                print(f"nns-launch: trace export failed: {e}",
+                      file=sys.stderr)
+                return 1
+            print(f"Wrote frame timeline to {args.trace_out} "
+                  f"(load in ui.perfetto.dev)")
+            _print_trace_breakdown(trace_tl)
         return 0
     finally:
+        if trace_tl is not None:
+            from nnstreamer_tpu.obs import timeline as _timeline
+
+            _timeline.deactivate()
         # the exporter outlives EOS so a scraper can collect the final
         # counters; it stops only when the process is about to exit
         if metrics_srv is not None:
             metrics_srv.stop()
+
+
+def _print_trace_breakdown(tl) -> None:
+    """Post-EOS stage-breakdown footer for --trace-out: where a frame's
+    end-to-end time went, and which stage owns the run's variance."""
+    bd = tl.stage_breakdown()
+    if not bd["frames"]:
+        print("-- frame timeline: no completed frames recorded")
+        return
+    stages = " ".join(f"{k}={v:.2f}" for k, v in bd["stages_ms"].items()
+                      if v > 0.0)
+    print(f"-- frame timeline: {bd['frames']} frames, "
+          f"e2e mean {bd['e2e_mean_ms']:.2f}ms, stages(ms) {stages}, "
+          f"unattributed {bd['unattributed_ms']:.2f}ms "
+          f"(reconciliation {bd['reconciliation']:.2f})")
+    vr = tl.variance_report()
+    if vr["dominant_stage"] is not None:
+        print(f"-- frame timeline: e2e spread (MAD) "
+              f"{vr['e2e_mad_ms']:.2f}ms, dominated by "
+              f"{vr['dominant_stage']} "
+              f"({vr['dominant_share']:.0%} of the spread)")
 
 
 def _print_stats(pipe) -> None:

@@ -30,6 +30,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from nnstreamer_tpu.obs import timeline as _timeline
 from nnstreamer_tpu.pipeline.element import Element
 from nnstreamer_tpu.registry import ELEMENT, subplugin
 from nnstreamer_tpu.tensors.buffer import TensorBuffer, is_device_array
@@ -84,6 +85,10 @@ class TensorAggregator(Element):
         #: — emitted as meta["admitted_ts"] so the sink's served-traffic
         #: latency population survives micro-batching
         self._admit_ts: List[float] = []
+        #: trace seqs of the unit frames in flight (timeline active
+        #: only), same lockstep discipline as _create_ts — a combined
+        #: window adopts its earliest constituent's frame identity
+        self._tl_seqs: List[Optional[int]] = []
         #: budget clock per queued unit frame: its create stamp when one
         #: flowed (end-to-end budget), else its aggregator arrival time
         self._held_since: List[float] = []
@@ -176,6 +181,11 @@ class TensorAggregator(Element):
             deficit = max(0, len(self._windows[0]) - len(self._create_ts))
             self._create_ts.extend([None] * deficit)
             self._create_ts.extend(stamps if stamps else [None] * n)
+        if _timeline.ACTIVE is not None or self._tl_seqs:
+            deficit = max(0, len(self._windows[0]) - len(self._tl_seqs))
+            self._tl_seqs.extend([None] * deficit)
+            self._tl_seqs.extend(
+                [buf.meta.get(_timeline.TRACE_SEQ_META)] * n)
         adm = buf.meta.get("admitted_t")
         if adm is not None or self._admit_ts:
             # same alignment discipline as _create_ts: the buffer's one
@@ -213,12 +223,17 @@ class TensorAggregator(Element):
                            if s is not None]
                 if out_adm:
                     meta["admitted_ts"] = out_adm
+            seq = next((s for s in self._tl_seqs[:fout]
+                        if s is not None), None)
+            if seq is not None:
+                meta[_timeline.TRACE_SEQ_META] = seq
             ret = self.srcpad.push(
                 TensorBuffer(outs, pts=self._pts, meta=meta)
             )
             self._windows = [w[flush:] for w in self._windows]
             self._create_ts = self._create_ts[flush:]
             self._admit_ts = self._admit_ts[flush:]
+            self._tl_seqs = self._tl_seqs[flush:]
             self._held_since = self._held_since[flush:]
             self._pts = buf.pts
         if budget > 0 and self._held_since and \
@@ -330,10 +345,14 @@ class TensorAggregator(Element):
         out_adm = [s for s in self._admit_ts[:k] if s is not None]
         if out_adm:
             meta["admitted_ts"] = out_adm
+        seq = next((s for s in self._tl_seqs[:k] if s is not None), None)
+        if seq is not None:
+            meta[_timeline.TRACE_SEQ_META] = seq
         ret = self.srcpad.push(TensorBuffer(outs, pts=self._pts, meta=meta))
         self._windows = [[] for _ in self._windows]
         self._create_ts = []
         self._admit_ts = []
+        self._tl_seqs = []
         self._held_since = []
         self._pts = None
         return ret
@@ -347,5 +366,6 @@ class TensorAggregator(Element):
             self._windows.clear()
             self._create_ts.clear()
             self._admit_ts.clear()
+            self._tl_seqs.clear()
             self._held_since.clear()
             self._pts = None

@@ -14,8 +14,10 @@ dict of ``option1..optionN`` strings (reference mode options).
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Optional
 
+from nnstreamer_tpu.obs import timeline as _timeline
 from nnstreamer_tpu.pipeline.element import Element
 from nnstreamer_tpu.registry import DECODER, ELEMENT, get_subplugin, subplugin
 from nnstreamer_tpu.tensors.types import TensorsConfig
@@ -94,5 +96,17 @@ class TensorDecoder(Element):
 
     def chain(self, pad, buf):
         dec = self._get_decoder()
-        out = dec.decode(buf.to_host(), self._config, self._options())
+        # materialize FIRST so the timeline's d2h span (recorded inside
+        # to_host) isn't double-counted under the decode span below
+        host = buf.to_host()
+        tl = _timeline.ACTIVE
+        if tl is None:
+            out = dec.decode(host, self._config, self._options())
+        else:
+            t0 = time.monotonic()
+            out = dec.decode(host, self._config, self._options())
+            seq = buf.meta.get(_timeline.TRACE_SEQ_META)
+            if seq is not None:
+                tl.span("decode", seq, t0, time.monotonic(),
+                        track=self.name)
         return self.srcpad.push(out)

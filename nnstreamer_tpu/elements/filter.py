@@ -33,6 +33,7 @@ import numpy as np
 
 from nnstreamer_tpu.config import get_conf
 from nnstreamer_tpu.obs import get_registry
+from nnstreamer_tpu.obs import timeline as _timeline
 from nnstreamer_tpu.filters.api import FilterFramework, FilterProperties
 from nnstreamer_tpu.pipeline.element import (
     CustomEvent,
@@ -329,6 +330,11 @@ class TensorFilter(Element):
         outputs = fw.invoke(model_inputs)
         dt = _time.monotonic() - t0
         obs["invoke"].observe(dt)
+        tl = _timeline.ACTIVE
+        seq = buf.meta.get(_timeline.TRACE_SEQ_META) \
+            if tl is not None else None
+        if tl is not None and seq is not None:
+            tl.span("device", seq, t0, t0 + dt, track=self.name)
         sched = getattr(self.pipeline, "_slo_scheduler", None)
         if sched is not None:
             # feed the admission controller's service-rate EWMA; the
@@ -351,7 +357,7 @@ class TensorFilter(Element):
             # and pooled staging inputs recycle at that fence point.
             # Host-only results with no stash skip the window entirely —
             # nothing is outstanding for them.
-            self._window.admit(final, stash)
+            self._window.admit(final, stash, frame=seq)
         out_buf = buf.with_tensors(final)
         if peer_device_capable(self.srcpad):
             # device-capable downstream: keep the result resident (no-op

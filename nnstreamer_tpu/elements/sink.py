@@ -15,6 +15,7 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
+from nnstreamer_tpu.obs import timeline as _timeline
 from nnstreamer_tpu.pipeline.element import Element, EosEvent, FlowReturn
 from nnstreamer_tpu.registry import ELEMENT, subplugin
 from nnstreamer_tpu.tensors.buffer import TensorBuffer
@@ -147,6 +148,11 @@ class TensorSink(Element):
                 buf = buf.with_tensors([
                     t[:k] if getattr(t, "ndim", 0) and t.shape[0] > k
                     else t for t in buf.tensors])
+        # sink-stage span starts AFTER materialization: the D2H block is
+        # already recorded (inside to_host) as this frame's d2h stage
+        tl = _timeline.ACTIVE
+        t_sink0 = time.monotonic() if tl is not None else 0.0
+        e2e_s: Optional[float] = None
         # end-to-end frame latency: source create() → here (payload is
         # host-materialized above). Under micro-batching meta carries one
         # capture stamp per constituent frame, so each frame's latency
@@ -170,6 +176,10 @@ class TensorSink(Element):
                 for t in stamps:
                     self.latencies.append(now - t)
                     hist.observe(now - t)
+                if tl is not None:
+                    # the frame's measured e2e rides on the sink span —
+                    # the reconciliation denominator for stage_breakdown
+                    e2e_s = now - (sum(stamps) / len(stamps))
             # aggregated buffers carry one admission stamp per
             # constituent frame (meta["admitted_ts"], kept in lockstep
             # with create_ts by tensor_aggregator); unaggregated ones
@@ -201,6 +211,15 @@ class TensorSink(Element):
             self._cv.notify_all()
         for cb in self._callbacks:
             cb(buf)
+        if tl is not None:
+            seq = buf.meta.get(_timeline.TRACE_SEQ_META)
+            if seq is not None:
+                if e2e_s is not None:
+                    tl.span("sink", seq, t_sink0, time.monotonic(),
+                            track=self.name, e2e_s=e2e_s)
+                else:
+                    tl.span("sink", seq, t_sink0, time.monotonic(),
+                            track=self.name)
         return FlowReturn.OK
 
     def latency_percentiles(self, *qs: float, skip: int = 0,

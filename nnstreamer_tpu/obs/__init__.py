@@ -36,3 +36,10 @@ from nnstreamer_tpu.obs.collectors import (  # noqa: F401
     register_pipeline_collector,
 )
 from nnstreamer_tpu.obs.server import MetricsServer  # noqa: F401
+from nnstreamer_tpu.obs.timeline import (  # noqa: F401
+    TRACE_SEQ_META,
+    Timeline,
+    jax_correlation,
+    trace_enabled,
+    tracing,
+)

@@ -1,0 +1,413 @@
+"""Frame-ledger timeline: per-frame lifecycle spans across the async
+substrate (lanes, queues, scheduler, dispatch window, transfers).
+
+The PR-1 ``utils/trace.py`` Tracer wraps synchronous ``_chain_entry``
+calls — one COMPLETE slice per element invoke — which was the whole
+story when the pipeline WAS its chain calls. Everything built since is
+asynchronous: DispatchWindow keeps K device batches in flight, lane
+workers process frames out of order behind a reorder buffer, the SLO
+scheduler holds frames in an EDF heap and sheds them, DeviceBuffers
+defer their D2H to the sink. None of that shows up in a chain-wrapped
+trace. This module records where a FRAME's time actually goes.
+
+Recording model
+---------------
+A :class:`Timeline` is installed process-wide (``ACTIVE``). The source
+thread stamps a monotone sequence id (``meta["trace_seq"]``) on every
+frame — the same single-writer monotone-id discipline the lane executor
+already uses for reorder reassembly — and instrumentation points across
+the stack append typed spans keyed by that id. Each recording thread
+appends into its own bounded ring (``deque(maxlen=capacity)``): no
+lock, no allocation beyond the tuple, GIL-atomic append. Export drains
+every ring, so a span is attributed to the thread that recorded it
+(lane workers, queue drains, the source loop each get their own track).
+
+With no timeline installed (``ACTIVE is None`` — the default) every
+instrumentation site is a single module-attribute read and an ``is
+None`` test: the off path stays byte-identical and effectively free,
+matching the ``NNSTPU_RESIDENT`` / ``NNSTPU_LANES`` kill-switch
+discipline.
+
+Stage semantics (the frame ledger)
+----------------------------------
+The canonical span kinds in :data:`STAGES` tile a frame's critical
+path, so their per-frame sums reconcile with the sink's end-to-end
+latency:
+
+- ``ingest``      source ``create()`` → first queue entry (host
+                  preprocessing, minus any reorder-buffer wait)
+- ``lane_reorder``time parked in the lane reorder buffer
+- ``queue_wait``  FIFO queue residency (entry → drain pop)
+- ``sched_hold``  EDF-heap residency in a scheduler-mode queue
+- ``fence_wait``  dispatch-window fence block for the frame's own entry
+- ``device``      filter/fused-region invoke dispatch
+- ``d2h``         the sanctioned ``to_host()`` materialization block
+- ``decode``      tensor→media decode (host part)
+- ``sink``        sink-side completion work after materialization
+
+Non-tiling kinds (``h2d``, ``lane_exec``, ``lane_stall``) and instant
+events (``sched_reject``, ``sched_shed``, ``sched_revoked``,
+``submit``) appear in the exported trace but are excluded from the
+reconciliation sum — they overlap the stages above in wall time.
+
+Export
+------
+:meth:`Timeline.to_chrome` emits Chrome trace-event JSON that Perfetto
+loads directly: one process, one named thread track per recording
+thread / lane / queue, ``ph:"X"`` slices with ``args`` carrying the
+frame seq, ``s``/``t``/``f`` flow events linking one frame across
+tracks, and ``b``/``e`` async spans for dispatch-window inflight slots.
+:meth:`stage_breakdown` aggregates the same records into per-stage
+means that must sum to ~e2e; :meth:`variance_report` attributes
+warm-run spread to its dominant stage. :func:`jax_correlation` runs
+``jax.profiler`` over the same window so the XLA device trace can be
+lined up with the frame ledger in one Perfetto session.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional, Tuple
+
+#: meta key carrying the frame's trace context: a monotone sequence id
+#: stamped once by the source thread (single writer, like the lane
+#: executor's ``lane_seq``)
+TRACE_SEQ_META = "trace_seq"
+
+#: span kinds that tile a frame's critical path — the stage_breakdown /
+#: reconciliation set, in pipeline order
+STAGES: Tuple[str, ...] = ("ingest", "lane_reorder", "queue_wait",
+                           "sched_hold", "fence_wait", "device", "d2h",
+                           "decode", "sink")
+
+_ENV = "NNSTPU_TRACE"
+
+#: the process-wide active timeline; ``None`` means tracing is OFF and
+#: every instrumentation site reduces to one attribute read + is-None
+#: test. Hot paths read this directly (``_timeline.ACTIVE``).
+ACTIVE: Optional["Timeline"] = None
+
+
+def trace_enabled() -> bool:
+    """True when ``NNSTPU_TRACE`` asks for tracing (any non-empty value
+    except the usual falsy spellings; a value that is not a boolean
+    spelling is taken as the export path)."""
+    v = os.environ.get(_ENV, "").strip()
+    return bool(v) and v.lower() not in ("0", "false", "no", "off")
+
+
+def env_export_path() -> Optional[str]:
+    """The export path carried in ``NNSTPU_TRACE``, if it names one."""
+    v = os.environ.get(_ENV, "").strip()
+    if not v or v.lower() in ("0", "false", "no", "off", "1", "true",
+                              "yes", "on"):
+        return None
+    return v
+
+
+def active() -> Optional["Timeline"]:
+    return ACTIVE
+
+
+def activate(capacity: int = 1 << 16) -> "Timeline":
+    """Install a fresh process-wide timeline and return it."""
+    global ACTIVE
+    tl = Timeline(capacity)
+    ACTIVE = tl
+    return tl
+
+
+def deactivate() -> None:
+    global ACTIVE
+    ACTIVE = None
+
+
+@contextmanager
+def tracing(capacity: int = 1 << 16):
+    """Scoped activation: ``with tracing() as tl: pipe.run(...)``."""
+    tl = activate(capacity)
+    try:
+        yield tl
+    finally:
+        if ACTIVE is tl:
+            deactivate()
+
+
+def maybe_activate_env() -> Optional["Timeline"]:
+    """``Pipeline.start()`` hook: honor ``NNSTPU_TRACE`` without code
+    changes. Idempotent; an explicitly installed timeline wins."""
+    if ACTIVE is not None:
+        return ACTIVE
+    if not trace_enabled():
+        return None
+    tl = activate()
+    tl.export_path = env_export_path()
+    tl._env_owned = True
+    return tl
+
+
+def maybe_export_env() -> None:
+    """``Pipeline.stop()`` hook: export + retire an env-owned timeline
+    (``NNSTPU_TRACE=/path/to/trace.json``)."""
+    tl = ACTIVE
+    if tl is None or not tl._env_owned:
+        return
+    if tl.export_path:
+        try:
+            tl.export_chrome(tl.export_path)
+        except OSError:
+            pass  # an unwritable path must not take down pipeline stop
+    deactivate()
+
+
+@contextmanager
+def jax_correlation(logdir: str):
+    """Run ``jax.profiler`` over the same window as the active timeline
+    so the XLA device trace and the frame ledger share a wall-clock
+    span and can be loaded side by side in Perfetto. Degrades to a
+    no-op when the profiler is unavailable."""
+    started = False
+    try:
+        import jax
+
+        jax.profiler.start_trace(logdir)
+        started = True
+    except Exception:  # noqa: BLE001 — profiling is best-effort
+        started = False
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:  # nns-lint: disable=NNS104 -- stop_trace after a successful start can only fail at teardown; the ledger export must still proceed
+                pass
+
+
+class Timeline:
+    """Low-overhead frame-ledger recorder (see module docstring)."""
+
+    def __init__(self, capacity: int = 1 << 16):
+        self.capacity = int(capacity)
+        self.epoch = time.monotonic()
+        self.export_path: Optional[str] = None
+        self._env_owned = False
+        self._seq = itertools.count()  # next() is GIL-atomic
+        self._local = threading.local()
+        #: [(thread_name, ring)] — registry of every thread's ring;
+        #: appended once per recording thread under the lock, drained
+        #: at export
+        self._rings: List[Tuple[str, deque]] = []
+        self._rings_lock = threading.Lock()
+        #: dispatch-window inflight slots: ("b"/"e", name, id, t, track)
+        self._async: deque = deque(maxlen=4 * self.capacity)
+
+    # -- recording (hot path) ------------------------------------------------
+    def next_seq(self) -> int:
+        return next(self._seq)
+
+    def _ring(self) -> deque:
+        r = getattr(self._local, "ring", None)
+        if r is None:
+            r = deque(maxlen=self.capacity)
+            self._local.ring = r
+            with self._rings_lock:
+                self._rings.append((threading.current_thread().name, r))
+        return r
+
+    def span(self, kind: str, seq: Optional[int], t0: float, t1: float,
+             track: Optional[str] = None, **args) -> None:
+        """Record a duration span [t0, t1) attributed to frame ``seq``."""
+        self._ring().append((kind, seq, t0, t1, track, args or None))
+
+    def mark(self, kind: str, seq: Optional[int],
+             t: Optional[float] = None, track: Optional[str] = None,
+             **args) -> None:
+        """Record an instant event (shed/reject decisions, submits)."""
+        if t is None:
+            t = time.monotonic()
+        self._ring().append((kind, seq, t, None, track, args or None))
+
+    def async_begin(self, name: str, aid: int,
+                    t: Optional[float] = None,
+                    track: str = "dispatch") -> None:
+        self._async.append(
+            ("b", name, aid, time.monotonic() if t is None else t, track))
+
+    def async_end(self, name: str, aid: int,
+                  t: Optional[float] = None,
+                  track: str = "dispatch") -> None:
+        self._async.append(
+            ("e", name, aid, time.monotonic() if t is None else t, track))
+
+    def clear(self) -> None:
+        """Drop recorded events (rings stay registered; epoch advances
+        so a re-used timeline exports a fresh window)."""
+        with self._rings_lock:
+            rings = list(self._rings)
+        for _, r in rings:
+            r.clear()
+        self._async.clear()
+        self.epoch = time.monotonic()
+
+    # -- aggregation ---------------------------------------------------------
+    def _snapshot(self) -> List[tuple]:
+        """All records as (thread, kind, seq, t0, t1, track, args),
+        time-ordered."""
+        with self._rings_lock:
+            rings = list(self._rings)
+        out: List[tuple] = []
+        for tname, ring in rings:
+            for rec in list(ring):
+                out.append((tname,) + rec)
+        out.sort(key=lambda r: r[3])
+        return out
+
+    def frame_ledger(self, skip_frames: int = 0
+                     ) -> Dict[int, Dict[str, float]]:
+        """Per-frame stage durations (seconds) keyed by trace seq; a
+        frame that reached the sink also carries its measured ``e2e``.
+        ``skip_frames`` drops the first N frames (warm-up exclusion)."""
+        frames: Dict[int, Dict[str, float]] = {}
+        for _, kind, seq, t0, t1, _, args in self._snapshot():
+            if seq is None or t1 is None:
+                continue
+            d = frames.setdefault(seq, {})
+            d[kind] = d.get(kind, 0.0) + (t1 - t0)
+            if args and "e2e_s" in args:
+                d["e2e"] = float(args["e2e_s"])
+        for s in sorted(frames)[:skip_frames]:
+            del frames[s]
+        return frames
+
+    def stage_breakdown(self, skip_frames: int = 0) -> Dict[str, Any]:
+        """Mean per-frame seconds spent in each canonical stage, over
+        frames that completed (have a sink e2e record). ``covered_ms``
+        is the sum of the stage means; ``reconciliation`` is
+        covered/e2e — ~1.0 means the ledger accounts for the frame's
+        whole life, a gap shows as ``unattributed_ms``."""
+        frames = self.frame_ledger(skip_frames)
+        done = [d for d in frames.values() if "e2e" in d]
+        n = len(done)
+        if n == 0:
+            return {"frames": 0, "stages_ms": {}, "e2e_mean_ms": 0.0,
+                    "covered_ms": 0.0, "unattributed_ms": 0.0,
+                    "reconciliation": 0.0}
+        stages = {k: sum(d.get(k, 0.0) for d in done) / n * 1e3
+                  for k in STAGES}
+        e2e = sum(d["e2e"] for d in done) / n * 1e3
+        covered = sum(stages.values())
+        return {
+            "frames": n,
+            "stages_ms": {k: round(v, 4) for k, v in stages.items()},
+            "e2e_mean_ms": round(e2e, 4),
+            "covered_ms": round(covered, 4),
+            "unattributed_ms": round(max(e2e - covered, 0.0), 4),
+            "reconciliation": round(covered / e2e, 4) if e2e > 0 else 0.0,
+        }
+
+    def variance_report(self, skip_frames: int = 0) -> Dict[str, Any]:
+        """Attribute e2e spread to its dominant stage: per-stage MAD of
+        the per-frame durations (robust — one cold outlier cannot own
+        the report), ranked; ``dominant_share`` is the winner's MAD as
+        a fraction of the e2e MAD."""
+        frames = self.frame_ledger(skip_frames)
+        done = [d for d in frames.values() if "e2e" in d]
+        if len(done) < 2:
+            return {"frames": len(done), "e2e_mad_ms": 0.0,
+                    "stage_mad_ms": {}, "dominant_stage": None,
+                    "dominant_share": 0.0}
+
+        def _mad(vals: List[float]) -> float:
+            vals = sorted(vals)
+            med = vals[len(vals) // 2]
+            dev = sorted(abs(v - med) for v in vals)
+            return dev[len(dev) // 2]
+
+        stage_mad = {k: _mad([d.get(k, 0.0) for d in done]) * 1e3
+                     for k in STAGES}
+        e2e_mad = _mad([d["e2e"] for d in done]) * 1e3
+        dominant = max(stage_mad, key=lambda k: stage_mad[k])
+        if stage_mad[dominant] <= 0.0:
+            dominant = None
+        return {
+            "frames": len(done),
+            "e2e_mad_ms": round(e2e_mad, 4),
+            "stage_mad_ms": {k: round(v, 4)
+                             for k, v in stage_mad.items()},
+            "dominant_stage": dominant,
+            "dominant_share": round(stage_mad[dominant] / e2e_mad, 4)
+            if dominant and e2e_mad > 0 else 0.0,
+        }
+
+    # -- export --------------------------------------------------------------
+    def _us(self, t: float) -> float:
+        return round((t - self.epoch) * 1e6, 3)
+
+    def to_chrome(self) -> Dict[str, Any]:
+        """Chrome trace-event JSON (Perfetto-loadable): named thread
+        tracks, ``X`` slices with frame-seq args, flow events following
+        each frame across tracks, async inflight-slot spans."""
+        recs = self._snapshot()
+        tids: Dict[str, int] = {}
+
+        def _tid(track: str) -> int:
+            t = tids.get(track)
+            if t is None:
+                t = tids[track] = len(tids) + 1
+            return t
+
+        events: List[dict] = []
+        flows: Dict[int, List[Tuple[float, int]]] = {}
+        for thread, kind, seq, t0, t1, track, args in recs:
+            track = track or thread
+            a: Dict[str, Any] = {"seq": seq}
+            if args:
+                a.update(args)
+            tid = _tid(track)
+            if t1 is None:
+                events.append({"name": kind, "cat": "timeline",
+                               "ph": "i", "s": "t", "ts": self._us(t0),
+                               "pid": 1, "tid": tid, "args": a})
+            else:
+                events.append({"name": kind, "cat": "timeline",
+                               "ph": "X", "ts": self._us(t0),
+                               "dur": max(round((t1 - t0) * 1e6, 3), 0.0),
+                               "pid": 1, "tid": tid, "args": a})
+                if seq is not None:
+                    flows.setdefault(seq, []).append((t0, tid))
+        # flow events: one arrow chain per frame across its tracks — the
+        # "follow this frame" affordance in Perfetto
+        for seq, hops in flows.items():
+            if len(hops) < 2:
+                continue
+            hops.sort()
+            for i, (t0, tid) in enumerate(hops):
+                ph = "s" if i == 0 else ("f" if i == len(hops) - 1 else "t")
+                ev = {"name": "frame", "cat": "frame", "ph": ph,
+                      "id": seq, "ts": self._us(t0), "pid": 1, "tid": tid}
+                if ph == "f":
+                    ev["bp"] = "e"
+                events.append(ev)
+        for ph, name, aid, t, track in list(self._async):
+            events.append({"name": name, "cat": "inflight", "ph": ph,
+                           "id": aid, "ts": self._us(t), "pid": 1,
+                           "tid": _tid(track)})
+        meta: List[dict] = [{"name": "process_name", "ph": "M", "pid": 1,
+                             "args": {"name": "nnstreamer_tpu"}}]
+        for track, tid in sorted(tids.items(), key=lambda kv: kv[1]):
+            meta.append({"name": "thread_name", "ph": "M", "pid": 1,
+                         "tid": tid, "args": {"name": track}})
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+    def export_chrome(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_chrome(), f)

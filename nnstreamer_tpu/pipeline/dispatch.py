@@ -31,6 +31,7 @@ import time
 import weakref
 from typing import Any, Deque, List, Optional, Tuple
 
+from nnstreamer_tpu.obs import timeline as _timeline
 from nnstreamer_tpu.tensors.buffer import is_device_array
 
 #: meta key carrying pool-owned host staging arrays whose release is
@@ -50,7 +51,8 @@ class DispatchWindow:
         #: weakly bound: the window must not keep a dead element (and its
         #: pipeline) alive through the metrics registry
         self._owner = weakref.ref(owner)
-        self._entries: Deque[Tuple[List[Any], Optional[list]]] = \
+        self._entries: Deque[
+            Tuple[List[Any], Optional[list], Optional[int], float]] = \
             collections.deque()
         self._m_fence = None
         self._gauge_done = False
@@ -92,27 +94,38 @@ class DispatchWindow:
 
     # -- hot path -----------------------------------------------------------
     def admit(self, tensors: List[Any],
-              stash: Optional[list] = None) -> None:
+              stash: Optional[list] = None,
+              frame: Optional[int] = None) -> None:
         """Register a just-dispatched batch; fence the oldest entries
         until at most ``inflight`` remain outstanding. Accepts a raw
         tensor list or a whole (Device)Buffer — a device-resident input
         arrived with no H2D stage and no pool stash, so its entry is
-        purely an ordering fence."""
+        purely an ordering fence. ``frame`` is the frame's trace seq so
+        the timeline can draw the inflight slot as an async span."""
         tensors = getattr(tensors, "tensors", tensors)
-        self._entries.append((list(tensors), stash))
+        t_admit = time.monotonic()
+        self._entries.append((list(tensors), stash, frame, t_admit))
+        tl = _timeline.ACTIVE
+        if tl is not None and frame is not None:
+            tl.async_begin("inflight", frame, t_admit)
         limit = self._inflight()
         while len(self._entries) > limit:
             self._fence_oldest()
 
     def _fence_oldest(self) -> None:
-        tensors, stash = self._entries.popleft()
+        tensors, stash, frame, _t_admit = self._entries.popleft()
         hist = self._obs()
         t0 = time.monotonic()
         for t in tensors:
             if is_device_array(t):
                 t.block_until_ready()
+        t1 = time.monotonic()
         if hist is not None:
-            hist.observe(time.monotonic() - t0)
+            hist.observe(t1 - t0)
+        tl = _timeline.ACTIVE
+        if tl is not None and frame is not None:
+            tl.span("fence_wait", frame, t0, t1, track="dispatch")
+            tl.async_end("inflight", frame, t1)
         if stash:
             # the fenced dispatch (and the H2D feeding it) is complete:
             # its pooled host staging buffers have no readers left —

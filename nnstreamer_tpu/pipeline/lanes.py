@@ -54,6 +54,7 @@ import numpy as np
 
 from nnstreamer_tpu.log import get_logger
 from nnstreamer_tpu.obs import get_registry
+from nnstreamer_tpu.obs import timeline as _timeline
 from nnstreamer_tpu.pipeline.element import (
     CapsEvent,
     Element,
@@ -105,6 +106,15 @@ def effective_lanes(requested: int) -> int:
 
 def _single_io(el: Element) -> bool:
     return len(el.sinkpads) == 1 and len(el.srcpads) == 1
+
+
+def _tl_seq(items: List[Tuple[str, Any]]) -> Optional[int]:
+    """Trace context of a reorder slot: the first buffer's stamped
+    frame-ledger seq (obs/timeline.py); event-only slots have none."""
+    for kind, payload in items:
+        if kind == "buf":
+            return payload.meta.get(_timeline.TRACE_SEQ_META)
+    return None
 
 
 class _LaneTail(Element):
@@ -180,6 +190,9 @@ class IngestLanes(Element):
         #: frame, which the drain thread pops before it pushes)
         self._delivered = 0
         self._pending: Dict[int, List[Tuple[str, Any]]] = {}
+        #: reorder-buffer entry stamps for the frame ledger (tracing
+        #: only; keyed like _pending, maintained under _cv)
+        self._pending_t: Dict[int, float] = {}
         self._cv = threading.Condition()
         self._forwarded = 0
         self._fwd_times: collections.deque = collections.deque(maxlen=256)
@@ -275,6 +288,7 @@ class IngestLanes(Element):
         self._next = 0
         self._delivered = 0
         self._pending = {}
+        self._pending_t = {}
         self._forwarded = 0
         self._fwd_times.clear()
         self._last_caps_str = None
@@ -363,6 +377,8 @@ class IngestLanes(Element):
             except _queue.Empty:
                 continue
             self._busy[k] = True
+            tl = _timeline.ACTIVE
+            t_pick = time.monotonic() if tl is not None else 0.0
             try:
                 head._chain_entry(sink, self._stage_copy(buf, pool))
                 items = tail.take()
@@ -376,6 +392,14 @@ class IngestLanes(Element):
                     self._cv.notify_all()
                 return
             self._busy[k] = False
+            if tl is not None:
+                # recorded from the lane worker's own thread, so the
+                # export shows each lane as its own track (lanes as
+                # threads); not part of the reconciliation tiling — it
+                # overlaps the frame's ingest window
+                tl.span("lane_exec",
+                        buf.meta.get(_timeline.TRACE_SEQ_META),
+                        t_pick, time.monotonic(), lane=k)
             self._reorder_put(seq, items)
 
     def _reorder_put(self, seq: int, items: List[Tuple[str, Any]]) -> None:
@@ -389,6 +413,12 @@ class IngestLanes(Element):
             if t0 is not None and self._m_stall is not None:
                 self._m_stall.inc(time.monotonic() - t0)
             self._pending[seq] = items
+            tl = _timeline.ACTIVE
+            if tl is not None:
+                now = time.monotonic()
+                self._pending_t[seq] = now
+                if t0 is not None:
+                    tl.span("lane_stall", _tl_seq(items), t0, now)
             self._cv.notify_all()
 
     def _drain_loop(self) -> None:
@@ -398,8 +428,21 @@ class IngestLanes(Element):
                 if items is None:
                     self._cv.wait(timeout=0.1)
                     continue
+                t_in = self._pending_t.pop(self._next, None)
                 self._next += 1
                 self._cv.notify_all()
+            tl = _timeline.ACTIVE
+            if tl is not None and t_in is not None:
+                # the frame's park time in the reorder buffer — a
+                # critical-path stage; the first downstream queue
+                # subtracts it from the ingest span so the two tile
+                now = time.monotonic()
+                tl.span("lane_reorder", _tl_seq(items), t_in, now,
+                        track="reorder")
+                for kind, payload in items:
+                    if kind == "buf":
+                        payload.meta["tl_reorder_s"] = now - t_in
+                        break
             try:
                 self._forward(items)
                 with self._cv:

@@ -26,6 +26,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from nnstreamer_tpu.log import get_logger
 from nnstreamer_tpu.obs import get_registry, register_pipeline_collector
+from nnstreamer_tpu.obs import timeline as _timeline
 from nnstreamer_tpu.pipeline.element import (
     Element,
     EosEvent,
@@ -95,6 +96,14 @@ class SourceElement(Element):
                 # tensor_filter.c:349-423). appsrc callers may pre-set it.
                 if "create_t" not in buf.meta:
                     buf.meta["create_t"] = time.monotonic()
+                # frame-ledger trace context (obs/timeline.py): one
+                # monotone id per frame, stamped by the single source
+                # thread — the same single-writer discipline the lane
+                # executor uses for its reorder sequence
+                tl = _timeline.ACTIVE
+                if tl is not None and \
+                        _timeline.TRACE_SEQ_META not in buf.meta:
+                    buf.meta[_timeline.TRACE_SEQ_META] = tl.next_seq()
                 ret = self.srcpad.push(buf)
                 if ret is FlowReturn.EOS:
                     break
@@ -236,6 +245,46 @@ class Queue(Element):
             self._last_drop_warn_t = now
             self._drops_since_warn = 0
 
+    # -- frame-ledger hooks (obs/timeline.py) --------------------------------
+    def _tl_arrive(self, buf) -> None:
+        """The FIRST queue a frame reaches closes its ingest span
+        (source ``create()`` → here, minus any lane reorder wait, so
+        ingest + lane_reorder tile exactly); every queue stamps the
+        entry time its drain side turns into a queue_wait/sched_hold
+        span. No-op (one attr read) with tracing off."""
+        tl = _timeline.ACTIVE
+        if tl is None:
+            return
+        seq = buf.meta.get(_timeline.TRACE_SEQ_META)
+        if seq is None:
+            return
+        now = time.monotonic()
+        if "tl_ingest_done" not in buf.meta:
+            buf.meta["tl_ingest_done"] = True
+            create = buf.meta.get("create_t")
+            if create is not None:
+                reorder = buf.meta.pop("tl_reorder_s", 0.0)
+                tl.span("ingest", seq, create,
+                        max(now - reorder, create), track="ingest")
+        buf.meta["tl_q_t"] = now
+
+    def _tl_depart(self, buf, kind: Optional[str] = None) -> None:
+        """Drain-side twin of :meth:`_tl_arrive`: queue residency ends
+        when the worker pops the frame. FIFO pops record ``queue_wait``,
+        EDF pops ``sched_hold``."""
+        tl = _timeline.ACTIVE
+        if tl is None:
+            return
+        t0 = buf.meta.pop("tl_q_t", None)
+        if t0 is None:
+            return
+        seq = buf.meta.get(_timeline.TRACE_SEQ_META)
+        if seq is None:
+            return
+        if kind is None:
+            kind = "sched_hold" if self._sched is not None else "queue_wait"
+        tl.span(kind, seq, t0, time.monotonic(), track=self.name)
+
     def _depth(self) -> int:
         """Occupancy: FIFO (or EDF heap in scheduler mode) + popped but
         undelivered."""
@@ -367,6 +416,7 @@ class Queue(Element):
             # link; the zero rows are synthesized on device now
             if buf.meta.get("pad_rows"):
                 buf = buf.pad_rows_device()
+        self._tl_arrive(buf)
         if self._sched is not None and self._worker is not None:
             # SLO path: deadline admission + EDF heap; rejected frames
             # never carry an admission stamp and are dropped here
@@ -435,12 +485,19 @@ class Queue(Element):
         in (``Pad.push_list`` → ``HANDLES_LIST``), else per-buffer."""
         if not run:
             return
+        # queue-residency spans end HERE, per item, right before its
+        # hand-off — stamping at drain-pop time would hide the in-batch
+        # wait (item N sitting in the drained run while items 0..N-1
+        # push through the downstream chain) as unattributed e2e time
+        tl_on = _timeline.ACTIVE is not None
         if self.get_property("materialize_host"):
             # materialize HERE, where the group's copies were just
             # issued — handing device arrays onward would re-serialize
             # the fetches at the sink
             for it in run:
                 self._undelivered -= 1
+                if tl_on:
+                    self._tl_depart(it)
                 self.srcpad.push(it.to_host())
         elif len(run) > 1:
             peer = self.srcpad.peer
@@ -448,15 +505,22 @@ class Queue(Element):
                                             "HANDLES_LIST", False):
                 # one chain_list hand-off: the whole run leaves at once
                 self._undelivered -= len(run)
+                if tl_on:
+                    for it in run:
+                        self._tl_depart(it)
                 self.srcpad.push_list(run)
             else:
                 # push_list would fall back to sequential pushes — keep
                 # the occupancy honest while the peer works through them
                 for it in run:
                     self._undelivered -= 1
+                    if tl_on:
+                        self._tl_depart(it)
                     self.srcpad.push(it)
         else:
             self._undelivered -= 1
+            if tl_on:
+                self._tl_depart(run[0])
             self.srcpad.push(run[0])
 
     def _drain(self):
@@ -767,6 +831,12 @@ class Pipeline:
         filters are ready), then spawn one streaming thread per source."""
         if self.state is State.PLAYING:
             return self
+        # frame-ledger tracing (obs/timeline.py): honor NNSTPU_TRACE
+        # before any element starts so the source stamp and every
+        # instrumentation point see the active timeline. Unset env and
+        # no explicit activation = ACTIVE stays None and every trace
+        # site is a single is-None test.
+        _timeline.maybe_activate_env()
         sources = [e for e in self.elements if isinstance(e, SourceElement)]
         others = [e for e in self.elements if not isinstance(e, SourceElement)]
         # SLO scheduler before any element starts: admission-point
@@ -837,6 +907,10 @@ class Pipeline:
         for r in self._regions or ():
             r.stop()
         self.state = State.NULL
+        # an env-owned timeline (NNSTPU_TRACE=<path>) exports its ledger
+        # once the run is over; explicitly installed timelines are the
+        # caller's to export
+        _timeline.maybe_export_env()
         return self
 
     # -- bus ------------------------------------------------------------------

@@ -62,6 +62,7 @@ from collections import deque
 from typing import Any, Dict, Optional
 
 from nnstreamer_tpu.log import get_logger
+from nnstreamer_tpu.obs import timeline as _timeline
 
 log = get_logger("scheduler")
 
@@ -328,6 +329,12 @@ class SloScheduler:
         self._m["slack"].observe(slack)
         if not ok:
             self._m["rejected"].inc()
+            tl = _timeline.ACTIVE
+            if tl is not None:
+                tl.mark("sched_reject",
+                        buf.meta.get(_timeline.TRACE_SEQ_META),
+                        track="scheduler",
+                        slack_ms=round(slack * 1e3, 3))
             return False
         buf.meta.setdefault("admitted_t", now)
         buf.meta["deadline_t"] = deadline_t
@@ -359,6 +366,10 @@ class SloScheduler:
         buf.meta.pop("admitted_t", None)
         buf.meta.pop("deadline_t", None)
         self._m["shed_late" if late else "shed_capacity"].inc()
+        tl = _timeline.ACTIVE
+        if tl is not None:
+            tl.mark("sched_shed", buf.meta.get(_timeline.TRACE_SEQ_META),
+                    track="scheduler", late=late)
 
     # -- observation feeds ----------------------------------------------------
     def observe_service(self, seconds: float, frames: int = 1) -> None:

@@ -25,6 +25,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from nnstreamer_tpu.obs import timeline as _timeline
 from nnstreamer_tpu.tensors.types import (
     NNS_TENSOR_SIZE_LIMIT,
     TensorsInfo,
@@ -97,6 +98,21 @@ def _record_d2h(nbytes: int) -> None:
     with _xfer_lock:
         _xfer["d2h_bytes"] += nbytes
         _xfer["d2h_events"] += 1
+
+
+def _tl_xfer_span(kind: str, meta: Dict[str, Any], t0: float,
+                  nbytes: int = 0) -> None:
+    """Record a transfer span (``h2d``/``d2h``) on the active timeline
+    for the frame carried in ``meta`` — free single-test no-op when
+    tracing is off or the buffer predates the source's seq stamp."""
+    tl = _timeline.ACTIVE
+    if tl is None:
+        return
+    seq = meta.get(_timeline.TRACE_SEQ_META)
+    if seq is None:
+        return
+    tl.span(kind, seq, t0, time.monotonic(), track="transfer",
+            nbytes=nbytes)
 
 
 def record_residency_entry(resident: bool) -> None:
@@ -232,6 +248,7 @@ class TensorBuffer:
     def to_host(self) -> "TensorBuffer":
         """Materialize all tensors as numpy arrays (blocking D2H if needed),
         then apply the deferred ``finalize`` hook if one is attached."""
+        t0 = time.monotonic()
         out, moved = [], 0
         for t in self.tensors:
             if isinstance(t, np.ndarray):
@@ -241,6 +258,7 @@ class TensorBuffer:
                 moved += _device_nbytes(t)
         if moved:
             _record_d2h(moved)
+            _tl_xfer_span("d2h", self.meta, t0, nbytes=moved)
         buf = self.replace(tensors=out, finalize=None)
         if self.finalize is not None:
             buf = self.finalize(buf)
@@ -251,12 +269,14 @@ class TensorBuffer:
         import jax
 
         tgt = sharding if sharding is not None else device
+        t0 = time.monotonic()
         moved = sum(_device_nbytes(t) for t in self.tensors
                     if not is_device_array(t))
         out = [jax.device_put(t, tgt) if tgt is not None else jax.device_put(t)
                for t in self.tensors]
         if moved:
             _record_h2d(moved)
+            _tl_xfer_span("h2d", self.meta, t0, nbytes=moved)
         return self.replace(tensors=out)
 
     def pad_rows_device(self) -> "TensorBuffer":
@@ -366,6 +386,7 @@ class DeviceBuffer(TensorBuffer):
         if self._host_src is not None:
             host = list(self._host_src)  # zero-copy: pre-upload bytes
         else:
+            t0 = time.monotonic()
             host, moved = [], 0
             for t in self.tensors:
                 if isinstance(t, np.ndarray):
@@ -375,6 +396,7 @@ class DeviceBuffer(TensorBuffer):
                     moved += _device_nbytes(t)
             if moved:
                 _record_d2h(moved)
+                _tl_xfer_span("d2h", self.meta, t0, nbytes=moved)
         buf = TensorBuffer(tensors=host, pts=self.pts, dts=self.dts,
                            duration=self.duration, meta=dict(self.meta),
                            finalize=None)

@@ -60,20 +60,34 @@ class Tracer:
                 # drop the instance attribute so the class method resolves
                 # again (no permanent shadowing)
                 el.__dict__.pop("_chain_entry", None)
+            # stream over (EOS or abandoned): every surviving baseline
+            # belongs to a finished run — a reattach starts fresh
+            with self._lock:
+                self._first_seen.clear()
 
     def _wrap(self, el: Element, fn):
+        is_sink = not el.srcpads  # terminal element: frames complete here
+
         def traced(pad, buf):
             t_in = time.monotonic()
             interlat_us = None
             if buf.pts is not None:
                 with self._lock:
                     first = self._first_seen.setdefault(buf.pts, t_in)
-                    if len(self._first_seen) > 16384:  # bound the map
+                    if len(self._first_seen) > 16384:  # backstop bound
                         self._first_seen.pop(next(iter(self._first_seen)))
                 interlat_us = (t_in - first) * 1e6
             ret = fn(pad, buf)
             t_out = time.monotonic()
             self._record(el.name, t_in, t_out, buf.pts, interlat_us)
+            if is_sink and buf.pts is not None:
+                # the frame completed — retire its baseline so the
+                # backstop above only ever evicts truly-lost frames;
+                # evicting oldest-INSERTED regardless of completion
+                # churned live baselines on long runs and skewed
+                # interlatency toward zero
+                with self._lock:
+                    self._first_seen.pop(buf.pts, None)
             return ret
 
         return traced
@@ -120,13 +134,23 @@ class Tracer:
         return agg
 
     def export_chrome(self, path: str) -> None:
-        """Chrome trace-event format (load in chrome://tracing/Perfetto)."""
+        """Chrome trace-event format (load in chrome://tracing/Perfetto).
+
+        Each invoke is a ``ph:"X"`` slice carrying ``pts`` and
+        ``interlatency_us`` as args; per-pts flow events (``s``/``t``/
+        ``f``) chain a frame's slices across element tracks so Perfetto
+        can follow one frame through the pipeline."""
         with self._lock:
             events = list(self.events)
         tids = {name: i for i, name in enumerate(
             sorted({ev["element"] for ev in events}))}
-        trace = [
-            {
+        trace: List[dict] = []
+        flows: Dict[int, List[tuple]] = {}
+        for ev in events:
+            args: dict = {"pts": ev["pts"]}
+            if ev.get("interlatency_us") is not None:
+                args["interlatency_us"] = round(ev["interlatency_us"], 3)
+            trace.append({
                 "name": ev["element"],
                 "cat": "element",
                 "ph": "X",
@@ -134,9 +158,22 @@ class Tracer:
                 "dur": ev["dur_us"],
                 "pid": 1,
                 "tid": tids[ev["element"]],
-            }
-            for ev in events
-        ]
+                "args": args,
+            })
+            if ev["pts"] is not None:
+                flows.setdefault(ev["pts"], []).append(
+                    (ev["ts_us"], tids[ev["element"]]))
+        for pts, hops in flows.items():
+            if len(hops) < 2:
+                continue  # a frame seen on one track has nothing to link
+            hops.sort()
+            for i, (ts, tid) in enumerate(hops):
+                ph = "s" if i == 0 else ("f" if i == len(hops) - 1 else "t")
+                flow = {"name": "frame", "cat": "frame", "ph": ph,
+                        "id": pts, "ts": ts, "pid": 1, "tid": tid}
+                if ph == "f":
+                    flow["bp"] = "e"
+                trace.append(flow)
         with open(path, "w") as f:
             json.dump({"traceEvents": trace}, f)
 

@@ -1,0 +1,253 @@
+"""Frame-ledger timeline (obs/timeline.py) — the PR-7 observability
+contract:
+
+- with no active timeline nothing is recorded, no frame carries a
+  trace stamp, and outputs are byte-identical to a traced run;
+- on the golden pipeline the canonical stages TILE a frame's life:
+  stage_breakdown sums reconcile with the sink's e2e record;
+- the Chrome export is Perfetto-loadable — named thread tracks, X
+  slices carrying the frame seq, s/t/f flow chains per frame;
+- scheduler decisions are events WITH matching counters: every
+  admission-reject / shed / revoked-admission increments its
+  ``nns_sched_*`` / ``nns_queue_admitted_revoked_total`` series and
+  lands in the timeline, and the two accountings must agree.
+"""
+
+import threading
+import time
+
+import numpy as np
+
+from nnstreamer_tpu import parse_launch
+from nnstreamer_tpu.obs import get_registry
+from nnstreamer_tpu.obs import timeline as _timeline
+from nnstreamer_tpu.obs.timeline import STAGES, TRACE_SEQ_META, Timeline
+from nnstreamer_tpu.pipeline.element import Element, EosEvent, FlowReturn
+from nnstreamer_tpu.pipeline.pipeline import Pipeline, Queue
+from nnstreamer_tpu.serving.scheduler import SloScheduler
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
+
+GOLDEN = ("videotestsrc pattern=ball num-buffers=24 width=16 height=16 ! "
+          "tensor_converter ! queue ! tensor_sink name=sink")
+
+
+def _run_golden():
+    pipe = parse_launch(GOLDEN)
+    msg = pipe.run(timeout=120)
+    assert msg is not None and msg.kind == "eos", msg
+    return pipe
+
+
+def _instants(tl: Timeline, name: str):
+    return [ev for ev in tl.to_chrome()["traceEvents"]
+            if ev.get("ph") == "i" and ev["name"] == name]
+
+
+def _counter(name, **labels):
+    c = get_registry().get(name, **labels)
+    return float(c.value) if c is not None else 0.0
+
+
+class TestRecorderUnits:
+    def test_breakdown_tiles_synthetic_frames(self):
+        tl = Timeline()
+        for seq in range(4):
+            t = 100.0 + seq
+            tl.span("ingest", seq, t, t + 0.010)
+            tl.span("queue_wait", seq, t + 0.010, t + 0.030)
+            # repeated same-stage spans must SUM, not overwrite
+            tl.span("queue_wait", seq, t + 0.030, t + 0.040)
+            tl.span("sink", seq, t + 0.040, t + 0.050, e2e_s=0.050)
+        bd = tl.stage_breakdown()
+        assert bd["frames"] == 4
+        assert set(bd["stages_ms"]) == set(STAGES)
+        assert bd["stages_ms"]["queue_wait"] == 30.0
+        assert bd["reconciliation"] == 1.0
+        var = tl.variance_report()
+        assert var["dominant_stage"] is None  # identical frames: no spread
+
+    def test_skip_frames_drops_warmup(self):
+        tl = Timeline()
+        tl.span("sink", 0, 0.0, 10.0, e2e_s=10.0)      # cold outlier
+        tl.span("sink", 1, 20.0, 20.001, e2e_s=0.001)
+        tl.span("sink", 2, 30.0, 30.001, e2e_s=0.001)
+        assert tl.stage_breakdown(skip_frames=1)["e2e_mean_ms"] == 1.0
+
+
+class TestGoldenPipeline:
+    def test_breakdown_reconciles_with_sink_e2e(self):
+        tl = _timeline.activate()
+        try:
+            _run_golden()
+            bd = tl.stage_breakdown(skip_frames=2)
+        finally:
+            _timeline.deactivate()
+        assert bd["frames"] >= 10
+        assert set(bd["stages_ms"]) == set(STAGES)
+        # the stages must tile a frame's life: covered within 10% of
+        # e2e (0.5 ms floor — on a fast CPU run 10% of e2e is noise)
+        gap = abs(bd["e2e_mean_ms"] - bd["covered_ms"])
+        assert gap <= max(0.10 * bd["e2e_mean_ms"], 0.5), bd
+
+    def test_chrome_export_is_perfetto_loadable(self):
+        tl = _timeline.activate()
+        try:
+            _run_golden()
+            doc = tl.to_chrome()
+        finally:
+            _timeline.deactivate()
+        evs = doc["traceEvents"]
+        meta = [e for e in evs if e["ph"] == "M"]
+        assert any(e["name"] == "process_name" for e in meta)
+        named = {e["tid"] for e in meta if e["name"] == "thread_name"}
+        used = {e["tid"] for e in evs if e["ph"] != "M"}
+        assert used and used <= named, "unnamed thread track"
+        slices = [e for e in evs if e["ph"] == "X"]
+        assert slices
+        for e in slices:
+            assert e["dur"] >= 0 and "seq" in e["args"]
+        # flow chains: every frame crossing ≥2 tracks starts with `s`
+        # and finishes with `f` so Perfetto can follow it end to end
+        flows = {}
+        for e in evs:
+            if e.get("cat") == "frame":
+                flows.setdefault(e["id"], []).append(e["ph"])
+        assert flows, "no flow events"
+        for phases in flows.values():
+            assert phases[0] == "s" and phases[-1] == "f"
+
+    def test_off_records_nothing_and_output_matches_traced(self):
+        assert _timeline.ACTIVE is None
+        pipe_off = _run_golden()
+        off = [b for b in pipe_off.get("sink").buffers]
+        # zero footprint: no frame carries a trace stamp when off
+        assert all(TRACE_SEQ_META not in b.meta for b in off)
+        tl = _timeline.activate()
+        try:
+            pipe_on = _run_golden()
+        finally:
+            _timeline.deactivate()
+        on = [b for b in pipe_on.get("sink").buffers]
+        assert tl.stage_breakdown()["frames"] > 0
+        assert len(off) == len(on) == 24
+        for a, b in zip(off, on):
+            assert a.tensors[0].tobytes() == b.tensors[0].tobytes()
+
+
+def _buf(i: int, deadline_t=None, seq=None) -> TensorBuffer:
+    buf = TensorBuffer([np.array([float(i)], np.float32)], pts=i * 1000)
+    if deadline_t is not None:
+        buf.meta["deadline_t"] = deadline_t
+    if seq is not None:
+        buf.meta[TRACE_SEQ_META] = seq
+    return buf
+
+
+class _Gate(Element):
+    """Parks the queue worker inside chain() until released."""
+
+    ELEMENT_NAME = "_tl_gate"
+    PROPERTIES = {}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+        self.entered = threading.Event()
+        self.release = threading.Event()
+
+    def chain(self, pad, buf):
+        self.entered.set()
+        assert self.release.wait(timeout=10)
+        return self.srcpads[0].push(buf)
+
+
+class _Collect(Element):
+    ELEMENT_NAME = "_tl_collect"
+    PROPERTIES = {}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad("sink")
+        self.buffers = []
+
+    def chain(self, pad, buf):
+        self.buffers.append(buf)
+        return FlowReturn.OK
+
+
+class TestSchedulerTimeline:
+    def test_reject_and_shed_marks_match_counters(self):
+        tl = _timeline.activate()
+        try:
+            sched = SloScheduler(budget_ms=50, name="tl-sched-unit")
+            rej0 = _counter("nns_sched_rejected_total",
+                            pipeline="tl-sched-unit")
+            late0 = _counter("nns_sched_shed_total",
+                             pipeline="tl-sched-unit", reason="late")
+            cap0 = _counter("nns_sched_shed_total",
+                            pipeline="tl-sched-unit", reason="capacity")
+            sched.observe_service(0.1)  # 100 ms/frame: 50 ms unmeetable
+            for i in range(3):
+                assert not sched.admit(_buf(i, seq=i), now=10.0, backlog=0)
+            late = _buf(10, seq=10)
+            ontime = _buf(11, seq=11)
+            assert sched.admit(late, now=10.0, backlog=0, budget_ms=10_000)
+            assert sched.admit(ontime, now=10.0, backlog=0,
+                               budget_ms=10_000)
+            sched.note_shed(late, now=30.0)    # deadline 20.0 < 30: late
+            sched.note_shed(ontime, now=10.5)  # had slack: capacity
+            rejects = _instants(tl, "sched_reject")
+            sheds = _instants(tl, "sched_shed")
+        finally:
+            _timeline.deactivate()
+        # every counted decision is a timeline event, and vice versa
+        assert len(rejects) == _counter("nns_sched_rejected_total",
+                                        pipeline="tl-sched-unit") - rej0 == 3
+        shed_late = _counter("nns_sched_shed_total",
+                             pipeline="tl-sched-unit", reason="late") - late0
+        shed_cap = _counter("nns_sched_shed_total",
+                            pipeline="tl-sched-unit",
+                            reason="capacity") - cap0
+        assert len(sheds) == shed_late + shed_cap == 2
+        assert sum(1 for e in sheds if e["args"]["late"]) == shed_late == 1
+        # events carry the diagnosis: frame seq + decision slack
+        assert {e["args"]["seq"] for e in rejects} == {0, 1, 2}
+        assert all(e["args"]["slack_ms"] < 0 for e in rejects)
+
+    def test_queue_shed_revokes_admission_and_marks(self):
+        tl = _timeline.activate()
+        pipe = Pipeline(name="tl-edf-shed", fuse=False,
+                        slo_budget_ms=10_000.0)
+        q = Queue(name="q", stamp_admission=True, max_size_buffers=2)
+        gate = _Gate(name="gate")
+        col = _Collect(name="col")
+        pipe.add_linked(q, gate, col)
+        try:
+            pipe.start()
+            r0 = _counter("nns_queue_admitted_revoked_total",
+                          pipeline="tl-edf-shed", element="q")
+            now = time.monotonic()
+            q.chain(None, _buf(0, deadline_t=now + 9.0, seq=0))  # plug
+            assert gate.entered.wait(timeout=5)
+            q.chain(None, _buf(1, deadline_t=now + 0.05, seq=1))
+            q.chain(None, _buf(2, deadline_t=now + 5.0, seq=2))
+            time.sleep(0.12)  # frame 1's deadline passes IN the heap
+            q.chain(None, _buf(3, deadline_t=time.monotonic() + 6.0,
+                               seq=3))   # overflow: sheds late frame 1
+            q.chain(None, _buf(4, deadline_t=time.monotonic() + 7.0,
+                               seq=4))   # overflow: sheds least-urgent 4
+            gate.release.set()
+            q.sink_event(None, EosEvent())
+            revoked = _counter("nns_queue_admitted_revoked_total",
+                               pipeline="tl-edf-shed", element="q") - r0
+            sheds = _instants(tl, "sched_shed")
+        finally:
+            _timeline.deactivate()
+            pipe.stop()
+        # every revoked admission is a timeline shed event with the
+        # frame's identity — the ledger and the counter must agree
+        assert revoked == len(sheds) == 2
+        assert {e["args"]["seq"] for e in sheds} == {1, 4}
+        assert [e["args"]["late"] for e in sorted(
+            sheds, key=lambda e: e["args"]["seq"])] == [True, False]

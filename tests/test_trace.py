@@ -51,9 +51,14 @@ class _CountSink(Element):
 
 class TestTracerChromeExport:
     def _run_traced(self, n=6):
+        from nnstreamer_tpu.pipeline.pipeline import Queue
+
+        # a queue between source and sink gives every frame ≥2 traced
+        # hops, so the export's flow-event chains have something to link
         src = _NumSrc(name="tsrc", num_buffers=n)
         sink = _CountSink(name="tsink")
-        pipe = Pipeline(name=f"trace-{n}", fuse=False).add_linked(src, sink)
+        pipe = Pipeline(name=f"trace-{n}", fuse=False).add_linked(
+            src, Queue(name="tq"), sink)
         tracer = Tracer()
         with tracer.attach(pipe):
             assert pipe.run(timeout=10) is not None
@@ -67,13 +72,27 @@ class TestTracerChromeExport:
             doc = json.load(f)  # must parse — the Perfetto load contract
         events = doc["traceEvents"]
         assert events, "traced run produced no events"
-        for ev in events:
+        slices = [ev for ev in events if ev["ph"] == "X"]
+        assert slices, "no complete events"
+        for ev in slices:
             # one COMPLETE event per invoke: phase X with ts + dur
-            assert ev["ph"] == "X"
             assert ev["cat"] == "element"
             assert ev["ts"] >= 0 and ev["dur"] >= 0
             assert isinstance(ev["pid"], int)
             assert isinstance(ev["tid"], int)
+            # pts + interlatency ride along as args (followable frames)
+            assert "pts" in ev["args"]
+        # flow events follow a frame across element tracks: each pts seen
+        # by >1 element starts with `s` and finishes with `f` (bp="e")
+        flow = [ev for ev in events if ev["ph"] in ("s", "t", "f")]
+        assert flow, "no flow events in a multi-element trace"
+        by_id = {}
+        for ev in flow:
+            by_id.setdefault(ev["id"], []).append(ev["ph"])
+        for phases in by_id.values():
+            assert phases[0] == "s" and phases[-1] == "f"
+        assert all(ev.get("bp") == "e"
+                   for ev in flow if ev["ph"] == "f")
 
     def test_one_complete_event_per_element_invoke(self, tmp_path):
         tracer, sink = self._run_traced(n=7)
@@ -81,12 +100,13 @@ class TestTracerChromeExport:
         tracer.export_chrome(str(path))
         with open(path) as f:
             events = json.load(f)["traceEvents"]
+        slices = [ev for ev in events if ev["ph"] == "X"]
         per_el = {}
-        for ev in events:
+        for ev in slices:
             per_el[ev["name"]] = per_el.get(ev["name"], 0) + 1
         assert per_el["tsink"] == sink.count == 7
         # distinct elements get distinct tids (one lane per element)
-        tids = {ev["name"]: ev["tid"] for ev in events}
+        tids = {ev["name"]: ev["tid"] for ev in slices}
         assert len(set(tids.values())) == len(tids)
 
     def test_detach_restores_chain_entry(self):
