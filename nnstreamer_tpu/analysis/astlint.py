@@ -43,6 +43,16 @@ These rules encode invariants this codebase has already been burned by
   assumes these paths are event-driven and O(work); one
   ``time.sleep``-style pacing loop or forever-wait turns every
   admission decision stale and stalls EOS/teardown behind it.
+- NNS111: a broad ``except Exception``/``BaseException`` inside an
+  element chain or worker loop (``chain`` / ``chain_list`` /
+  ``run_loop`` / ``_worker`` / ``_drain`` / ``_drain_sched`` /
+  ``_drain_loop`` — see ``_WORKER_FUNCS``) whose handler neither
+  re-raises nor posts to the pipeline bus
+  (``post_error``/``post_message``/``post_warning``): these are the
+  exception boundaries the supervision layer (``pipeline/supervise.py``)
+  and the bus ``wait()`` contract rely on — a handler that only logs
+  (or does nothing) converts a dead frame into a silent hang, because
+  downstream never sees an error message and EOS never arrives.
 
 Findings are suppressed per-line with::
 
@@ -96,6 +106,15 @@ _SCHED_HOT_FUNCS = {"admit", "admit_request", "decide", "note_shed",
                     "_drain_sched", "_drain", "dispatch", "fence"}
 #: attribute calls that block forever unless given a timeout
 _UNBOUNDED_WAIT_ATTRS = {"wait", "wait_for", "acquire", "join", "get"}
+
+#: element-chain / worker-loop function names (NNS111): the exception
+#: boundaries that must either re-raise (so _chain_entry's policy
+#: dispatch sees the failure) or post to the pipeline bus (so wait()
+#: unblocks) — swallowing here turns one dead frame into a silent hang
+_WORKER_FUNCS = {"chain", "chain_list", "run_loop", "_worker",
+                 "_drain", "_drain_sched", "_drain_loop"}
+#: bus-posting method names that count as surfacing the failure
+_BUS_POST_ATTRS = {"post_error", "post_message", "post_warning"}
 
 #: direct-materialization callables (NNS108): fetch device bytes while
 #: bypassing the cached, counted to_host() path
@@ -205,6 +224,7 @@ class _FileLinter(ast.NodeVisitor):
 
     def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
         self._rule_nns104(node)
+        self._rule_nns111(node)
         self.generic_visit(node)
 
     def visit_ClassDef(self, node: ast.ClassDef) -> None:
@@ -284,6 +304,33 @@ class _FileLinter(ast.NodeVisitor):
                 "'except Exception: pass' silently swallows every bug",
                 hint="log the exception, narrow the type, or justify "
                      "with a pragma")
+
+    def _rule_nns111(self, node: ast.ExceptHandler) -> None:
+        if not any(f in _WORKER_FUNCS for f in self._func_stack):
+            return
+        if node.type is None:
+            return  # bare except: is NNS104's finding already
+        names = [_dotted(node.type)]
+        if isinstance(node.type, ast.Tuple):
+            names = [_dotted(e) for e in node.type.elts]
+        if not any(n in ("Exception", "BaseException") for n in names):
+            return
+        if all(isinstance(s, ast.Pass) for s in node.body):
+            return  # broad+pass is NNS104's finding already
+        for sub in ast.walk(ast.Module(body=node.body, type_ignores=[])):
+            if isinstance(sub, ast.Raise):
+                return
+            if isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr in _BUS_POST_ATTRS:
+                return
+        self.emit(
+            "NNS111", node,
+            "broad except in an element chain/worker loop that neither "
+            "re-raises nor posts to the pipeline bus — the dead frame "
+            "becomes a silent hang (no error message, no EOS)",
+            hint="re-raise (let _chain_entry's error-policy handle it), "
+                 "call post_error/post_warning, or justify with a pragma")
 
     def _rule_nns105(self, node: ast.Call, dotted: str) -> None:
         if dotted not in ("threading.Thread", "Thread"):

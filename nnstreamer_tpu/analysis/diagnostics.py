@@ -59,6 +59,13 @@ CODE_TABLE: Dict[str, str] = {
     "NNS108": "direct tensor materialization outside the sanctioned "
               "to_host() site (bypasses the DeviceBuffer cache and the "
               "transfer counters)",
+    "NNS109": "REORDER_SAFE class whose per-frame chain mutates self "
+              "state (lane clones would diverge from the serial element)",
+    "NNS110": "blocking sleep or unbounded wait in a scheduler/dispatch "
+              "hot path (stales admission decisions, wedges EOS)",
+    "NNS111": "broad except in an element chain/worker loop that "
+              "neither re-raises nor posts to the pipeline bus (a dead "
+              "frame becomes a silent hang)",
     "NNS199": "nns-lint pragma without a justification",
 }
 

@@ -265,6 +265,22 @@ def main(argv=None) -> int:
                          "batch forming) on the admission-point queues; "
                          "unset/0 keeps the plain FIFO path (see "
                          "docs/profiling.md, SLO tuning)")
+    ap.add_argument("--error-policy", default=None, metavar="POLICY",
+                    choices=("halt", "skip-frame", "retry", "degrade"),
+                    help="pipeline-default element error policy: halt "
+                         "(fail fast, the default), skip-frame (drop "
+                         "the failing frame and keep streaming), retry "
+                         "(bounded exponential backoff), or degrade "
+                         "(tensor_filter backend reload then CPU "
+                         "fallback); per-element 'error-policy' "
+                         "properties override (see docs/robustness.md)")
+    ap.add_argument("--watchdog-s", type=float, default=None, metavar="S",
+                    help="arm the pipeline watchdog: fail the pipeline "
+                         "with a bus error when no frame progresses for "
+                         "S seconds while work is in flight, instead of "
+                         "hanging a stalled fence or EOS drain forever; "
+                         "NNSTPU_WATCHDOG_S does the same without the "
+                         "flag (see docs/robustness.md)")
     args = ap.parse_args(argv)
 
     if args.confchk:
@@ -322,6 +338,10 @@ def main(argv=None) -> int:
         pipe.lanes = max(1, args.lanes)
     if args.slo_budget_ms is not None:
         pipe.slo_budget_ms = max(0.0, args.slo_budget_ms)
+    if args.error_policy is not None:
+        pipe.error_policy = args.error_policy
+    if args.watchdog_s is not None:
+        pipe.watchdog_s = max(0.0, args.watchdog_s)
 
     if args.verbose:
         for el in pipe.elements:

@@ -34,6 +34,7 @@ import numpy as np
 from nnstreamer_tpu.config import get_conf
 from nnstreamer_tpu.obs import get_registry
 from nnstreamer_tpu.obs import timeline as _timeline
+from nnstreamer_tpu.pipeline import faults as _faults
 from nnstreamer_tpu.filters.api import FilterFramework, FilterProperties
 from nnstreamer_tpu.pipeline.element import (
     CustomEvent,
@@ -214,8 +215,9 @@ class TensorFilter(Element):
         self._open_fw()
 
     def stop(self):
-        self._window.drain()  # fence outstanding dispatches before the
-        # backend (whose params they read) closes
+        # fence outstanding dispatches before the backend (whose params
+        # they read) closes; a poisoned batch must not abort teardown
+        self._window.drain(on_error="log")
         if self.fw is not None:
             self.fw.close()
             self.fw = None
@@ -323,11 +325,26 @@ class TensorFilter(Element):
                 if not isinstance(x, np.ndarray) else x
                 for x in model_inputs]
 
+        fi = _faults.ACTIVE
+        if fi is not None:
+            # chaos hook, BEFORE the stash pop: a retrying error policy
+            # re-enters chain with the buffer's meta intact
+            fi.check("filter.invoke",
+                     seq=buf.meta.get(_timeline.TRACE_SEQ_META))
+
         from nnstreamer_tpu.pipeline.dispatch import POOL_STASH_META
 
         stash = buf.meta.pop(POOL_STASH_META, None)
         t0 = _time.monotonic()
-        outputs = fw.invoke(model_inputs)
+        try:
+            outputs = fw.invoke(model_inputs)
+        except Exception:
+            if stash:
+                # restore the stash so a retrying error policy (or the
+                # next consumer) still releases the pooled staging
+                # arrays at a fence — a lost stash pins slabs forever
+                buf.meta[POOL_STASH_META] = stash
+            raise
         dt = _time.monotonic() - t0
         obs["invoke"].observe(dt)
         tl = _timeline.ACTIVE

@@ -160,7 +160,8 @@ class TensorLMServe(Element):
             max_new = int(buf.meta.get("lm_max_new", max_new))
             stream = self._engine.submit(prompt, max_new_tokens=max_new)
             self._enqueue(cid, (stream, buf, None, time.monotonic()))
-        except Exception as e:  # noqa: BLE001 — a malformed remote
+        except Exception as e:  # noqa: BLE001  # nns-lint: disable=NNS111 -- failure surfaces as an in-order error RESPONSE, not a bus error
+            # a malformed remote
             # request must not error the server pipeline (remote DoS);
             # its error response goes through the SAME per-client fifo so
             # it cannot overtake earlier in-flight completions (the wire
@@ -254,7 +255,8 @@ class TensorLMServe(Element):
                         "lm_prompt_len": stream.prompt_len,
                     })
                 self._push_response(out)
-            except Exception as e:  # noqa: BLE001 — one failed request
+            except Exception as e:  # noqa: BLE001  # nns-lint: disable=NNS111 -- failure surfaces as an in-order error RESPONSE, not a bus error
+                # one failed request
                 # must neither kill the drainer nor skip a response (the
                 # order-matched protocol would attribute every later
                 # completion to the wrong request)
@@ -267,7 +269,7 @@ class TensorLMServe(Element):
                     stream.cancel()
                 try:
                     self._push_response(self._error_response(buf, str(e)))
-                except Exception as e2:  # noqa: BLE001 — downstream gone
+                except Exception as e2:  # noqa: BLE001  # nns-lint: disable=NNS111 -- downstream gone: nothing left to post to
                     self.log.warning("client %d error response dropped: "
                                      "%s", cid, e2)
             finally:

@@ -31,8 +31,12 @@ import time
 import weakref
 from typing import Any, Deque, List, Optional, Tuple
 
+from nnstreamer_tpu.log import get_logger
 from nnstreamer_tpu.obs import timeline as _timeline
+from nnstreamer_tpu.pipeline import faults as _faults
 from nnstreamer_tpu.tensors.buffer import is_device_array
+
+log = get_logger("dispatch")
 
 #: meta key carrying pool-owned host staging arrays whose release is
 #: deferred to the fence point (set by Queue prefetch-device)
@@ -55,6 +59,7 @@ class DispatchWindow:
             Tuple[List[Any], Optional[list], Optional[int], float]] = \
             collections.deque()
         self._m_fence = None
+        self._m_poisoned = None
         self._gauge_done = False
 
     def __len__(self) -> int:
@@ -113,12 +118,30 @@ class DispatchWindow:
             self._fence_oldest()
 
     def _fence_oldest(self) -> None:
+        """Fence the oldest outstanding batch. A failing fence (device
+        error surfacing at ``block_until_ready``, or an injected
+        ``dispatch.fence`` fault) poisons ONLY that batch: the entry is
+        already popped, its stash still releases, the timeline still
+        closes its inflight span — the entries behind it fence normally
+        on later calls, so in-order delivery of the surviving frames is
+        never corrupted. The wrapped error propagates to the dispatching
+        element's chain, where its error policy decides the outcome."""
         tensors, stash, frame, _t_admit = self._entries.popleft()
         hist = self._obs()
         t0 = time.monotonic()
-        for t in tensors:
-            if is_device_array(t):
-                t.block_until_ready()
+        err: Optional[BaseException] = None
+        try:
+            fi = _faults.ACTIVE
+            if fi is not None:
+                # chaos hook: kind=stall parks this fence (watchdog
+                # bait); kind=raise poisons the batch
+                fi.check("dispatch.fence", seq=frame)
+            for t in tensors:
+                if is_device_array(t):
+                    t.block_until_ready()
+        except Exception as e:  # noqa: BLE001 — isolation: bookkeeping
+            # below must run before the poisoned batch's error surfaces
+            err = e
         t1 = time.monotonic()
         if hist is not None:
             hist.observe(t1 - t0)
@@ -135,11 +158,50 @@ class DispatchWindow:
             from nnstreamer_tpu.tensors.pool import get_pool
 
             get_pool().release_many(stash)
+        if err is not None:
+            self._count_poisoned()
+            from nnstreamer_tpu.pipeline.element import FlowError
 
-    def drain(self) -> None:
-        """Fence everything outstanding (EOS / stop / unsplice)."""
+            owner = self._owner()
+            name = owner.name if owner is not None else "dispatch"
+            if isinstance(err, FlowError):
+                raise err
+            raise FlowError(
+                f"{name}: poisoned in-flight batch at fence: {err}"
+            ) from err
+
+    def _count_poisoned(self) -> None:
+        if self._m_poisoned is None:
+            from nnstreamer_tpu.obs import get_registry
+
+            owner = self._owner()
+            labels = owner._obs_labels() if owner is not None else {}
+            self._m_poisoned = get_registry().counter(
+                "nns_fault_poisoned_batches_total",
+                "In-flight dispatches whose fence failed (batch "
+                "isolated; entries behind it fence normally)", **labels)
+        self._m_poisoned.inc()
+
+    def drain(self, on_error: str = "raise") -> None:
+        """Fence everything outstanding (EOS / stop / unsplice). A
+        poisoned batch never strands the entries behind it: every entry
+        is fenced (stashes released) and the FIRST failure re-raises at
+        the end — or is only logged with ``on_error="log"``, the
+        teardown mode where a raise would abort the rest of stop()."""
+        first: Optional[BaseException] = None
         while self._entries:
-            self._fence_oldest()
+            try:
+                self._fence_oldest()
+            except Exception as e:  # noqa: BLE001 — keep fencing: the
+                # remaining entries' stashes must still release
+                if first is None:
+                    first = e
+        if first is not None:
+            if on_error == "log":
+                log.warning("dispatch drain: poisoned batch during "
+                            "teardown: %s", first)
+                return
+            raise first
 
     def snapshot(self) -> dict:
         out = {"inflight_now": len(self._entries),

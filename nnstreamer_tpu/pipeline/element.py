@@ -221,7 +221,12 @@ class Element:
     """
 
     ELEMENT_NAME = "element"
-    PROPERTIES: Dict[str, Any] = {"silent": True, "name": None}
+    #: ``error_policy`` None = inherit ``Pipeline(error_policy=...)``,
+    #: else ``halt`` — see pipeline/supervise.py for the policy set and
+    #: ``retry_max``/``retry_backoff_ms`` semantics
+    PROPERTIES: Dict[str, Any] = {"silent": True, "name": None,
+                                  "error_policy": None, "retry_max": 3,
+                                  "retry_backoff_ms": 5.0}
 
     _instance_counter: Dict[str, int] = {}
     _instance_counter_lock = threading.Lock()
@@ -442,7 +447,7 @@ class Element:
             except FlowError:
                 raise
             except Exception as e:
-                raise FlowError(f"{self.name}: {e}") from e
+                ret = self._recover_chain(pad, buf, e)
         finally:
             now = _time.monotonic()
             self.stats.record(now - t0, now)
@@ -476,7 +481,7 @@ class Element:
             except FlowError:
                 raise
             except Exception as e:
-                raise FlowError(f"{self.name}: {e}") from e
+                ret = self._recover_chain_list(pad, bufs, e)
         finally:
             now = _time.monotonic()
             per = (now - t0) / max(len(bufs), 1)
@@ -485,6 +490,33 @@ class Element:
                 self.stats.record(per, now)
                 hist.observe(per)
         return FlowReturn.OK if ret is None else ret
+
+    def _halt_policy(self) -> bool:
+        """True when this element's effective error policy is ``halt``
+        (the default). Decided from the two property reads alone so the
+        common no-supervision case never imports the recovery module."""
+        pol = self._props.get("error_policy") or \
+            getattr(self.pipeline, "error_policy", None)
+        return not pol or str(pol).replace("_", "-") == "halt"
+
+    def _recover_chain(self, pad: Pad, buf: TensorBuffer,
+                       exc: BaseException) -> FlowReturn:
+        """A ``chain`` call raised a non-FlowError: apply the element's
+        error policy (``pipeline/supervise.py``). ``halt`` reproduces
+        the historical wrap-and-raise exactly."""
+        if self._halt_policy():
+            raise FlowError(f"{self.name}: {exc}") from exc
+        from nnstreamer_tpu.pipeline import supervise
+
+        return supervise.recover_chain(self, pad, buf, exc)
+
+    def _recover_chain_list(self, pad: Pad, bufs: List[TensorBuffer],
+                            exc: BaseException) -> FlowReturn:
+        if self._halt_policy():
+            raise FlowError(f"{self.name}: {exc}") from exc
+        from nnstreamer_tpu.pipeline import supervise
+
+        return supervise.recover_chain_list(self, pad, bufs, exc)
 
     def _event_entry(self, pad: Pad, event: Event) -> None:
         if isinstance(event, CapsEvent):
@@ -577,6 +609,14 @@ class Element:
             self.pipeline.post_error(self, exc)
         else:
             raise exc
+
+    def post_warning(self, text: str) -> None:
+        """Post a non-fatal condition to the pipeline bus (logged and
+        delivered as a ``warning`` message; ``wait()`` keeps running)."""
+        if self.pipeline is not None:
+            self.pipeline.post_warning(self, text)
+        else:
+            self.log.warning("%s: %s", self.name, text)
 
     def __repr__(self):
         return f"<{type(self).__name__} {self.name!r}>"

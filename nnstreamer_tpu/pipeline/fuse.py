@@ -276,7 +276,8 @@ class FusedRegion(Element):
                 # per-frame fence; steady-state frames skip this branch
                 jax.block_until_ready(out)  # nns-lint: disable=NNS107 -- once
                 self._verified = True
-        except Exception as e:  # noqa: BLE001 — fusion is an optimization,
+        except Exception as e:  # noqa: BLE001  # nns-lint: disable=NNS111 -- falls back to the member chain, whose error handling is authoritative
+            # fusion is an optimization,
             # never a failure: a stage that won't trace or whose first
             # post-compile execution fails falls back to the member chain,
             # whose own error handling is authoritative. (Runtime failures

@@ -55,6 +55,7 @@ import numpy as np
 from nnstreamer_tpu.log import get_logger
 from nnstreamer_tpu.obs import get_registry
 from nnstreamer_tpu.obs import timeline as _timeline
+from nnstreamer_tpu.pipeline import faults as _faults
 from nnstreamer_tpu.pipeline.element import (
     CapsEvent,
     Element,
@@ -197,7 +198,12 @@ class IngestLanes(Element):
         self._forwarded = 0
         self._fwd_times: collections.deque = collections.deque(maxlen=256)
         self._last_caps_str: Optional[str] = None
+        #: last negotiated caps — replayed through a restarted lane's
+        #: fresh clone chain so it is negotiated like its predecessor
+        self._saved_caps = None
         self._m_stall = None  # lazy: labels need the owning pipeline
+        self._m_leaked = None
+        self._m_restarts = None
 
     # -- capacity ------------------------------------------------------------
     def _capacity(self) -> int:
@@ -214,6 +220,14 @@ class IngestLanes(Element):
             "nns_lane_reorder_stall_seconds",
             "Cumulative lane-worker time blocked on a full reorder "
             "buffer (head-of-line pressure from a slow lane)", **labels)
+        self._m_leaked = reg.counter(
+            "nns_lane_leaked_threads_total",
+            "Lane executor threads that failed to join within the "
+            "stop() timeout (leaked past teardown)", **labels)
+        self._m_restarts = reg.counter(
+            "nns_fault_lane_restarts_total",
+            "Lane worker clone-chain restarts under supervision",
+            **labels)
         ref = weakref.ref(self)
         reg.gauge(
             "nns_lane_occupancy",
@@ -310,16 +324,31 @@ class IngestLanes(Element):
         self._stop_evt.set()
         with self._cv:
             self._cv.notify_all()
-        for t in self._workers:
+        for k, t in enumerate(self._workers):
             t.join(timeout=5)
+            if t.is_alive():
+                self._count_leaked(f"lane {k} worker", t)
         self._workers = []
         if self._drainer is not None:
             self._drainer.join(timeout=5)
+            if self._drainer.is_alive():
+                self._count_leaked("drain thread", self._drainer)
             self._drainer = None
         for clones in self._clones:
             for c in clones:
                 c.stop()
         super().stop()
+
+    def _count_leaked(self, what: str, thread: threading.Thread) -> None:
+        """A join timed out: the thread outlives the executor. Silent
+        before — now every leak is logged with its identity and counted
+        (``nns_lane_leaked_threads_total``) so teardown hangs show up in
+        tests and on dashboards instead of as mystery threads."""
+        self.log.warning(
+            "%s: %s (%s) did not join within 5s at stop(); thread leaked",
+            self.name, what, thread.name)
+        if self._m_leaked is not None:
+            self._m_leaked.inc()
 
     # -- splicing ------------------------------------------------------------
     def splice(self, pipe) -> None:
@@ -368,9 +397,7 @@ class IngestLanes(Element):
         return buf.with_tensors(staged)
 
     def _worker(self, k: int) -> None:
-        head, tail = self._heads[k], self._tails[k]
         q, pool = self._lane_qs[k], self._pools[k]
-        sink = head.sinkpads[0]
         while not self._stop_evt.is_set():
             try:
                 seq, buf = q.get(timeout=0.1)
@@ -380,17 +407,32 @@ class IngestLanes(Element):
             tl = _timeline.ACTIVE
             t_pick = time.monotonic() if tl is not None else 0.0
             try:
-                head._chain_entry(sink, self._stage_copy(buf, pool))
-                items = tail.take()
+                fi = _faults.ACTIVE
+                if fi is not None:
+                    # chaos hook: kind=crash simulates abrupt worker
+                    # death — supervision (below) restarts the lane
+                    fi.check("lane.worker",
+                             seq=buf.meta.get(_timeline.TRACE_SEQ_META))
+                # re-read per iteration: supervision may have swapped in
+                # a fresh clone chain after a restart
+                head = self._heads[k]
+                head._chain_entry(head.sinkpads[0],
+                                  self._stage_copy(buf, pool))
+                items = self._tails[k].take()
             except Exception as e:  # noqa: BLE001 — a lane failure must
-                # reach the bus (and stop the peers), not die silently
+                # reach the bus (halt) or lane supervision (any other
+                # error policy), never die silently
                 self._busy[k] = False
-                self.post_error(e if isinstance(e, FlowError)
-                                else FlowError(f"{self.name}: lane {k}: {e}"))
-                self._stop_evt.set()
-                with self._cv:
-                    self._cv.notify_all()
-                return
+                if self._halt_policy():
+                    self.post_error(
+                        e if isinstance(e, FlowError)
+                        else FlowError(f"{self.name}: lane {k}: {e}"))
+                    self._stop_evt.set()
+                    with self._cv:
+                        self._cv.notify_all()
+                    return
+                self._supervise_lane_failure(k, seq, buf, e)
+                continue
             self._busy[k] = False
             if tl is not None:
                 # recorded from the lane worker's own thread, so the
@@ -420,6 +462,114 @@ class IngestLanes(Element):
                 if t0 is not None:
                     tl.span("lane_stall", _tl_seq(items), t0, now)
             self._cv.notify_all()
+
+    # -- lane supervision (pipeline/supervise.py policies) -------------------
+    def _supervise_lane_failure(self, k: int, seq: int, buf,
+                                exc: BaseException) -> None:
+        """A lane worker failed with a non-halt error policy: restart
+        the lane's clone chain (its per-frame state is untrusted after
+        an arbitrary failure), then either replay the in-flight frame
+        through the fresh chain (``retry``/``degrade``) or account it as
+        dropped (``skip-frame``). Either way the frame's sequence slot
+        is filled — a real result or an empty tombstone — so the reorder
+        buffer delivers every surviving frame in order, byte-identical
+        to a run where the dead frame never existed."""
+        from nnstreamer_tpu.pipeline import supervise
+
+        policy = supervise.effective_policy(self)
+        if self._m_restarts is not None:
+            self._m_restarts.inc()
+        tl = _timeline.ACTIVE
+        if tl is not None:
+            tl.mark("lane_restart",
+                    buf.meta.get(_timeline.TRACE_SEQ_META),
+                    track="faults", lane=k)
+        self.log.warning(
+            "%s: lane %d worker failed on seq %d (%s); restarting clone "
+            "chain under error-policy=%s", self.name, k, seq, exc, policy)
+        try:
+            self._rebuild_lane(k)
+        except Exception as e:  # noqa: BLE001 — a lane that cannot be
+            # rebuilt is unrecoverable; fail the pipeline
+            self.post_error(FlowError(
+                f"{self.name}: lane {k} restart failed: {e}"))
+            self._stop_evt.set()
+            with self._cv:
+                self._cv.notify_all()
+            return
+        m = supervise._metrics(self)
+        if policy == "skip-frame":
+            self._tombstone(k, seq, buf, exc, m)
+            return
+        # retry / degrade: replay the in-flight frame through the fresh
+        # chain with the element-standard bounded backoff
+        retry_max = max(1, int(self._props.get("retry_max") or 3))
+        base_ms = float(self._props.get("retry_backoff_ms") or 5.0)
+        pool = self._pools[k]
+        last = exc
+        for attempt in range(1, retry_max + 1):
+            supervise._backoff_sleep(self, attempt, base_ms)
+            m["retries"].inc()
+            try:
+                head = self._heads[k]
+                head._chain_entry(head.sinkpads[0],
+                                  self._stage_copy(buf, pool))
+                items = self._tails[k].take()
+            except Exception as e:  # noqa: BLE001 — bounded ladder; the
+                # frame is tombstoned below when attempts run out
+                last = e
+                continue
+            m["recovered"].inc()
+            self.log.warning(
+                "%s: lane %d recovered seq %d on retry %d/%d", self.name,
+                k, seq, attempt, retry_max)
+            self._reorder_put(seq, items)
+            return
+        self._tombstone(k, seq, buf, last, m)
+
+    def _tombstone(self, k: int, seq: int, buf, exc: BaseException,
+                   m) -> None:
+        """Fill the dead frame's sequence slot with an empty unit: the
+        drain advances past it delivering nothing, so survivors stay in
+        order and the EOS drain still completes."""
+        m["skipped"].inc()
+        tl = _timeline.ACTIVE
+        if tl is not None:
+            tl.mark("fault_skip", buf.meta.get(_timeline.TRACE_SEQ_META),
+                    track="faults", element=self.name, lane=k)
+        self.log.warning("%s: lane %d dropped seq %d after failure (%s)",
+                         self.name, k, seq, exc)
+        self._reorder_put(seq, [])
+
+    def _rebuild_lane(self, k: int) -> None:
+        """Swap lane k's clone chain for a fresh one. Single-writer
+        safe: only worker k drives lane k's chain, and the caps
+        renegotiation barrier waits for every stamped slot (including
+        the in-flight one this rebuild is filling) before touching
+        heads."""
+        for c in self._clones[k]:
+            try:
+                c.stop()
+            except Exception as e:  # noqa: BLE001 — the dead chain's
+                # teardown must not block its replacement
+                self.log.warning("%s: lane %d clone %s stop failed: %s",
+                                 self.name, k, c.name, e)
+        clones = [self._clone_of(el, k) for el in self.segment]
+        tail = _LaneTail(name=f"{self.name}~tail{k}")
+        tail.pipeline = self.pipeline
+        for a, b in zip(clones, clones[1:]):
+            a.srcpads[0].link(b.sinkpads[0])
+        clones[-1].srcpads[0].link(tail.sinkpads[0])
+        for c in clones:
+            c.start()
+        self._clones[k] = clones
+        self._heads[k] = clones[0]
+        self._tails[k] = tail
+        if self._saved_caps is not None:
+            head = clones[0]
+            head._event_entry(head.sinkpads[0],
+                              CapsEvent(self._saved_caps))
+            tail.take()  # announcement already forwarded by lane 0
 
     def _drain_loop(self) -> None:
         while not self._stop_evt.is_set():
@@ -492,7 +642,18 @@ class IngestLanes(Element):
             # (re)negotiation is a barrier: flush in-flight frames, then
             # run the caps through every lane's clone chain so each is
             # negotiated; forward lane 0's announcement (all identical)
-            self._wait_drained(self._seq, timeout=_EOS_DRAIN_TIMEOUT_S)
+            self._saved_caps = event.caps
+            if not self._wait_drained(self._seq,
+                                      timeout=_EOS_DRAIN_TIMEOUT_S):
+                # satellite fix: this False was silently dropped — the
+                # barrier proceeding with frames still in flight means
+                # those frames render under the WRONG caps downstream
+                self.post_warning(
+                    f"caps renegotiation barrier timed out after "
+                    f"{_EOS_DRAIN_TIMEOUT_S:.0f}s with "
+                    f"{self._seq - self._delivered} frame slot(s) "
+                    f"undelivered; proceeding — in-flight frames may "
+                    f"carry stale caps")
             first_items: List[Tuple[str, Any]] = []
             for k in range(self.n):
                 head = self._heads[k]
@@ -507,9 +668,14 @@ class IngestLanes(Element):
             # reorder buffer before EOS crosses downstream
             if not self._wait_drained(self._seq,
                                       timeout=_EOS_DRAIN_TIMEOUT_S):
-                self.log.warning(
-                    "%s: EOS drain timed out with %d slot(s) undelivered",
-                    self.name, self._seq - self._delivered)
+                # satellite fix: a swallowed timeout here silently
+                # dropped the undrained frames — put the loss on the bus
+                # where applications (and the chaos tests) can see it
+                self.post_warning(
+                    f"EOS drain timed out after "
+                    f"{_EOS_DRAIN_TIMEOUT_S:.0f}s with "
+                    f"{self._seq - self._delivered} frame slot(s) "
+                    f"undelivered; those frames are lost")
             self.srcpad.push_event(event)
             return
         # any other serialized event: give it a sequence slot so it never

@@ -333,7 +333,9 @@ def _build_element(node: LaunchNode) -> Element:
 
 def parse_launch(description: str, pipeline: Optional[Pipeline] = None,
                  lanes: Optional[int] = None,
-                 slo_budget_ms: Optional[float] = None) -> Pipeline:
+                 slo_budget_ms: Optional[float] = None,
+                 error_policy: Optional[str] = None,
+                 watchdog_s: Optional[float] = None) -> Pipeline:
     """Build a Pipeline from a gst-launch-style description.
 
     Two-pass like gst_parse_launch: first build all elements and record the
@@ -346,12 +348,21 @@ def parse_launch(description: str, pipeline: Optional[Pipeline] = None,
     (``serving/scheduler.py``): deadline admission, EDF ordering and
     feedback-tuned batch forming on the admission-point queues; None/0
     leaves the scheduler off entirely (byte-identical FIFO path).
+    ``error_policy`` sets the pipeline-default recovery policy
+    (``pipeline/supervise.py``: halt | skip-frame | retry | degrade;
+    elements override via their ``error-policy`` property) and
+    ``watchdog_s`` arms the stall watchdog with that deadline; None
+    leaves both at the fail-fast defaults.
     """
     pipe = pipeline or Pipeline()
     if lanes is not None:
         pipe.lanes = max(1, int(lanes))
     if slo_budget_ms is not None:
         pipe.slo_budget_ms = max(0.0, float(slo_budget_ms))
+    if error_policy is not None:
+        pipe.error_policy = error_policy
+    if watchdog_s is not None:
+        pipe.watchdog_s = max(0.0, float(watchdog_s))
 
     # -- pass 1: nodes & chains (syntax via parse_description) ---------------
     # node: ("el", Element) | ("ref", name) | ("refpad", name, pad)

@@ -27,6 +27,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from nnstreamer_tpu.log import get_logger
 from nnstreamer_tpu.obs import get_registry, register_pipeline_collector
 from nnstreamer_tpu.obs import timeline as _timeline
+from nnstreamer_tpu.pipeline import faults as _faults
 from nnstreamer_tpu.pipeline.element import (
     Element,
     EosEvent,
@@ -51,13 +52,18 @@ class Message:
     """Bus message (GstMessage equivalent)."""
 
     def __init__(self, kind: str, source: Optional[Element] = None,
-                 error: Optional[Exception] = None):
-        self.kind = kind  # "eos" | "error"
+                 error: Optional[Exception] = None,
+                 text: Optional[str] = None):
+        self.kind = kind  # "eos" | "error" | "warning"
         self.source = source
         self.error = error
+        self.text = text  # human-readable detail (warnings)
 
     def __repr__(self):
-        return f"Message({self.kind}, src={getattr(self.source, 'name', None)}, err={self.error})"
+        detail = f", text={self.text!r}" if self.text else ""
+        return (f"Message({self.kind}, "
+                f"src={getattr(self.source, 'name', None)}, "
+                f"err={self.error}{detail})")
 
 
 class SourceElement(Element):
@@ -369,6 +375,12 @@ class Queue(Element):
         return maxsize <= 0 or self._q.qsize() < maxsize
 
     def chain(self, pad, buf):
+        fi = _faults.ACTIVE
+        if fi is not None:
+            # chaos hook (pipeline/faults.py): a raise here surfaces
+            # through _chain_entry under THIS queue's error policy
+            fi.check("queue.push",
+                     seq=buf.meta.get(_timeline.TRACE_SEQ_META))
         if self.get_property("prefetch_host") and \
                 not self.get_property("materialize_host"):
             # (materialize_host issues the copies drain-side, grouped)
@@ -728,7 +740,9 @@ class Pipeline:
     """Element container + scheduler + bus."""
 
     def __init__(self, name: str = "pipeline", fuse: bool = True,
-                 lanes: int = 1, slo_budget_ms: float = 0.0):
+                 lanes: int = 1, slo_budget_ms: float = 0.0,
+                 error_policy: Optional[str] = None,
+                 watchdog_s: float = 0.0):
         self.name = name
         self.elements: List[Element] = []
         self.by_name: Dict[str, Element] = {}
@@ -749,6 +763,14 @@ class Pipeline:
         #: object at all — the byte-identical pre-scheduler path.
         self.slo_budget_ms = float(slo_budget_ms or 0.0)
         self._slo_scheduler = None
+        #: pipeline-default error policy (pipeline/supervise.py);
+        #: elements without their own ``error-policy`` property inherit
+        #: this. None = ``halt``, the historical fail-fast behavior.
+        self.error_policy = error_policy
+        #: watchdog deadline in seconds (>0 arms PipelineWatchdog at
+        #: start()); NNSTPU_WATCHDOG_S overrides when unset
+        self.watchdog_s = float(watchdog_s or 0.0)
+        self._watchdog = None
         # export per-element latency/throughput gauges at scrape time
         # (weakref-bound: a collected pipeline unregisters itself)
         register_pipeline_collector(self)
@@ -837,6 +859,10 @@ class Pipeline:
         # no explicit activation = ACTIVE stays None and every trace
         # site is a single is-None test.
         _timeline.maybe_activate_env()
+        # fault injection (pipeline/faults.py): same discipline —
+        # NNSTPU_FAULTS unset leaves faults.ACTIVE None and every hook
+        # is one attribute read on the byte-identical path
+        _faults.maybe_activate_env()
         sources = [e for e in self.elements if isinstance(e, SourceElement)]
         others = [e for e in self.elements if not isinstance(e, SourceElement)]
         # SLO scheduler before any element starts: admission-point
@@ -886,11 +912,39 @@ class Pipeline:
             )
             self._threads.append(t)
             t.start()
+        # liveness watchdog (pipeline/supervise.py): armed only with an
+        # explicit deadline (Pipeline(watchdog_s=) / NNSTPU_WATCHDOG_S)
+        # — default off, zero extra threads
+        wd_s = self._effective_watchdog_s()
+        if wd_s > 0 and self._watchdog is None:
+            from nnstreamer_tpu.pipeline.supervise import PipelineWatchdog
+
+            self._watchdog = PipelineWatchdog(self, wd_s)
+            self._watchdog.start()
         return self
+
+    def _effective_watchdog_s(self) -> float:
+        if self.watchdog_s > 0:
+            return self.watchdog_s
+        import os
+
+        raw = os.environ.get("NNSTPU_WATCHDOG_S", "").strip()
+        if not raw:
+            return 0.0
+        try:
+            return float(raw)
+        except ValueError:
+            log.warning("NNSTPU_WATCHDOG_S=%r is not a number; watchdog "
+                        "stays off", raw)
+            return 0.0
 
     def stop(self) -> "Pipeline":
         if self.state is State.NULL:
             return self
+        # watchdog first: teardown quiescence must not read as a stall
+        if self._watchdog is not None:
+            self._watchdog.stop()
+            self._watchdog = None
         for el in self.elements:
             if isinstance(el, SourceElement):
                 el.stop()
@@ -921,6 +975,14 @@ class Pipeline:
         log.error("pipeline %s: error from %s: %s", self.name,
                   source.name if source else "?", error)
         self._bus.put(Message("error", source, error))
+
+    def post_warning(self, source: Optional[Element], text: str) -> None:
+        """Non-fatal bus message: logged, delivered to ``pop_message``
+        readers, and skipped over by ``wait()`` (the pipeline keeps
+        running — the reference's GST_MESSAGE_WARNING semantics)."""
+        log.warning("pipeline %s: warning from %s: %s", self.name,
+                    source.name if source else "?", text)
+        self._bus.put(Message("warning", source, text=text))
 
     def pop_message(self, timeout: Optional[float] = None) -> Optional[Message]:
         try:

@@ -253,6 +253,11 @@ class SloScheduler:
                 "nns_sched_shed_total",
                 "Admitted frames shed before dispatch",
                 reason="capacity", **labels),
+            "retries": reg.counter(
+                "nns_fault_sched_retry_seconds_total",
+                "Wall time burnt on element retries/backoff fed into the "
+                "service-rate estimate (brownout-aware admission)",
+                **labels),
             "slack": reg.histogram(
                 "nns_sched_deadline_slack_seconds",
                 "Deadline slack at admission decision time (negative = "
@@ -375,6 +380,18 @@ class SloScheduler:
     def observe_service(self, seconds: float, frames: int = 1) -> None:
         """Backend invoke latency (elements/filter.py hot path)."""
         self.estimator.observe_invoke(seconds, frames)
+
+    def note_retry(self, busy_s: float) -> None:
+        """An element recovered (or exhausted) a retry ladder after
+        ``busy_s`` of failed attempts + backoff (pipeline/supervise.py).
+        That wall time is real per-frame service cost during a brownout:
+        folding it into the invoke-side estimate raises the service-time
+        EWMA, so admission tightens exactly while the element is flaky
+        instead of over-admitting against the healthy-path estimate."""
+        if busy_s <= 0:
+            return
+        self._m["retries"].inc(busy_s)
+        self.estimator.observe_invoke(busy_s, 1)
 
     def observe_completion(self, latency_s: float, now: float,
                            frames: int = 1) -> None:

@@ -115,6 +115,21 @@ def _tl_xfer_span(kind: str, meta: Dict[str, Any], t0: float,
             nbytes=nbytes)
 
 
+def _fault_check(site: str, meta: Dict[str, Any]) -> None:
+    """Transfer-site chaos hook (pipeline/faults.py), resolved through
+    ``sys.modules`` so the tensors layer never imports the pipeline
+    package (element.py imports this module — a top-level import back
+    would cycle). With injection off this is one dict lookup; an
+    injector can only exist once its module is imported, so the lazy
+    resolution can never miss an active one."""
+    import sys
+
+    faults = sys.modules.get("nnstreamer_tpu.pipeline.faults")
+    if faults is None or faults.ACTIVE is None:
+        return
+    faults.ACTIVE.check(site, seq=meta.get(_timeline.TRACE_SEQ_META))
+
+
 def record_residency_entry(resident: bool) -> None:
     """Tally one DeviceBuffer pad entry: ``resident`` means the element
     declared DEVICE_PASSTHROUGH and the buffer crossed the pad without a
@@ -257,6 +272,7 @@ class TensorBuffer:
                 out.append(np.asarray(t))
                 moved += _device_nbytes(t)
         if moved:
+            _fault_check("transfer.d2h", self.meta)
             _record_d2h(moved)
             _tl_xfer_span("d2h", self.meta, t0, nbytes=moved)
         buf = self.replace(tensors=out, finalize=None)
@@ -275,6 +291,7 @@ class TensorBuffer:
         out = [jax.device_put(t, tgt) if tgt is not None else jax.device_put(t)
                for t in self.tensors]
         if moved:
+            _fault_check("transfer.h2d", self.meta)
             _record_h2d(moved)
             _tl_xfer_span("h2d", self.meta, t0, nbytes=moved)
         return self.replace(tensors=out)
@@ -395,6 +412,7 @@ class DeviceBuffer(TensorBuffer):
                     host.append(np.asarray(t))
                     moved += _device_nbytes(t)
             if moved:
+                _fault_check("transfer.d2h", self.meta)
                 _record_d2h(moved)
                 _tl_xfer_span("d2h", self.meta, t0, nbytes=moved)
         buf = TensorBuffer(tensors=host, pts=self.pts, dts=self.dts,

@@ -184,8 +184,8 @@ class TestVerifierGraph:
     def test_every_emitted_code_is_documented(self):
         # any diagnostic the verifier can emit has a CODE_TABLE row
         # (docs/linting.md renders from the same table)
-        assert {"NNS001", "NNS005", "NNS011", "NNS101",
-                "NNS199"} <= set(CODE_TABLE)
+        assert {"NNS001", "NNS005", "NNS011", "NNS101", "NNS109",
+                "NNS110", "NNS111", "NNS199"} <= set(CODE_TABLE)
 
 
 class TestParsePositionalErrors:
@@ -425,6 +425,69 @@ class TestAstLint:
                "    self._ev.wait()  # nns-lint: disable=NNS110 -- "
                "teardown-only flush, no admission live\n")
         assert by_code(lint_source(src, "x.py"), "NNS110") == []
+
+    def test_nns111_swallowed_except_in_worker_loop(self):
+        src = ("def _worker(self, k):\n"
+               "    try:\n"
+               "        step()\n"
+               "    except Exception as e:\n"
+               "        log.warning('oops %s', e)\n")
+        assert "NNS111" in codes(lint_source(src, "x.py"))
+
+    def test_nns111_reraise_or_bus_post_ok(self):
+        src = ("def chain(self, pad, buf):\n"
+               "    try:\n"
+               "        step()\n"
+               "    except Exception:\n"
+               "        raise\n"
+               "def _drain(self):\n"
+               "    try:\n"
+               "        step()\n"
+               "    except Exception as e:\n"
+               "        self.post_error(e)\n"
+               "def run_loop(self):\n"
+               "    try:\n"
+               "        step()\n"
+               "    except Exception:\n"
+               "        self.post_warning('degraded')\n")
+        assert by_code(lint_source(src, "x.py"), "NNS111") == []
+
+    def test_nns111_narrow_or_cold_path_ok(self):
+        # a narrow except is a deliberate, typed decision; the same
+        # swallow outside the chain/worker set is not this rule's concern
+        src = ("def _worker(self, k):\n"
+               "    try:\n"
+               "        step()\n"
+               "    except KeyError as e:\n"
+               "        log.warning('oops %s', e)\n"
+               "def helper(self):\n"
+               "    try:\n"
+               "        step()\n"
+               "    except Exception as e:\n"
+               "        log.warning('oops %s', e)\n")
+        assert by_code(lint_source(src, "x.py"), "NNS111") == []
+
+    def test_nns111_bare_and_pass_left_to_nns104(self):
+        src = ("def chain(self, pad, buf):\n"
+               "    try:\n"
+               "        step()\n"
+               "    except:\n"
+               "        pass\n"
+               "    try:\n"
+               "        step()\n"
+               "    except Exception:\n"
+               "        pass\n")
+        assert by_code(lint_source(src, "x.py"), "NNS111") == []
+        assert len(by_code(lint_source(src, "x.py"), "NNS104")) == 2
+
+    def test_nns111_pragma_suppressible(self):
+        src = ("def _drain(self):\n"
+               "    try:\n"
+               "        step()\n"
+               "    except Exception as e:  # nns-lint: disable=NNS111 "
+               "-- error response goes out in-band\n"
+               "        respond(e)\n")
+        assert by_code(lint_source(src, "x.py"), "NNS111") == []
 
     def test_pragma_suppresses_with_reason(self):
         src = ("import time\n"

@@ -1,0 +1,596 @@
+"""Supervision layer + deterministic fault injection (pipeline/faults.py,
+pipeline/supervise.py).
+
+The contract under test, per docs/robustness.md:
+
+- ``NNSTPU_FAULTS`` unset means ``faults.ACTIVE is None`` and the hot
+  path is byte-identical to a build without the injector;
+- the same spec + seed reproduces the same fired occurrence set across
+  runs and regardless of thread interleaving (pure function of
+  ``(seed, site, n)``);
+- ``error-policy=retry`` recovers injected failures with ZERO frame
+  loss and byte-identical output; ``skip-frame`` loses exactly the
+  injected count with survivor order preserved; ``degrade`` reloads the
+  tensor_filter backend and keeps serving; ``halt`` is the unchanged
+  default (wrap, raise, bus error);
+- a crashed lane worker restarts under supervision with surviving
+  frames delivered in order;
+- the watchdog detects a stalled pipeline within its deadline, fails it
+  on the bus, and teardown leaves no live threads;
+- every injected fault/recovery is visible from three independent
+  witnesses that must agree: the injector's fired log, the
+  ``nns_fault_*`` counters, and the frame-ledger ``faults`` track.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.obs import get_registry
+from nnstreamer_tpu.obs import timeline as _timeline
+from nnstreamer_tpu.pipeline import faults
+from nnstreamer_tpu.pipeline import supervise
+from nnstreamer_tpu.pipeline.element import Element, FlowReturn
+from nnstreamer_tpu.pipeline.pipeline import (
+    FlowError,
+    Pipeline,
+    Queue,
+    SourceElement,
+)
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
+from nnstreamer_tpu.tensors.types import TensorsConfig
+
+# -- helpers ------------------------------------------------------------------
+
+
+@pytest.fixture(autouse=True)
+def _no_active_injector():
+    faults.deactivate()
+    yield
+    faults.deactivate()
+
+
+def _cval(name, **labels):
+    m = get_registry().get(name, **labels)
+    return 0.0 if m is None else m.value
+
+
+def _live_threads():
+    return set(threading.enumerate())
+
+
+def _extra_threads(before, timeout=5.0):
+    """Threads alive now that were not alive at ``before`` — polled,
+    because worker joins race the assertion."""
+    deadline = time.monotonic() + timeout
+    while True:
+        extra = [t for t in threading.enumerate()
+                 if t not in before and t.is_alive()]
+        if not extra or time.monotonic() >= deadline:
+            return extra
+        time.sleep(0.05)
+
+
+class _SeqSrc(SourceElement):
+    """Index-stamped scalar tensors 1..n."""
+
+    ELEMENT_NAME = "_supseqsrc"
+    REORDER_SAFE = True
+    PROPERTIES = {**SourceElement.PROPERTIES, "num_buffers": 16}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.i = 0
+
+    def negotiate(self):
+        cfg = TensorsConfig.from_arrays([np.zeros((4,), np.float32)])
+        self.srcpad.set_caps(cfg.to_caps())
+
+    def create(self):
+        if self.i >= self.get_property("num_buffers"):
+            return None
+        self.i += 1
+        return TensorBuffer(
+            [np.full((4,), float(self.i), np.float32)],
+            pts=self.i * 1000)
+
+
+class _Hook(Element):
+    """Pure transform (x*2+1) that runs the ``filter.invoke`` fault hook
+    per frame — the generic stand-in for a backend invoke."""
+
+    ELEMENT_NAME = "_suphook"
+    REORDER_SAFE = True
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+
+    def chain(self, pad, buf):
+        fi = faults.ACTIVE
+        if fi is not None:
+            fi.check("filter.invoke",
+                     seq=buf.meta.get(_timeline.TRACE_SEQ_META))
+        self.srcpad.push(buf.with_tensors(
+            [t * 2.0 + 1.0 for t in buf.tensors]))
+        return FlowReturn.OK
+
+
+class _Boom(Element):
+    """Raises ValueError on the ``fail_at``-th frame; forwards others."""
+
+    ELEMENT_NAME = "_supboom"
+    PROPERTIES = {**Element.PROPERTIES, "fail_at": 5}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+        self.n = 0
+
+    def chain(self, pad, buf):
+        self.n += 1
+        if self.n == int(self.get_property("fail_at")):
+            raise ValueError(f"boom on frame {self.n}")
+        self.srcpad.push(buf)
+        return FlowReturn.OK
+
+
+def _build(name, *mids, n=20, **pipe_kw):
+    """src(n) ! mids... ! tensor_sink, returning (pipe, outs list of
+    first-scalar floats appended at the sink)."""
+    from nnstreamer_tpu.elements.sink import TensorSink
+
+    pipe = Pipeline(name=name, fuse=False, **pipe_kw)
+    src = _SeqSrc(num_buffers=n)
+    sink = TensorSink(name="out")
+    pipe.add_linked(src, *mids, sink)
+    outs = []
+    sink.connect(lambda b: outs.append(float(np.asarray(b.tensors[0])[0])))
+    return pipe, outs
+
+
+# -- spec grammar and activation ----------------------------------------------
+
+
+class TestSpecGrammar:
+    def test_parse_multi_clause_spec(self):
+        rules = faults.parse_faults(
+            "filter.invoke:rate=0.01,kind=raise;"
+            "lane.worker:nth=37,kind=crash;"
+            "dispatch.fence:kind=stall,ms=500")
+        by_site = {r.site: r for r in rules}
+        assert by_site["filter.invoke"].rate == 0.01
+        assert by_site["filter.invoke"].kind == "raise"
+        assert by_site["lane.worker"].nth == 37
+        assert by_site["lane.worker"].kind == "crash"
+        assert by_site["dispatch.fence"].kind == "stall"
+        assert by_site["dispatch.fence"].ms == 500.0
+
+    def test_unknown_site_kind_key_all_raise(self):
+        with pytest.raises(ValueError, match="unknown site"):
+            faults.parse_faults("bogus.site:rate=1")
+        with pytest.raises(ValueError, match="unknown kind"):
+            faults.parse_faults("filter.invoke:kind=bogus")
+        with pytest.raises(ValueError, match="unknown key"):
+            faults.parse_faults("filter.invoke:frequency=2")
+
+    def test_env_activation_and_idempotence(self, monkeypatch):
+        monkeypatch.setenv("NNSTPU_FAULTS", "filter.invoke:nth=2")
+        monkeypatch.setenv("NNSTPU_FAULTS_SEED", "5")
+        inj = faults.maybe_activate_env()
+        assert inj is not None and faults.ACTIVE is inj
+        assert inj.seed == 5
+        assert faults.maybe_activate_env() is inj  # idempotent
+
+    def test_explicit_injector_wins_over_env(self, monkeypatch):
+        inj = faults.activate("filter.invoke:nth=1")
+        monkeypatch.setenv("NNSTPU_FAULTS", "queue.push:nth=1")
+        assert faults.maybe_activate_env() is inj
+
+    def test_unset_env_leaves_active_none(self, monkeypatch):
+        monkeypatch.delenv("NNSTPU_FAULTS", raising=False)
+        assert faults.maybe_activate_env() is None
+        assert faults.ACTIVE is None
+
+    def test_bad_seed_env_falls_back_to_zero(self, monkeypatch):
+        monkeypatch.setenv("NNSTPU_FAULTS", "filter.invoke:nth=9999")
+        monkeypatch.setenv("NNSTPU_FAULTS_SEED", "not-a-number")
+        inj = faults.maybe_activate_env()
+        assert inj is not None and inj.seed == 0
+
+
+# -- determinism --------------------------------------------------------------
+
+
+def _drive(inj, site, n):
+    fired = []
+    for _ in range(n):
+        try:
+            inj.check(site)
+        except faults.InjectedFault as e:
+            fired.append(e.n)
+    return fired
+
+
+class TestDeterminism:
+    def test_same_spec_seed_same_fired_set(self):
+        a = faults.FaultInjector(
+            faults.parse_faults("filter.invoke:rate=0.3"), seed=11)
+        b = faults.FaultInjector(
+            faults.parse_faults("filter.invoke:rate=0.3"), seed=11)
+        fired_a = _drive(a, "filter.invoke", 200)
+        fired_b = _drive(b, "filter.invoke", 200)
+        assert fired_a == fired_b
+        assert len(fired_a) > 0
+        assert a.fired_set("filter.invoke") == sorted(fired_a)
+
+    def test_decision_independent_of_thread_interleaving(self):
+        serial = faults.FaultInjector(
+            faults.parse_faults("queue.push:rate=0.3"), seed=3)
+        _drive(serial, "queue.push", 200)
+        threaded = faults.FaultInjector(
+            faults.parse_faults("queue.push:rate=0.3"), seed=3)
+
+        def worker():
+            for _ in range(50):
+                try:
+                    threaded.check("queue.push")
+                except faults.InjectedFault:
+                    pass
+
+        ts = [threading.Thread(target=worker) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        # the occurrence counter hands out a different interleaving, but
+        # the decision per occurrence index is the same pure function
+        assert threaded.fired_set("queue.push") \
+            == serial.fired_set("queue.push")
+
+    def test_nth_and_every_triggers(self):
+        inj = faults.FaultInjector(
+            faults.parse_faults("filter.invoke:nth=3"), seed=0)
+        assert _drive(inj, "filter.invoke", 10) == [3]
+        inj = faults.FaultInjector(
+            faults.parse_faults("filter.invoke:every=4"), seed=0)
+        assert _drive(inj, "filter.invoke", 12) == [4, 8, 12]
+
+    def test_crash_kind_raises_injected_crash(self):
+        inj = faults.FaultInjector(
+            faults.parse_faults("lane.worker:nth=1,kind=crash"))
+        with pytest.raises(faults.InjectedCrash):
+            inj.check("lane.worker")
+        assert inj.fired == [("lane.worker", 1, "crash")]
+
+    def test_pipeline_runs_reproduce_fired_set(self):
+        def once(tag):
+            inj = faults.activate("filter.invoke:rate=0.2", seed=7)
+            pipe, outs = _build(f"sup-det-{tag}", _Hook(),
+                                error_policy="retry")
+            msg = pipe.run(timeout=30)
+            assert msg is not None and msg.kind == "eos"
+            return inj.fired_set("filter.invoke"), outs
+
+        fired1, outs1 = once("a")
+        fired2, outs2 = once("b")
+        assert fired1 == fired2 and len(fired1) > 0
+        assert outs1 == outs2
+
+
+# -- error policies -----------------------------------------------------------
+
+
+class TestRetryPolicy:
+    def test_zero_loss_byte_identical_no_hang(self):
+        inj = faults.activate("filter.invoke:rate=0.2", seed=7)
+        pipe, outs = _build("sup-retry",
+                            _Hook(name="hook", retry_backoff_ms=1.0),
+                            error_policy="retry")
+        msg = pipe.run(timeout=30)
+        assert msg is not None and msg.kind == "eos"
+        assert outs == [i * 2.0 + 1.0 for i in range(1, 21)]
+        assert inj.injected("filter.invoke") > 0
+        labels = pipe.get("hook")._obs_labels()
+        assert _cval("nns_fault_recovered_total", **labels) >= 1
+        assert _cval("nns_fault_retries_total", **labels) >= 1
+
+    def test_exhausted_retries_halt_with_flow_error(self):
+        faults.activate("filter.invoke:every=1")  # every attempt fails
+        pipe, _ = _build("sup-retry-exhaust",
+                         _Hook(retry_max=2, retry_backoff_ms=1.0),
+                         n=4, error_policy="retry")
+        with pytest.raises(FlowError, match="retry exhausted"):
+            pipe.run(timeout=30)
+
+    def test_element_policy_overrides_pipeline_default(self):
+        faults.activate("filter.invoke:nth=2")
+        # pipeline says halt (default); the element itself opts into
+        # skip-frame and must win
+        pipe, outs = _build("sup-override",
+                            _Hook(error_policy="skip_frame"), n=6)
+        msg = pipe.run(timeout=30)
+        assert msg is not None and msg.kind == "eos"
+        assert len(outs) == 5
+
+
+class TestSkipFramePolicy:
+    def test_loss_equals_injected_order_preserved(self):
+        inj = faults.activate("filter.invoke:rate=0.2", seed=7)
+        pipe, outs = _build("sup-skip", _Hook(name="hook"),
+                            error_policy="skip-frame")
+        msg = pipe.run(timeout=30)
+        assert msg is not None and msg.kind == "eos"
+        lost = inj.injected("filter.invoke")
+        assert lost > 0
+        assert len(outs) == 20 - lost
+        assert outs == sorted(outs)  # survivors in order
+        survivors = {(v - 1.0) / 2.0 for v in outs}
+        fired = {float(n) for n in inj.fired_set("filter.invoke")}
+        assert survivors == set(range(1, 21)) - \
+            {float(i) for i in range(1, 21) if float(i) in fired}
+        assert _cval("nns_fault_skipped_frames_total",
+                     **pipe.get("hook")._obs_labels()) == lost
+
+    def test_halt_is_unchanged_default(self):
+        faults.activate("filter.invoke:nth=3")
+        pipe, outs = _build("sup-halt", _Hook(), n=6)
+        with pytest.raises(FlowError, match="injected fault"):
+            pipe.run(timeout=30)
+        assert outs == [3.0, 5.0]  # frames before the failure delivered
+
+
+class TestDegradePolicy:
+    def test_filter_backend_reload_keeps_serving(self):
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu import parse_launch
+        from nnstreamer_tpu.filters.jax_backend import register_jax_model
+
+        register_jax_model("sup_degrade",
+                           lambda x: (x.astype(jnp.float32) * 2.0,), None)
+        faults.activate("filter.invoke:nth=3")
+        pipe = parse_launch(
+            "videotestsrc num-buffers=6 width=4 height=4 ! "
+            "tensor_converter ! "
+            "tensor_filter framework=jax model=sup_degrade name=filter ! "
+            "queue materialize-host=true ! tensor_sink name=out",
+            error_policy="degrade")
+        outs = []
+        pipe.get("out").connect(lambda b: outs.append(b))
+        msg = pipe.run(timeout=60)
+        assert msg is not None and msg.kind == "eos"
+        assert len(outs) == 6  # zero loss: reload + retry served frame 3
+        el = pipe.get("filter")
+        labels = el._obs_labels()
+        assert _cval("nns_fault_degraded_total", **labels) >= 1
+        assert _cval("nns_fault_recovered_total", **labels) >= 1
+        # the first rung (in-place reload) recovered — the CPU-fallback
+        # rung never ran, so the accelerator property is untouched
+        assert el._props.get("accelerator") != "cpu"
+
+    def test_non_filter_element_gets_retry_semantics(self):
+        faults.activate("filter.invoke:nth=2")
+        pipe, outs = _build("sup-degrade-nonfilter",
+                            _Hook(retry_backoff_ms=1.0), n=6,
+                            error_policy="degrade")
+        msg = pipe.run(timeout=30)
+        assert msg is not None and msg.kind == "eos"
+        assert len(outs) == 6  # recovered by retry, no backend involved
+
+
+# -- lane-worker supervision --------------------------------------------------
+
+
+class TestLaneSupervision:
+    def _run(self, policy, spec, n=20, lanes=4):
+        inj = faults.activate(spec)
+        pipe, outs = _build(f"sup-lane-{policy}", _Hook(),
+                            n=n, lanes=lanes, error_policy=policy)
+        msg = pipe.run(timeout=60)
+        assert msg is not None and msg.kind == "eos"
+        return inj, pipe, outs
+
+    def test_crashed_worker_restarts_zero_loss_in_order(self):
+        inj, pipe, outs = self._run(
+            "retry", "lane.worker:nth=5,kind=crash")
+        assert inj.injected("lane.worker") == 1
+        assert outs == [i * 2.0 + 1.0 for i in range(1, 21)]
+        ex = pipe._lane_execs[0]
+        assert _cval("nns_fault_lane_restarts_total",
+                     **ex._obs_labels()) >= 1
+        assert ex._delivered == ex._seq  # nothing stranded
+
+    def test_crashed_worker_skip_frame_counts_loss(self):
+        inj, pipe, outs = self._run(
+            "skip-frame", "lane.worker:nth=5,kind=crash")
+        assert inj.injected("lane.worker") == 1
+        assert len(outs) == 19  # exactly the in-flight frame lost
+        assert outs == sorted(outs)
+        ex = pipe._lane_execs[0]
+        assert ex._delivered == ex._seq
+
+
+# -- watchdog -----------------------------------------------------------------
+
+
+class TestWatchdog:
+    def test_detects_stall_within_deadline_clean_shutdown(self):
+        before = _live_threads()
+        trips0 = _cval("nns_fault_watchdog_trips_total",
+                       pipeline="sup-wd-stall")
+        faults.activate("filter.invoke:nth=2,kind=stall,ms=2500")
+        pipe, _ = _build("sup-wd-stall", _Hook(), n=6, watchdog_s=0.4)
+        t0 = time.monotonic()
+        pipe.start()
+        msg = pipe.wait(timeout=10)
+        detect_s = time.monotonic() - t0
+        assert msg is not None and msg.kind == "error"
+        assert "watchdog" in str(msg.error)
+        assert detect_s < 2.0  # detected well inside the stall
+        pipe.stop()
+        assert _cval("nns_fault_watchdog_trips_total",
+                     pipeline="sup-wd-stall") == trips0 + 1
+        assert _extra_threads(before) == []
+
+    def test_quiescent_pipeline_never_trips(self):
+        trips0 = _cval("nns_fault_watchdog_trips_total",
+                       pipeline="sup-wd-idle")
+        pipe, outs = _build("sup-wd-idle", _Hook(), n=4, watchdog_s=0.2)
+        pipe.start()
+        msg = pipe.wait(timeout=10)
+        assert msg is not None and msg.kind == "eos"
+        time.sleep(0.8)  # 4x the deadline of post-EOS idle
+        pipe.stop()
+        assert len(outs) == 4
+        assert _cval("nns_fault_watchdog_trips_total",
+                     pipeline="sup-wd-idle") == trips0
+
+    def test_env_arms_watchdog(self, monkeypatch):
+        monkeypatch.setenv("NNSTPU_WATCHDOG_S", "5.0")
+        pipe, _ = _build("sup-wd-env", _Hook(), n=2)
+        pipe.start()
+        try:
+            assert pipe._watchdog is not None
+            assert pipe._watchdog.deadline_s == 5.0
+        finally:
+            pipe.stop()
+        assert pipe._watchdog is None
+
+    def test_off_by_default_zero_threads(self, monkeypatch):
+        monkeypatch.delenv("NNSTPU_WATCHDOG_S", raising=False)
+        pipe, _ = _build("sup-wd-off", _Hook(), n=2)
+        pipe.start()
+        try:
+            assert pipe._watchdog is None
+            assert not any("watchdog" in t.name
+                           for t in threading.enumerate())
+        finally:
+            pipe.stop()
+
+
+# -- three-witness agreement: injector log, metrics, timeline -----------------
+
+
+class TestMetricsAndMarksAgree:
+    def test_fault_counts_agree_across_witnesses(self):
+        m0 = _cval("nns_fault_injected_total",
+                   site="filter.invoke", kind="raise")
+        tl = _timeline.activate()
+        try:
+            inj = faults.activate("filter.invoke:rate=0.3", seed=3)
+            pipe, _ = _build("sup-witness", _Hook(),
+                             error_policy="skip-frame")
+            msg = pipe.run(timeout=30)
+            assert msg is not None and msg.kind == "eos"
+            injected = inj.injected("filter.invoke")
+            assert injected > 0
+            marks = [r for r in tl._snapshot()
+                     if r[1] == "fault" and r[5] == "faults"]
+            skips = [r for r in tl._snapshot()
+                     if r[1] == "fault_skip" and r[5] == "faults"]
+        finally:
+            _timeline.deactivate()
+        assert len(marks) == injected
+        assert len(skips) == injected
+        assert _cval("nns_fault_injected_total",
+                     site="filter.invoke", kind="raise") == m0 + injected
+        assert inj.snapshot() == {"filter.invoke": injected}
+
+
+# -- kill switch --------------------------------------------------------------
+
+
+class TestKillSwitch:
+    def test_unset_env_is_byte_identical_off_path(self, monkeypatch):
+        monkeypatch.delenv("NNSTPU_FAULTS", raising=False)
+        pipe, outs = _build("sup-off", _Hook(), n=8)
+        msg = pipe.run(timeout=30)
+        assert msg is not None and msg.kind == "eos"
+        assert faults.ACTIVE is None  # never activated by start()
+        assert outs == [i * 2.0 + 1.0 for i in range(1, 9)]
+
+    def test_unknown_policy_is_a_flow_error(self):
+        pipe, _ = _build("sup-badpol",
+                         _Hook(name="hook", error_policy="bogus"), n=2)
+        with pytest.raises(FlowError, match="unknown error-policy"):
+            supervise.effective_policy(pipe.get("hook"))
+
+
+# -- bus error path (pre-existing machinery the supervisor builds on) ---------
+
+
+class _RawEntryBoom(Element):
+    """Raises a PLAIN RuntimeError from the chain-entry boundary itself,
+    bypassing the element-level FlowError wrap — exercising the queue
+    drain workers' own wrap-to-FlowError handlers."""
+
+    ELEMENT_NAME = "_suprawboom"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad("sink")
+        self.add_src_pad("src")
+
+    def chain(self, pad, buf):  # pragma: no cover - never reached
+        return FlowReturn.OK
+
+    def _chain_entry(self, pad, buf):
+        raise RuntimeError("raw entry boom")
+
+
+class TestBusErrorPath:
+    def test_error_posts_after_prefailure_frames(self):
+        before = _live_threads()
+        pipe, outs = _build("sup-bus-order",
+                            Queue(name="q", max_size_buffers=8),
+                            _Boom(fail_at=5), n=8)
+        pipe.start()
+        msg = pipe.wait(timeout=30)
+        assert msg is not None and msg.kind == "error"
+        assert isinstance(msg.error, FlowError)
+        assert "boom on frame 5" in str(msg.error)
+        # every pre-failure frame was delivered before the error posted
+        assert outs == [1.0, 2.0, 3.0, 4.0]
+        pipe.stop()
+        assert _extra_threads(before) == []
+
+    def test_queue_drain_wraps_raw_exception_in_flow_error(self):
+        pipe, _ = _build("sup-bus-wrap", Queue(name="q"),
+                         _RawEntryBoom(), n=4)
+        pipe.start()
+        msg = pipe.wait(timeout=30)
+        pipe.stop()
+        assert msg is not None and msg.kind == "error"
+        assert isinstance(msg.error, FlowError)
+        # the queue's _drain handler names ITSELF as the wrap site
+        assert str(msg.error).startswith("q: ")
+        assert "raw entry boom" in str(msg.error)
+
+    def test_sched_drain_wraps_and_stops_clean(self):
+        before = _live_threads()
+        pipe, _ = _build(
+            "sup-bus-sched",
+            Queue(name="q", stamp_admission=True, max_size_buffers=16),
+            _RawEntryBoom(), n=4, slo_budget_ms=10_000.0)
+        pipe.start()
+        assert pipe.get("q")._sched is not None  # scheduler path active
+        msg = pipe.wait(timeout=30)
+        pipe.stop()
+        assert msg is not None and msg.kind == "error"
+        assert isinstance(msg.error, FlowError)
+        assert str(msg.error).startswith("q: ")
+        assert _extra_threads(before) == []
+
+    def test_stop_after_error_leaves_no_live_threads(self):
+        before = _live_threads()
+        pipe, _ = _build("sup-bus-threads",
+                         Queue(name="q", max_size_buffers=4),
+                         _Boom(fail_at=2), n=16, lanes=1)
+        with pytest.raises(FlowError):
+            pipe.run(timeout=30)
+        assert _extra_threads(before) == []
