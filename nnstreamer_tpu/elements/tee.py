@@ -37,14 +37,18 @@ class Tee(Element):
 
     def chain(self, pad, buf):
         from nnstreamer_tpu.pipeline.dispatch import POOL_STASH_META
+        from nnstreamer_tpu.tensors.buffer import H2D_EXCLUSIVE_META
 
-        if POOL_STASH_META in buf.meta:
+        if POOL_STASH_META in buf.meta or H2D_EXCLUSIVE_META in buf.meta:
             # fan-out would duplicate the staging-buffer release claim:
             # one branch's explicit release could recycle memory another
             # branch's in-flight device work still reads. Drop the claim
             # — the pool's GC fallback recycles once every branch is done.
+            # The donation marker goes with it: a fanned-out payload has
+            # N readers, so no branch's fused region may donate it.
             buf = buf.replace()
             buf.meta.pop(POOL_STASH_META, None)
+            buf.meta.pop(H2D_EXCLUSIVE_META, None)
         ret = FlowReturn.OK
         for sp in self.srcpads:
             r = sp.push(buf)

@@ -16,7 +16,13 @@ The window also owns the staging-buffer recycle point: a pooled host
 array consumed by an H2D transfer (``tensors/pool.py``, carried in
 ``meta["pool_stash"]``) must not be rewritten while the transfer or the
 dispatch reading it is in flight. Fencing entry N proves dispatch N
-completed, so its stash is released exactly there.
+completed, so its stash is released exactly there. Batched window
+uploads (``tensors/buffer.py`` ``upload_many``) extend the same
+contract: the single window slab that staged a whole drained run rides
+the run's LAST buffer's stash, so the in-order fence releases it only
+after every dispatch that read any slot of that upload has completed
+(a slot still adopted as a DeviceBuffer host view keeps the slab out
+of circulation through the pool's refcount guard regardless).
 
 Instrumented as ``nns_filter_inflight`` (current window occupancy) and
 ``nns_filter_fence_wait_seconds`` (time spent blocked in each fence —
@@ -39,7 +45,9 @@ from nnstreamer_tpu.tensors.buffer import is_device_array
 log = get_logger("dispatch")
 
 #: meta key carrying pool-owned host staging arrays whose release is
-#: deferred to the fence point (set by Queue prefetch-device)
+#: deferred to the fence point (set by Queue prefetch-device; a batched
+#: window upload additionally parks its shared window slab on the run's
+#: last buffer here)
 POOL_STASH_META = "pool_stash"
 
 

@@ -36,6 +36,7 @@ from nnstreamer_tpu.log import get_logger
 from nnstreamer_tpu.obs import get_registry
 from nnstreamer_tpu.pipeline.caps import ANY, Caps, CapsList
 from nnstreamer_tpu.tensors.buffer import (
+    H2D_EXCLUSIVE_META,
     DeviceBuffer,
     TensorBuffer,
     record_residency_entry,
@@ -386,6 +387,14 @@ class Element:
     #: ``chain`` state mutations.
     REORDER_SAFE = False
 
+    #: This element's jitted program may consume (donate) an incoming
+    #: single-consumer payload — only the fused region sets this. Every
+    #: OTHER element strips the upload point's exclusivity marker at pad
+    #: entry: once a payload has crossed a non-consuming element its
+    #: ownership chain is unprovable (meta is copied onto derived
+    #: buffers), so donation must not trust a stale marker.
+    DONATION_CONSUMER = False
+
     def reorder_safe(self) -> bool:
         """Instance-level lane-replicability check; defaults to the class
         flag. Elements that are only conditionally stateless
@@ -426,6 +435,9 @@ class Element:
         t0 = _time.monotonic()
         try:
             try:
+                if not self.DONATION_CONSUMER and \
+                        H2D_EXCLUSIVE_META in buf.meta:
+                    buf.meta.pop(H2D_EXCLUSIVE_META, None)
                 if isinstance(buf, DeviceBuffer):
                     # a resident buffer stays resident across elements that
                     # declared passthrough (finalize-free payloads) or that
@@ -467,6 +479,9 @@ class Element:
             try:
                 entered = []
                 for b in bufs:
+                    if not self.DONATION_CONSUMER and \
+                            H2D_EXCLUSIVE_META in b.meta:
+                        b.meta.pop(H2D_EXCLUSIVE_META, None)
                     if isinstance(b, DeviceBuffer):
                         resident = self.HANDLES_DEFERRED or (
                             self.DEVICE_PASSTHROUGH and b.finalize is None)
@@ -541,8 +556,20 @@ class Element:
         :meth:`chain`; HANDLES_LIST elements may override to hoist
         per-buffer overhead (e.g. one lock acquisition per backlog)."""
         ret = None
-        for b in bufs:
-            ret = self.chain(pad, b)
+        for i, b in enumerate(bufs):
+            try:
+                ret = self.chain(pad, b)
+            except Exception as e:
+                # buffers before index i were fully chained (and pushed
+                # downstream) — record the progress so a non-halt error
+                # policy replays only the unconsumed suffix instead of
+                # re-pushing delivered frames (duplication)
+                if getattr(e, "_nns_list_done", None) is None:
+                    try:
+                        e._nns_list_done = i
+                    except Exception:  # nns-lint: disable=NNS104 -- exceptions with __slots__ just lose the replay hint; the original error re-raises below
+                        pass
+                raise
             if ret is FlowReturn.EOS:
                 break
         return ret

@@ -26,9 +26,12 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import warnings
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 from nnstreamer_tpu.log import get_logger
+from nnstreamer_tpu.obs import timeline as _timeline
+from nnstreamer_tpu.pipeline import faults as _faults
 from nnstreamer_tpu.pipeline.element import (
     CustomEvent,
     Element,
@@ -37,9 +40,26 @@ from nnstreamer_tpu.pipeline.element import (
     Pad,
     peer_device_capable,
 )
-from nnstreamer_tpu.tensors.buffer import as_device_buffer
+from nnstreamer_tpu.pipeline.supervise import effective_policy
+from nnstreamer_tpu.tensors.buffer import (
+    H2D_EXCLUSIVE_META,
+    as_device_buffer,
+    is_device_array,
+)
 
 log = get_logger("fuse")
+
+# donation falls back gracefully where XLA can't apply it (host numpy
+# inputs, backends without aliasing support): JAX executes correctly and
+# warns — the warning is expected steady-state noise here, not a bug
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable")
+warnings.filterwarnings("ignore", message="Donation is not implemented")
+
+#: error policies under which the supervisor may RE-INVOKE chain() with
+#: the same buffer after a fault — a donated input can't be replayed, so
+#: these arm a device-side replay copy instead of donating the original
+_REPLAY_POLICIES = ("retry", "degrade")
 
 
 @dataclasses.dataclass
@@ -72,6 +92,15 @@ class DeviceStage:
 
 def fusion_enabled() -> bool:
     return os.environ.get("NNSTPU_FUSE", "1").strip().lower() not in (
+        "0", "false", "no", "off"
+    )
+
+
+def donation_enabled() -> bool:
+    """Kill switch for input-slab donation (``NNSTPU_DONATE=0``): the
+    fused program then never aliases its input buffers, which is the
+    reference behavior for debugging donation-suspected corruption."""
+    return os.environ.get("NNSTPU_DONATE", "1").strip().lower() not in (
         "0", "false", "no", "off"
     )
 
@@ -124,6 +153,10 @@ class FusedRegion(Element):
     #: the jitted program consumes jax.Arrays directly — a DeviceBuffer
     #: input skips H2D staging and the ingest pool entirely
     DEVICE_PASSTHROUGH = True
+    #: the jitted program may DONATE an incoming single-consumer payload
+    #: (upload points mark those with H2D_EXCLUSIVE_META); chain() stages
+    #: a replay copy whenever the original must survive a re-invoke
+    DONATION_CONSUMER = True
     PROPERTIES = {**Element.PROPERTIES, "inflight": 2}
 
     def __init__(self, members: Sequence[Element], name=None, **props):
@@ -155,6 +188,8 @@ class FusedRegion(Element):
             self._props["inflight"] = max(member_inflight)
         self._window = DispatchWindow(self)
         self._m_retrace = None  # region re-trace counter (lazy)
+        self._m_whole = None    # whole-graph program gauge (lazy)
+        self._donating = False  # the live jit was built with donation
 
     # -- stage (re)build -----------------------------------------------------
     def _build(self) -> Tuple[list, Callable]:
@@ -179,17 +214,53 @@ class FusedRegion(Element):
             jitted = cache[1]
         else:
             fns = [st.fn for st in stages]
+            count = self._count_retrace
 
             def composed(consts, tensors):
+                # the counter fires at TRACE time: jax.jit re-executes
+                # this Python body once per distinct input signature, so
+                # a new batch shape (aggregator flush tail vs full
+                # window) is counted as the real XLA compile it is —
+                # while the jit object below is REUSED across shapes, so
+                # alternating batch sizes hit jit's per-shape executable
+                # cache instead of retracing every frame
+                count()
                 for f, c in zip(fns, consts):
                     tensors = f(c, list(tensors))
                 return list(tensors)
 
-            jitted = jax.jit(composed)
+            # donate the input tensor slab: the whole-graph program may
+            # write its outputs into the (freshly uploaded, single-
+            # consumer) input buffers instead of allocating, and the
+            # dead inputs free at dispatch rather than at GC. chain()
+            # substitutes a device-side replay copy whenever the
+            # original must survive (unverified first frame, armed
+            # retry/degrade policy, non-exclusive payload).
+            jitted = jax.jit(composed, donate_argnums=(1,)) \
+                if donation_enabled() else jax.jit(composed)
             self._trace_cache = (keys, jitted)
-            self._count_retrace()
+            self._donating = donation_enabled()
         compiled = ([st.consts for st in stages], jitted, stages[-1].finalize)
         self._compiled = compiled
+        if self._m_whole is None:
+            import weakref
+
+            from nnstreamer_tpu.obs import get_registry
+
+            ref = weakref.ref(self)
+
+            def _whole() -> float:
+                r = ref()
+                return 1.0 if (r is not None and r._compiled is not None
+                               and r._compiled[2] is not None) else 0.0
+
+            self._m_whole = get_registry().gauge(
+                "nns_fuse_whole_graph",
+                "1 when this region's single jitted program covers the "
+                "whole device-decodable graph (finalizing decoder stage "
+                "folded in: no mid-stream D2H, host-only work deferred "
+                "to the sink's fetch point)",
+                fn=_whole, **self._obs_labels())
         self._verified = False  # first frame after (re)compile syncs
         return compiled
 
@@ -249,6 +320,14 @@ class FusedRegion(Element):
             raise FlowError(f"{self.name}: buffer on internal event pad")
         if self._qos_throttled():
             return None  # downstream-rate QoS drop (tensor_filter.c:426)
+        fi = _faults.ACTIVE
+        if fi is not None:
+            # chaos hook — the same `filter.invoke` site the unfused
+            # filter checks (its chain doesn't run while fused), BEFORE
+            # donation and the stash pop: a retrying error policy
+            # re-enters chain with the buffer fully intact
+            fi.check("filter.invoke",
+                     seq=buf.meta.get(_timeline.TRACE_SEQ_META))
         compiled = self._compiled
         if compiled is None:
             try:
@@ -261,9 +340,26 @@ class FusedRegion(Element):
         consts, jitted, finalize = compiled
         from nnstreamer_tpu.pipeline.dispatch import POOL_STASH_META
 
+        # upload points stamp single-consumer payloads; popped so the
+        # marker never rides through to this region's OUTPUT buffer
+        exclusive = bool(buf.meta.pop(H2D_EXCLUSIVE_META, False))
         stash = buf.meta.pop(POOL_STASH_META, None)
+        args = list(buf.tensors)
+        if self._donating and not (
+                exclusive and self._verified
+                and effective_policy(self) not in _REPLAY_POLICIES):
+            # the jitted program donates (consumes) its input slab. Keep
+            # the ORIGINALS alive by donating device-side replay copies
+            # instead whenever the inputs may be touched again: an armed
+            # retry/degrade policy re-invokes chain() with this same
+            # buffer after a fault; an unverified first frame may fall
+            # back to the member chain; a non-exclusive payload (source-
+            # owned, tee'd) has readers this region can't see. Host
+            # numpy inputs need no copy — XLA can't alias them, so
+            # donation is a no-op for them.
+            args = [t.copy() if is_device_array(t) else t for t in args]
         try:
-            out = jitted(consts, list(buf.tensors))
+            out = jitted(consts, args)
             if not self._verified:
                 import jax
                 # JAX dispatch is asynchronous: a data-dependent RUNTIME

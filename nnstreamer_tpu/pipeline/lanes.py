@@ -178,6 +178,7 @@ class IngestLanes(Element):
         self._clones: List[List[Element]] = []
         self._lane_qs: List[_queue.Queue] = []
         self._pools: List[Any] = []
+        self._stage_win: dict = {}
         self._busy: List[bool] = []
         self._workers: List[threading.Thread] = []
         self._drainer: Optional[threading.Thread] = None
@@ -276,6 +277,9 @@ class IngestLanes(Element):
 
         self._heads, self._tails, self._clones = [], [], []
         self._lane_qs, self._pools = [], []
+        #: per-lane rolling staging windows (id(pool) → window state);
+        #: single-writer per entry — only that lane's worker
+        self._stage_win = {}
         self._busy = [False] * self.n
         for k in range(self.n):
             clones = [self._clone_of(el, k) for el in self.segment]
@@ -379,21 +383,47 @@ class IngestLanes(Element):
                 continue
         return FlowReturn.EOS
 
+    #: frames staged per rolling window slab (per lane, per tensor): the
+    #: lane writes successive frames into successive SLOTS of one
+    #: contiguous pool slab instead of per-frame staging buffers, so a
+    #: downstream batched upload (``tensors/buffer.py`` ``upload_many``)
+    #: re-wraps a drained run as the stacked H2D view with zero extra
+    #: host copies (``pool.contiguous_window_view``)
+    STAGE_WINDOW_FRAMES = 8
+
     def _stage_copy(self, buf: TensorBuffer, pool) -> TensorBuffer:
         """Copy host payloads into this lane's private pool arena: the
         GIL-releasing memcpy that makes lane parallelism real even when
         the per-frame math was folded on-device, and the reason a source
         frame (possibly a shared cached array or another pool's slab)
-        never couples lanes through slab refcounts."""
+        never couples lanes through slab refcounts.
+
+        Frames land in consecutive slots of a rolling window slab
+        (single-writer: only this lane's worker touches its window
+        state). A signature change or a full window rolls to a fresh
+        slab; old slabs stay alive through their live slot views (the
+        pool's refcount guard) and fall to GC when the last reader
+        drops."""
         if pool is None or not buf.tensors:
             return buf
         if not all(isinstance(t, np.ndarray) for t in buf.tensors):
             return buf  # resident payloads stage nothing on the host
+        sig = tuple((t.shape, t.dtype) for t in buf.tensors)
+        wins = self._stage_win
+        st = wins.get(id(pool))
+        if st is None or st["sig"] != sig or \
+                st["next"] >= self.STAGE_WINDOW_FRAMES:
+            st = {"sig": sig, "next": 0,
+                  "slabs": [pool.acquire_window(self.STAGE_WINDOW_FRAMES,
+                                                t.shape, t.dtype)
+                            for t in buf.tensors]}
+            wins[id(pool)] = st
+        i = st["next"]
+        st["next"] = i + 1
         staged = []
-        for t in buf.tensors:
-            view = pool.acquire(t.shape, t.dtype)
-            np.copyto(view, t)
-            staged.append(view)
+        for t, win in zip(buf.tensors, st["slabs"]):
+            np.copyto(win[i], t)
+            staged.append(win[i])
         return buf.with_tensors(staged)
 
     def _worker(self, k: int) -> None:

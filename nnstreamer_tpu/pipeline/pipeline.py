@@ -158,6 +158,13 @@ class Queue(Element):
                   # data buffers go to HANDLES_LIST peers as one list;
                   # 1 disables gathering entirely.
                   "drain_batch": 64,
+                  # batch_h2d: with prefetch_device, defer the upload to
+                  # the drain side and coalesce each gathered run into a
+                  # single staged multi-frame slab upload (one pool
+                  # window slab, one device_put; per-frame views carved
+                  # device-side — tensors/buffer.py upload_many). False
+                  # restores the per-frame producer-side to_device path.
+                  "batch_h2d": True,
                   # slo_budget_ms: per-queue SLO budget (ms). >0 makes
                   # this queue an admission point of the pipeline's
                   # SloScheduler (serving/scheduler.py): deadline
@@ -392,42 +399,18 @@ class Queue(Element):
                 if start_async is not None:
                     start_async()
         if self.get_property("prefetch_device"):
-            if not buf.on_device():
-                # mirror image of prefetch_host: start H2D for host
-                # tensors NOW so the downstream jitted consumer
-                # dispatches against device arrays (transfer overlaps
-                # the previous frame's compute; on a tunneled chip the
-                # per-call transfer RPC otherwise serializes into every
-                # dispatch)
-                from nnstreamer_tpu.tensors.buffer import as_device_buffer
-                from nnstreamer_tpu.tensors.pool import get_pool
-
-                stash = [t for t in buf.tensors if get_pool().owns(t)]
-                host_src = list(buf.tensors)
-                buf = buf.to_device()
-                # the uploaded copy is the payload from here on; the
-                # pre-upload host arrays become the wrapper's zero-copy
-                # host view (a later to_host costs nothing), and any
-                # pool-owned ones are pinned against explicit release
-                buf = as_device_buffer(buf, host_view=host_src)
-                if stash:
-                    # pooled staging arrays must survive until the
-                    # dispatch that consumes the uploaded copies has
-                    # fenced (the H2D may alias or still be in flight);
-                    # the downstream DispatchWindow releases them at its
-                    # fence point (pipeline/dispatch.py). to_device()
-                    # returned a fresh buffer, so its meta is still ours
-                    # to stamp.
-                    from nnstreamer_tpu.pipeline.dispatch import (
-                        POOL_STASH_META,
-                    )
-
-                    buf.meta[POOL_STASH_META] = stash
-            # a latency-budget partial window deferred its padding here
-            # (aggregator pad-device): only the real frames crossed the
-            # link; the zero rows are synthesized on device now
-            if buf.meta.get("pad_rows"):
-                buf = buf.pad_rows_device()
+            # batch_h2d defers the upload to the drain worker, which
+            # coalesces each gathered run into ONE staged window upload
+            # (_upload_run); the worker thread still overlaps the
+            # transfer with the producer. Producer-side per-frame upload
+            # remains for batch_h2d=false and the degenerate unstarted
+            # passthrough (no worker to defer to). A frame the SLO
+            # scheduler sheds from the EDF heap then never paid its H2D.
+            defer = (self.get_property("batch_h2d")
+                     and self._worker is not None
+                     and not buf.on_device())
+            if not defer:
+                buf = self._upload_one(buf)
         self._tl_arrive(buf)
         if self._sched is not None and self._worker is not None:
             # SLO path: deadline admission + EDF heap; rejected frames
@@ -491,12 +474,116 @@ class Queue(Element):
             # a CapsEvent must not overtake buffers queued ahead of it
             self._q.put(event)
 
+    # -- drain-side H2D batching (tensors/buffer.py upload_many) -------------
+    def _upload_one(self, buf):
+        """Per-frame upload path (producer-side prefetch, window
+        singletons, deferred-pad partial windows): to_device + pool
+        stash stamp + DeviceBuffer wrap with the pre-upload host view."""
+        if not buf.on_device():
+            from nnstreamer_tpu.tensors.buffer import as_device_buffer
+            from nnstreamer_tpu.tensors.pool import get_pool
+
+            stash = [t for t in buf.tensors if get_pool().owns(t)]
+            host_src = list(buf.tensors)
+            buf = buf.to_device()
+            # the uploaded copy is the payload from here on; the
+            # pre-upload host arrays become the wrapper's zero-copy
+            # host view (a later to_host costs nothing), and any
+            # pool-owned ones are pinned against explicit release
+            buf = as_device_buffer(buf, host_view=host_src)
+            # freshly uploaded copy with exactly one downstream consumer:
+            # a fused region may donate it to XLA (tensors/buffer.py)
+            from nnstreamer_tpu.tensors.buffer import H2D_EXCLUSIVE_META
+
+            buf.meta[H2D_EXCLUSIVE_META] = True
+            if stash:
+                # pooled staging arrays must survive until the
+                # dispatch that consumes the uploaded copies has
+                # fenced (the H2D may alias or still be in flight);
+                # the downstream DispatchWindow releases them at its
+                # fence point (pipeline/dispatch.py). to_device()
+                # returned a fresh buffer, so its meta is still ours
+                # to stamp.
+                from nnstreamer_tpu.pipeline.dispatch import POOL_STASH_META
+
+                buf.meta[POOL_STASH_META] = stash
+        # a latency-budget partial window deferred its padding here
+        # (aggregator pad-device): only the real frames crossed the
+        # link; the zero rows are synthesized on device now
+        if buf.meta.get("pad_rows"):
+            buf = buf.pad_rows_device()
+        return buf
+
+    def _upload_group(self, group: list) -> list:
+        """One staged multi-frame slab upload for ≥2 same-signature host
+        buffers. Per-buffer pool stashes are preserved; the window slabs
+        the upload staged through ride the LAST buffer's stash — the
+        dispatch window fences in order, so by the time the last frame's
+        fence releases them every dispatch that read the upload has
+        completed (live DeviceBuffer host views keep their slab out of
+        circulation via the pool's refcount guard regardless)."""
+        from nnstreamer_tpu.pipeline.dispatch import POOL_STASH_META
+        from nnstreamer_tpu.tensors.buffer import upload_many
+        from nnstreamer_tpu.tensors.pool import get_pool
+
+        pool = get_pool()
+        stashes = [[t for t in b.tensors if pool.owns(t)] for b in group]
+        devs, slabs = upload_many(group)
+        for b, st in zip(devs, stashes):
+            if st:
+                b.meta[POOL_STASH_META] = st
+        if slabs:
+            last = devs[-1]
+            last.meta[POOL_STASH_META] = list(
+                last.meta.get(POOL_STASH_META) or []) + slabs
+        return devs
+
+    def _upload_run(self, run: list) -> list:
+        """Split a drained run into maximal groups of consecutive
+        host-resident, identically-shaped buffers and upload each group
+        as one window slab; singletons, device-resident buffers, and
+        deferred-pad partials take the per-frame path."""
+        import numpy as _np
+
+        def _single(b) -> bool:
+            return (b.on_device() or not b.tensors
+                    or b.meta.get("pad_rows")
+                    or not all(isinstance(t, _np.ndarray)
+                               for t in b.tensors))
+
+        out: list = []
+        i = 0
+        while i < len(run):
+            b = run[i]
+            if _single(b):
+                out.append(self._upload_one(b))
+                i += 1
+                continue
+            sig = [(t.shape, t.dtype) for t in b.tensors]
+            j = i + 1
+            while j < len(run) and not _single(run[j]) and \
+                    [(t.shape, t.dtype)
+                     for t in run[j].tensors] == sig:
+                j += 1
+            if j - i >= 2:
+                out.extend(self._upload_group(run[i:j]))
+            else:
+                out.append(self._upload_one(b))
+            i = j
+        return out
+
     def _flush_run(self, run: list) -> None:
         """Deliver a gathered run of data buffers: materialized one by
         one (materialize_host), as ONE list hand-off when the peer opts
         in (``Pad.push_list`` → ``HANDLES_LIST``), else per-buffer."""
         if not run:
             return
+        if self.get_property("prefetch_device") and \
+                self.get_property("batch_h2d"):
+            # deferred uploads land here: the whole run crosses H2D as
+            # one staged slab (buffer identity changes; the timeline/
+            # admission meta rides along on the uploaded copies)
+            run = self._upload_run(run)
         # queue-residency spans end HERE, per item, right before its
         # hand-off — stamping at drain-pop time would hide the in-batch
         # wait (item N sitting in the drained run while items 0..N-1
@@ -505,12 +592,18 @@ class Queue(Element):
         if self.get_property("materialize_host"):
             # materialize HERE, where the group's copies were just
             # issued — handing device arrays onward would re-serialize
-            # the fetches at the sink
-            for it in run:
+            # the fetches at the sink. The whole run comes back in ONE
+            # grouped device_get (zero per-frame D2H round trips —
+            # d2h_per_frame stays 0 on a device-decodable pipeline);
+            # per-buffer finalize/caching semantics match to_host().
+            from nnstreamer_tpu.tensors.buffer import materialize_many
+
+            hosts = materialize_many(run)
+            for it, host in zip(run, hosts):
                 self._undelivered -= 1
                 if tl_on:
                     self._tl_depart(it)
-                self.srcpad.push(it.to_host())
+                self.srcpad.push(host)
         elif len(run) > 1:
             peer = self.srcpad.peer
             if peer is not None and getattr(peer.element,
@@ -960,6 +1053,13 @@ class Pipeline:
                 el.stop()
         for r in self._regions or ():
             r.stop()
+        # drop every staging arena's free slabs (shared ingest pool +
+        # per-lane pools): a stopped pipeline must not pin peak-rate
+        # slab bytes for the life of the process (nns_pool_bytes_held
+        # returns to the outstanding working set)
+        from nnstreamer_tpu.tensors.pool import release_all_pools
+
+        release_all_pools()
         self.state = State.NULL
         # an env-owned timeline (NNSTPU_TRACE=<path>) exports its ledger
         # once the run is over; explicitly installed timelines are the

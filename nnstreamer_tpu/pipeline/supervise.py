@@ -161,9 +161,16 @@ def recover_chain_list(el, pad, bufs: List[Any],
     policy = effective_policy(el)
     if policy == "halt":
         raise _wrap(el, exc)
-    log.warning("%s: chain_list failed (%s); replaying %d buffer(s) "
-                "individually under error-policy=%s", el.name, exc,
-                len(bufs), policy)
+    # the default chain_list marks how many leading buffers were fully
+    # chained before the failure — those already pushed downstream, so
+    # replaying them would DUPLICATE delivered frames. Custom chain_list
+    # implementations without the marker keep the replay-all behavior.
+    done = int(getattr(exc, "_nns_list_done", 0) or 0)
+    if 0 < done <= len(bufs):
+        bufs = bufs[done:]
+    log.warning("%s: chain_list failed (%s); replaying %d undelivered "
+                "buffer(s) individually under error-policy=%s", el.name,
+                exc, len(bufs), policy)
     ret: FlowReturn = FlowReturn.OK
     for b in bufs:
         try:

@@ -54,6 +54,14 @@ _xfer_lock = threading.Lock()
 _xfer: Dict[str, float] = {
     "h2d_bytes": 0.0, "h2d_events": 0.0,
     "d2h_bytes": 0.0, "d2h_events": 0.0,
+    # staged multi-frame window transfers (one device_put / device_get
+    # covering a whole dispatch window): *_events counts uploads/fetches,
+    # *_frames the frames they carried. Per-frame h2d_events/d2h_events
+    # deliberately do NOT move for these — d2h_per_frame / h2d_per_frame
+    # measure per-frame round trips, which window batching exists to
+    # drive to zero (the bytes still land in h2d_bytes/d2h_bytes).
+    "h2d_batched_events": 0.0, "h2d_batched_frames": 0.0,
+    "d2h_batched_events": 0.0, "d2h_batched_frames": 0.0,
     "resident_entries": 0.0, "materialized_entries": 0.0,
 }
 _xfer_metrics: Optional[Dict[str, Any]] = None
@@ -73,6 +81,14 @@ def _xfer_obs() -> Dict[str, Any]:
             "d2h": reg.counter(
                 "nns_transfer_d2h_bytes_total",
                 "Bytes explicitly materialized device->host (to_host)"),
+            "h2d_batched": reg.counter(
+                "nns_transfer_batched_h2d_total",
+                "Staged multi-frame slab uploads: one device_put "
+                "carrying a whole dispatch window (upload_many)"),
+            "d2h_batched": reg.counter(
+                "nns_transfer_batched_d2h_total",
+                "Grouped drain-side fetches: one device_get carrying a "
+                "whole materialization run (materialize_many)"),
         }
         reg.gauge(
             "nns_buffer_resident_ratio",
@@ -98,6 +114,34 @@ def _record_d2h(nbytes: int) -> None:
     with _xfer_lock:
         _xfer["d2h_bytes"] += nbytes
         _xfer["d2h_events"] += 1
+
+
+def _record_h2d_batched(frames: int, nbytes: int) -> None:
+    """One staged multi-frame slab upload: bytes land in the cumulative
+    h2d byte tally, but the per-frame event counter does not move — the
+    whole point of the window slab is that these frames paid no
+    per-frame round trip."""
+    if nbytes <= 0:
+        return
+    obs = _xfer_obs()
+    obs["h2d"].inc(nbytes)
+    obs["h2d_batched"].inc()
+    with _xfer_lock:
+        _xfer["h2d_bytes"] += nbytes
+        _xfer["h2d_batched_events"] += 1
+        _xfer["h2d_batched_frames"] += frames
+
+
+def _record_d2h_batched(frames: int, nbytes: int) -> None:
+    if nbytes <= 0:
+        return
+    obs = _xfer_obs()
+    obs["d2h"].inc(nbytes)
+    obs["d2h_batched"].inc()
+    with _xfer_lock:
+        _xfer["d2h_bytes"] += nbytes
+        _xfer["d2h_batched_events"] += 1
+        _xfer["d2h_batched_frames"] += frames
 
 
 def _tl_xfer_span(kind: str, meta: Dict[str, Any], t0: float,
@@ -462,3 +506,124 @@ def as_device_buffer(buf: TensorBuffer, host_view=None) -> TensorBuffer:
     return DeviceBuffer(tensors=buf.tensors, pts=buf.pts, dts=buf.dts,
                         duration=buf.duration, meta=buf.meta,
                         finalize=buf.finalize, host_view=host_view)
+
+
+# -- staged multi-frame window transfers --------------------------------------
+#: meta key marking a buffer whose device payload was freshly created by
+#: an upload point for exactly one downstream consumer — the whole-graph
+#: fused region may DONATE such tensors to XLA (pipeline/fuse.py); shared
+#: or source-owned payloads never carry it
+H2D_EXCLUSIVE_META = "h2d_exclusive"
+
+
+def upload_many(bufs: List[TensorBuffer]) -> (
+        "tuple[List[TensorBuffer], List[np.ndarray]]"):
+    """Coalesce one dispatch window's H2D copies into a single staged
+    multi-frame slab upload (FaaSTube-style transfer batching).
+
+    For each tensor index the window's frames are assembled into ONE
+    contiguous ``(k,) + shape`` host view — zero-copy when the frames are
+    already consecutive window-slab slots (``pool.contiguous_window_view``,
+    the ingest-lane staging layout), else copied into a fresh pool window
+    slab — and cross the link as ONE ``jax.device_put``. Per-frame device
+    views are carved device-side (a lazy slice per slot, no extra
+    transfers). Returns ``(device_buffers, window_slabs)``: the caller
+    stamps the slabs into the LAST buffer's pool stash so the dispatch
+    window's fence (``pipeline/dispatch.py``) recycles them only after
+    every dispatch that read the upload has completed.
+
+    Callers must pass ≥1 host-resident buffers with identical tensor
+    signatures; ordering and per-buffer meta/finalize are preserved, so
+    results are byte-identical to per-buffer ``to_device()``.
+    """
+    import jax
+
+    from nnstreamer_tpu.tensors.pool import (
+        contiguous_window_view,
+        get_pool,
+    )
+
+    k = len(bufs)
+    n_t = len(bufs[0].tensors)
+    pool = get_pool()
+    t0 = time.monotonic()
+    _fault_check("transfer.h2d", bufs[0].meta)
+    slabs: List[np.ndarray] = []
+    stacked_per_tensor: List[np.ndarray] = []
+    moved = 0
+    for j in range(n_t):
+        frames = [b.tensors[j] for b in bufs]
+        stacked = contiguous_window_view(frames) if k > 1 else None
+        if stacked is None:
+            stacked = pool.acquire_window(k, frames[0].shape,
+                                          frames[0].dtype)
+            for i, f in enumerate(frames):
+                np.copyto(stacked[i], f)
+            slabs.append(stacked)
+        moved += stacked.nbytes
+        stacked_per_tensor.append(stacked)
+    devs = [jax.device_put(s) for s in stacked_per_tensor]
+    _record_h2d_batched(k, moved)
+    _tl_xfer_span("h2d_batched", bufs[0].meta, t0, nbytes=moved)
+    out: List[TensorBuffer] = []
+    for i, b in enumerate(bufs):
+        dev_tensors = [devs[j][i] for j in range(n_t)]
+        nb = b.with_tensors(dev_tensors)
+        nb.meta[H2D_EXCLUSIVE_META] = True
+        # the pre-upload host arrays become the wrapper's zero-copy host
+        # view, exactly like the per-buffer prefetch path
+        out.append(as_device_buffer(nb, host_view=list(b.tensors)))
+    return out, slabs
+
+
+def materialize_many(bufs: List[TensorBuffer]) -> List[TensorBuffer]:
+    """Drain-side grouped materialization: every device tensor across the
+    run crosses D2H in ONE ``jax.device_get`` instead of one blocking
+    fetch per frame. Results are byte-identical to calling ``to_host()``
+    per buffer — per-buffer ``finalize`` hooks run in order on the host
+    payloads, DeviceBuffer host caches are honored and filled — but the
+    transfer tally records one *batched* fetch (``d2h_batched_events``)
+    and zero per-frame round trips, which is what ``d2h_per_frame = 0``
+    on a device-decodable pipeline means."""
+    import jax
+
+    fetch: List[Any] = []
+    where: Dict[Any, int] = {}
+    direct: List[bool] = []
+    for i, b in enumerate(bufs):
+        if isinstance(b, DeviceBuffer) and (
+                b._host_cache is not None or b._host_src is not None):
+            direct.append(True)  # zero-copy/cached: to_host() is free
+            continue
+        direct.append(False)
+        for j, t in enumerate(b.tensors):
+            if not isinstance(t, np.ndarray):
+                where[(i, j)] = len(fetch)
+                fetch.append(t)
+    if fetch:
+        t0 = time.monotonic()
+        moved = sum(_device_nbytes(t) for t in fetch)
+        _fault_check("transfer.d2h", bufs[0].meta)
+        # the one sanctioned *batched* D2H: a single grouped fetch for
+        # the whole run  # nns-lint: disable=NNS108 -- batched twin of to_host
+        fetched = jax.device_get(fetch)
+        _record_d2h_batched(len(bufs), moved)
+        _tl_xfer_span("d2h_batched", bufs[0].meta, t0, nbytes=moved)
+    out: List[TensorBuffer] = []
+    for i, b in enumerate(bufs):
+        if direct[i] or not any((i, j) in where
+                                for j in range(len(b.tensors))):
+            out.append(b.to_host())  # cached view or already-host payload
+            continue
+        host = [t if isinstance(t, np.ndarray)
+                else np.asarray(fetched[where[(i, j)]])
+                for j, t in enumerate(b.tensors)]
+        hb = TensorBuffer(tensors=host, pts=b.pts, dts=b.dts,
+                          duration=b.duration, meta=dict(b.meta),
+                          finalize=None)
+        if b.finalize is not None:
+            hb = b.finalize(hb)
+        if isinstance(b, DeviceBuffer):
+            b._host_cache = hb  # later to_host() callers share this
+        out.append(hb)
+    return out

@@ -27,9 +27,18 @@ bench measures. This module is the tensor_allocator analog:
   than handed to the next acquire.
 
 Instrumented with ``nns_pool_hits_total`` / ``nns_pool_misses_total`` /
-``nns_pool_grows_total`` counters and an ``nns_pool_outstanding`` gauge in
-``obs/``. Disable with ``NNSTPU_POOL=0`` (acquire degrades to plain
-``np.empty``).
+``nns_pool_grows_total`` counters and ``nns_pool_outstanding`` /
+``nns_pool_bytes_held`` gauges in ``obs/``. Disable with ``NNSTPU_POOL=0``
+(acquire degrades to plain ``np.empty``).
+
+**Window slabs.** The transfer-batching layer (``tensors/buffer.py``
+``upload_many``) stages one dispatch window's frames in ONE contiguous
+slab — ``acquire_window`` carves per-frame slot views out of a single
+pool allocation so the whole window crosses H2D as one ``device_put``.
+``contiguous_window_view`` is the zero-copy fast path: frames that were
+already written into consecutive slots of one slab (ingest-lane window
+staging, ``pipeline/lanes.py``) are re-wrapped as the stacked upload view
+with no host copy at all.
 """
 
 from __future__ import annotations
@@ -106,6 +115,13 @@ class BufferPool:
                 "nns_pool_outstanding",
                 "Pool-owned buffers currently held by the pipeline",
                 fn=lambda: (len(ref()._out) if ref() is not None else 0),
+                **labels)
+            reg.gauge(
+                "nns_pool_bytes_held",
+                "Bytes the pool currently holds (free slabs + slabs "
+                "backing outstanding views) — the footprint number "
+                "previously only inferable from the miss/grow counters",
+                fn=lambda: (ref().bytes_held() if ref() is not None else 0),
                 **labels)
         return self._metrics
 
@@ -234,6 +250,28 @@ class BufferPool:
     def release_many(self, arrs) -> int:
         return sum(1 for a in (arrs or ()) if self.release(a))
 
+    # -- window staging -----------------------------------------------------
+    def acquire_window(self, frames: int, shape, dtype) -> np.ndarray:
+        """One contiguous ``(frames,) + shape`` staging view backed by a
+        SINGLE pool slab: the host side of a batched multi-frame H2D
+        upload (``tensors/buffer.py`` ``upload_many``). Slot ``i`` is
+        plain ``view[i]`` — numpy collapses the slot's ``.base`` to the
+        underlying slab, so the refcount guard in :meth:`release` keeps
+        the slab out of circulation while any slot view is still read
+        (a DeviceBuffer host view, a late finalize)."""
+        return self.acquire((int(frames),) + tuple(shape), dtype)
+
+    def bytes_held(self) -> int:
+        """Current pool footprint in bytes: free slabs plus the slabs
+        backing outstanding views (each slab is its size class + the
+        alignment slack it was allocated with)."""
+        with self._lock:
+            free_b = sum(cls * len(v) + self.align * len(v)
+                         for cls, v in self._free.items())
+            out_b = sum(cls + self.align for cls, _s, _f in
+                        self._out.values())
+        return int(free_b + out_b)
+
     # -- introspection ------------------------------------------------------
     def hit_rate(self) -> Optional[float]:
         total = self.hits + self.misses
@@ -251,9 +289,62 @@ class BufferPool:
                 "hit_rate": None if rate is None else round(rate, 4)}
 
     def clear(self) -> None:
-        """Drop all free slabs (outstanding views are untouched)."""
+        """Free whole size-classes: drop every free slab so the pool's
+        held footprint returns to its outstanding working set
+        (outstanding views are untouched — their slabs recycle or drop
+        through the usual release/GC paths). ``Pipeline.stop()`` calls
+        this so a stopped pipeline's staging arenas don't pin peak-rate
+        slab bytes for the life of the process."""
         with self._lock:
             self._free.clear()
+
+
+def release_all_pools() -> None:
+    """Free the free-lists of every process-wide pool arena (the shared
+    ingest pool plus each per-lane arena) — the ``Pipeline.stop()``
+    footprint hook behind the ``nns_pool_bytes_held`` gauge."""
+    if _default is not None:
+        _default.clear()
+    with _lane_pools_lock:
+        pools = list(_lane_pools.values())
+    for p in pools:
+        p.clear()
+
+
+def contiguous_window_view(arrays) -> Optional[np.ndarray]:
+    """Zero-copy host side of a batched upload: if ``arrays`` are
+    equally-shaped C-contiguous views laid out back-to-back in ONE pool
+    slab (consecutive window-slab slots written by the ingest lanes or a
+    prior :meth:`BufferPool.acquire_window`), return the single
+    ``(k,) + shape`` view spanning them; else None (the caller copies
+    into a fresh window slab). The returned view's ``.base`` is the slab
+    itself, so it participates in the pool's refcount guard like any
+    derived view."""
+    k = len(arrays)
+    if k < 2:
+        return None
+    first = arrays[0]
+    base = getattr(first, "base", None)
+    if base is None or not isinstance(first, np.ndarray):
+        return None
+    # fast path only for the pool's own slab layout: 1-D uint8 backing
+    if not (isinstance(base, np.ndarray) and base.ndim == 1
+            and base.dtype == np.uint8 and base.flags["C_CONTIGUOUS"]):
+        return None
+    shape, dtype, step = first.shape, first.dtype, first.nbytes
+    if step == 0 or not first.flags["C_CONTIGUOUS"]:
+        return None
+    addr0 = first.ctypes.data
+    for i, a in enumerate(arrays):
+        if (not isinstance(a, np.ndarray) or a.base is not base
+                or a.shape != shape or a.dtype != dtype
+                or not a.flags["C_CONTIGUOUS"]
+                or a.ctypes.data != addr0 + i * step):
+            return None
+    off = addr0 - base.ctypes.data
+    if off < 0 or off + k * step > base.nbytes:
+        return None
+    return base[off:off + k * step].view(dtype).reshape((k,) + shape)
 
 
 _default: Optional[BufferPool] = None

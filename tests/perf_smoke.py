@@ -15,6 +15,9 @@ CPU pipeline (the EdgeTPU `device_type:dummy` pattern). Gates:
 - the device-resident tensor plane keeps the smoke pipeline's D2H
   traffic at its floor: at most one materialization per sink-delivered
   frame (``d2h_per_frame`` ≤ number of sinks);
+- the whole-graph steady state batches transfers: staged multi-frame
+  slab uploads (``nns_transfer_batched_h2d_total``), grouped result
+  fetches, and ZERO per-frame D2H events on the golden pipeline;
 - parallel ingest lanes (`--lanes`, pipeline/lanes.py) are correct AND
   profitable: ``lanes=2`` reproduces the serial run byte-for-byte in the
   same order while exporting the ``nns_lane_*`` series, and on a
@@ -126,10 +129,14 @@ def test_metrics_endpoint_exports_overlap_series():
                    "nns_filter_fence_wait_seconds",
                    "nns_pool_hits_total",
                    "nns_pool_misses_total",
+                   "nns_pool_bytes_held",
                    "nns_queue_drain_size",
                    "nns_fuse_retraces_total",
+                   "nns_fuse_whole_graph",
                    "nns_transfer_h2d_bytes_total",
                    "nns_transfer_d2h_bytes_total",
+                   "nns_transfer_batched_h2d_total",
+                   "nns_transfer_batched_d2h_total",
                    "nns_buffer_resident_ratio"):
         assert series in body, f"{series} missing from /metrics"
 
@@ -148,6 +155,74 @@ def test_d2h_per_frame_at_floor():
     assert d2h_per_frame <= 1.0, d2h_per_frame
     # and the run actually exercised the resident path
     assert after["resident_entries"] > before["resident_entries"]
+
+
+def test_whole_graph_batched_transfers_and_zero_d2h():
+    """The transfer-batching gate (CI `perf-smoke` whole-graph step).
+
+    On the golden device-decodable smoke pipeline the steady state must
+    be: per-frame H2D copies coalesced into staged multi-frame slab
+    uploads (one ``device_put`` per drained window), sink-bound results
+    carried by ONE grouped ``device_get`` per drained run, and — the
+    headline number — ZERO per-frame D2H events
+    (``d2h_per_frame == 0``; the bench reports the same field).
+    Deterministic counter deltas, no timing involved, so no median/MAD
+    gating is needed here — raw-value perf comparisons (lanes scaling,
+    bench fps) are the ones that gate on the median."""
+    before = transfer_snapshot()
+    _pipe, outs = _run(inflight=2)
+    after = transfer_snapshot()
+    assert len(outs) == 3
+    # staged multi-frame H2D engaged: the first window's XLA compile
+    # backs up the upload queue, so the next drain gathers >= 2 windows
+    # and coalesces them into one slab upload
+    assert after["h2d_batched_events"] > before["h2d_batched_events"]
+    assert after["h2d_batched_frames"] - before["h2d_batched_frames"] >= 2
+    # the materialize-host queue fetched results as grouped D2H runs
+    assert after["d2h_batched_events"] > before["d2h_batched_events"]
+    # the gate itself: not one per-frame D2H round trip in the whole run
+    assert after["d2h_events"] == before["d2h_events"]
+
+
+def test_retrace_counter_keys_on_batch_shape():
+    """A second input batch shape (the aggregator's unpadded flush tail
+    vs the full window) is a real XLA compile and must be counted as
+    exactly ONE re-trace — and alternating between the two shapes
+    afterwards must add none (the region reuses one jit object whose
+    per-shape executable cache absorbs both; a silent per-frame retrace
+    here was the failure mode this counter exists to expose)."""
+    _register_model()
+    pipe = parse_launch(
+        "appsrc name=src ! "
+        "tensor_transform mode=arithmetic option=typecast:float32,div:255.0 ! "
+        "tensor_filter framework=jax model=perf_smoke_sum name=filter ! "
+        "tensor_sink name=sink to-host=true")
+    src, sink = pipe.get("src"), pipe.get("sink")
+    pipe.start()
+    try:
+        assert pipe._regions, "transform+filter run did not fuse"
+        full = np.arange(8 * 16 * 16 * 3, dtype=np.uint8).reshape(8, 16, 16, 3)
+        tail = full[:4].copy()
+        r0 = _retraces_total()
+        src.push([full.copy()])
+        sink.wait(1)
+        r1 = _retraces_total()
+        assert r1 - r0 == 1, "first shape: exactly one compile"
+        src.push([tail.copy()])
+        sink.wait(2)
+        r2 = _retraces_total()
+        assert r2 - r1 == 1, "tail batch shape: exactly one more compile"
+        for _ in range(3):
+            src.push([full.copy()])
+            src.push([tail.copy()])
+        src.end_of_stream()
+        msg = pipe.wait(timeout=60)
+        assert msg is not None and msg.kind == "eos", msg
+        r3 = _retraces_total()
+        assert r3 - r2 == 0, "alternating known shapes must not retrace"
+        assert len(sink.buffers) == 8
+    finally:
+        pipe.stop()
 
 
 def test_lanes_byte_identical_and_series_exported():
