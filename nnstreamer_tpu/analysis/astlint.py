@@ -53,6 +53,16 @@ These rules encode invariants this codebase has already been burned by
   and the bus ``wait()`` contract rely on — a handler that only logs
   (or does nothing) converts a dead frame into a silent hang, because
   downstream never sees an error message and EOS never arrives.
+- NNS112: socket/channel IO without an explicit timeout inside a
+  transport hot path (connect, framed send/recv, result routing,
+  broker publish — see ``_TRANSPORT_HOT_FUNCS``): the resilience layer
+  (``query/resilience.py``) can only retry, hedge, or trip a breaker
+  when the underlying call BOUNDS its wait — an untimed ``connect()``
+  or ``recv()`` turns a dead peer into an indefinite hang that no
+  deadline or supervisor ever sees. A call is fine when the enclosing
+  function passes ``timeout=`` at the call, calls ``settimeout(...)``
+  on the socket, or sets ``SO_SNDTIMEO``/``SO_RCVTIMEO`` (the
+  send-side discipline used by ``query/mqtt.py``).
 
 Findings are suppressed per-line with::
 
@@ -116,6 +126,15 @@ _WORKER_FUNCS = {"chain", "chain_list", "run_loop", "_worker",
 #: bus-posting method names that count as surfacing the failure
 _BUS_POST_ATTRS = {"post_error", "post_message", "post_warning"}
 
+#: transport hot-path function names (NNS112): connection setup, framed
+#: send/recv, result routing and broker publish — the paths where an
+#: untimed socket wait hangs forever instead of feeding the resilience
+#: layer's retry/hedge/breaker machinery
+_TRANSPORT_HOT_FUNCS = {"connect", "_connect_one", "send_msg", "recv_msg",
+                        "_send_buf", "_recv_result", "_r_recv", "_r_hello",
+                        "send_result", "send_expired", "send_stream",
+                        "recv_stream", "publish", "_recover"}
+
 #: direct-materialization callables (NNS108): fetch device bytes while
 #: bypassing the cached, counted to_host() path
 _MATERIALIZE_CALLS = {"np.asarray", "numpy.asarray", "jax.device_get"}
@@ -161,6 +180,10 @@ class _FileLinter(ast.NodeVisitor):
         self.diags: List[Diagnostic] = []
         self._lock_depth = 0
         self._func_stack: List[str] = []
+        #: the actual FunctionDef nodes of the stack (NNS112 walks the
+        #: enclosing function body for timeout discipline)
+        self._func_nodes: List[ast.AST] = []
+        self._timeout_discipline: Dict[int, bool] = {}  # id(fnode) → bool
         self._wall_lines: Set[int] = set()
         self._collect_wall_bindings(tree)
 
@@ -204,7 +227,9 @@ class _FileLinter(ast.NodeVisitor):
 
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
         self._func_stack.append(node.name)
+        self._func_nodes.append(node)
         self.generic_visit(node)
+        self._func_nodes.pop()
         self._func_stack.pop()
 
     visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
@@ -220,6 +245,7 @@ class _FileLinter(ast.NodeVisitor):
         self._rule_nns107(node, dotted)
         self._rule_nns108(node, dotted)
         self._rule_nns110(node, dotted)
+        self._rule_nns112(node, dotted)
         self.generic_visit(node)
 
     def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
@@ -445,6 +471,64 @@ class _FileLinter(ast.NodeVisitor):
         if node.func.attr == "wait_for":
             return len(node.args) > 1
         return bool(node.args)
+
+    def _rule_nns112(self, node: ast.Call, dotted: str) -> None:
+        if not any(f in _TRANSPORT_HOT_FUNCS for f in self._func_stack):
+            return
+        what: Optional[str] = None
+        if dotted.endswith("create_connection") and \
+                not any(kw.arg == "timeout" for kw in node.keywords) and \
+                len(node.args) < 2:
+            # create_connection(addr[, timeout]) — positional 2nd arg IS
+            # the timeout, so only the one-arg untimed form is a finding
+            what = "create_connection() without a timeout"
+        elif isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _SOCKET_BLOCKING and \
+                not any(kw.arg == "timeout" for kw in node.keywords) and \
+                not self._enclosing_has_timeout_discipline():
+            what = f"socket .{node.func.attr}() with no timeout " \
+                   f"discipline in scope"
+        if what is None:
+            return
+        self.emit(
+            "NNS112", node,
+            f"{what} in a transport hot path — a dead peer becomes an "
+            f"indefinite hang the retry/hedge/breaker machinery never "
+            f"observes",
+            hint="pass timeout=, call settimeout(...) in this function, "
+                 "set SO_SNDTIMEO/SO_RCVTIMEO, or justify with a pragma")
+
+    def _enclosing_has_timeout_discipline(self) -> bool:
+        """True when the innermost enclosing function visibly bounds its
+        socket IO: a ``settimeout(<non-None constant>)`` / ``settimeout(
+        <expr>)`` call, or a ``setsockopt`` naming SO_SNDTIMEO /
+        SO_RCVTIMEO. Cached per function node — transport hot paths get
+        visited once per call expression."""
+        if not self._func_nodes:
+            return False
+        fnode = self._func_nodes[-1]
+        cached = self._timeout_discipline.get(id(fnode))
+        if cached is not None:
+            return cached
+        found = False
+        for sub in ast.walk(fnode):
+            if not isinstance(sub, ast.Call):
+                continue
+            if isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr == "settimeout" and sub.args and \
+                    not (isinstance(sub.args[0], ast.Constant)
+                         and sub.args[0].value is None):
+                found = True
+                break
+            if isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr == "setsockopt":
+                names = {_dotted(a) for a in sub.args}
+                if any(n.endswith(("SO_SNDTIMEO", "SO_RCVTIMEO"))
+                       for n in names):
+                    found = True
+                    break
+        self._timeout_discipline[id(fnode)] = found
+        return found
 
     def _rule_nns109(self, node: ast.ClassDef) -> None:
         declares = False

@@ -66,6 +66,9 @@ CODE_TABLE: Dict[str, str] = {
     "NNS111": "broad except in an element chain/worker loop that "
               "neither re-raises nor posts to the pipeline bus (a dead "
               "frame becomes a silent hang)",
+    "NNS112": "socket/channel send-recv in a transport hot path without "
+              "an explicit timeout (a dead peer hangs the path instead "
+              "of feeding the retry/hedge/breaker machinery)",
     "NNS199": "nns-lint pragma without a justification",
 }
 

@@ -12,9 +12,14 @@ to its client by client-id meta). Client failover walks a server list
 
 from __future__ import annotations
 
+import socket
 import threading
+import time
+import uuid
 from typing import List, Optional, Tuple
 
+from nnstreamer_tpu.obs import timeline as _timeline
+from nnstreamer_tpu.pipeline import faults as _faults
 from nnstreamer_tpu.pipeline.element import (
     CapsEvent,
     Element,
@@ -23,6 +28,7 @@ from nnstreamer_tpu.pipeline.element import (
 )
 from nnstreamer_tpu.pipeline.pipeline import SourceElement
 from nnstreamer_tpu.query import protocol as P
+from nnstreamer_tpu.query import resilience as _res
 from nnstreamer_tpu.query.server import QueryServer
 from nnstreamer_tpu.registry import ELEMENT, subplugin
 from nnstreamer_tpu.tensors.types import TensorFormat, TensorsConfig
@@ -65,6 +71,34 @@ class TensorQueryClient(Element):
         # refwire result connection (reference server-sink port);
         # 0 → src port + 1 (the reference's usual pairing)
         "sink_port": 0,
+        # -- resilient transport (query/resilience.py) -------------------
+        # All off by default: with none of these set the classic wire
+        # (commands 1-8) and the classic frame-drop semantics above are
+        # byte-identical to pre-resilience builds.
+        # reliable=true switches to the extended protocol: per-request
+        # ids + a server dedup window make reconnect resends idempotent,
+        # so in-flight frames (any max-in-flight) are resent in order
+        # after a connection error instead of dropped. Requires a
+        # serversrc started with reliable=true (nnstpu wire only).
+        "reliable": False,
+        # forward the frame's remaining SLO slack (meta deadline_t, as
+        # stamped by a local slo-budget queue) in the TRANSFER_EX header
+        # so the REMOTE scheduler sheds work that can no longer make its
+        # budget; shed/late frames come back as EXPIRED, not results
+        "propagate_deadline": False,
+        # per-endpoint circuit breaker: open after N consecutive connect
+        # failures, re-probe (half-open) after breaker-reset-ms
+        "breaker_failures": 5,
+        "breaker_reset_ms": 1000.0,
+        # >0 arms hedged failover: when no result lands within
+        # max(hedge-ms, p99 * 1.5) the client fails over to the next
+        # replica and resends (the dedup window absorbs duplicates)
+        "hedge_ms": 0.0,
+        # reconnect backoff base (bounded exponential, jittered)
+        "reconnect_backoff_ms": 50.0,
+        # read-only counter: frames the REMOTE end expired (deadline
+        # propagation) — intentional sheds, not losses
+        "frames_expired": 0,
     }
 
     def __init__(self, name=None, **props):
@@ -79,18 +113,27 @@ class TensorQueryClient(Element):
         self._lock = threading.Lock()
         #: (pts, meta) of requests sent but not yet answered (in order)
         self._pending: List[tuple] = []
+        # -- reliable-mode state (query/resilience.py) -------------------
+        #: stable identity across reconnects — the server's dedup window
+        #: and result routing key on this, not on the per-connection id
+        self._r_instance = uuid.uuid4().hex
+        self._r_next_id = 1  # monotone per-instance request id
+        self._r_pending: List[_res.PendingEntry] = []
+        self._r_breakers: dict = {}  # (host, port) → CircuitBreaker
+        self._r_stats = _res.EndpointStats()
+        self._r_endpoint: Optional[Tuple[str, int]] = None
 
     def set_property(self, key: str, value) -> None:
-        if key.replace("-", "_") == "frames_dropped":
-            raise ValueError("tensor_query_client: frames-dropped is "
-                             "read-only")
+        if key.replace("-", "_") in ("frames_dropped", "frames_expired"):
+            raise ValueError(f"tensor_query_client: {key} is read-only")
         super().set_property(key, value)
 
     def _drop_pending_locked(self) -> int:
         """Clear in-flight requests, bumping the frames-dropped counter."""
-        n = len(self._pending)
+        n = len(self._pending) + len(self._r_pending)
         if n:
             self._pending.clear()
+            self._r_pending.clear()
             self._props["frames_dropped"] = \
                 int(self._props.get("frames_dropped", 0)) + n
         return n
@@ -211,13 +254,33 @@ class TensorQueryClient(Element):
         return None  # output caps come from the first result buffer
 
     def _send_buf(self, buf):
+        act = None
+        fi = _faults.ACTIVE
+        if fi is not None:
+            act = fi.action("query.send",
+                            seq=buf.meta.get(_timeline.TRACE_SEQ_META))
+            if act == "drop":
+                return  # the bytes vanish; recv timeout / retry recovers
+            if act == "disconnect":
+                self._kill_sock()
+                raise OSError("injected fault: query.send disconnect")
         if self._refclient is not None:
             from nnstreamer_tpu.query import refwire as R
 
+            if act == "corrupt":  # refwire has no framed payload to
+                # mangle in place — the nearest physical fault is a
+                # connection killed mid-send
+                self._kill_sock()
+                raise OSError("injected fault: query.send corrupt")
             self._refclient.send(R.buffer_to_mems(buf.to_host()),
                                  pts=buf.pts)
         else:
-            P.send_buffer(self._sock, buf)
+            payload = P.pack_buffer(buf)
+            if act == "corrupt":
+                # truncation is guaranteed-detectable: the server's
+                # unpack runs out of bytes and kicks this connection
+                payload = payload[:max(1, len(payload) // 2)]
+            P.send_msg(self._sock, P.Cmd.TRANSFER, payload)
 
     def _disconnect_locked(self):
         if self._refclient is not None:
@@ -225,7 +288,35 @@ class TensorQueryClient(Element):
             self._refclient = None
         self._sock = None
 
+    def _kill_sock(self):
+        """Close and forget the current connection (both wires)."""
+        sock = self._sock
+        self._sock = None
+        if self._refclient is not None:
+            try:
+                self._refclient.close()
+            except OSError:
+                pass
+            self._refclient = None
+        elif sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
     def _recv_result(self):
+        fi = _faults.ACTIVE
+        if fi is not None:
+            act = fi.action("query.recv")
+            if act == "disconnect":
+                self._kill_sock()
+                raise OSError("injected fault: query.recv disconnect")
+            if act is not None:
+                # drop/corrupt of an in-order result poisons the
+                # response stream — surface as a protocol error so the
+                # caller's reconnect logic takes over
+                raise P.QueryProtocolError(
+                    f"injected fault: query.recv {act}")
         if self._refclient is not None:
             from nnstreamer_tpu.query import refwire as R
             from nnstreamer_tpu.tensors.buffer import TensorBuffer
@@ -251,7 +342,283 @@ class TensorQueryClient(Element):
             )
         return self.srcpad.push(result)
 
+    # -- reliable transport (query/resilience.py) ---------------------------
+    def _r_breaker(self, host: str, port: int) -> _res.CircuitBreaker:
+        key = (host, port)
+        br = self._r_breakers.get(key)
+        if br is None:
+            br = self._r_breakers[key] = _res.CircuitBreaker(
+                failures=int(self.get_property("breaker_failures") or 5),
+                reset_s=float(self.get_property("breaker_reset_ms")
+                              or 1000.0) / 1e3,
+                endpoint=f"{host}:{port}")
+        return br
+
+    def _r_make_entry(self, buf) -> _res.PendingEntry:
+        deadline_t = None
+        if self.get_property("propagate_deadline"):
+            d = buf.meta.get("deadline_t")
+            if d is not None:
+                deadline_t = float(d)
+        req_id = self._r_next_id
+        self._r_next_id += 1
+        return _res.PendingEntry(req_id, buf.pts, dict(buf.meta),
+                                 P.pack_buffer(buf), deadline_t=deadline_t)
+
+    def _r_send_entry(self, entry: _res.PendingEntry) -> None:
+        """Send (or resend) one entry as TRANSFER_EX. The slack is
+        recomputed from the entry's deadline at every send, so a resend
+        carries the budget that is actually left."""
+        now = time.monotonic()
+        payload = P.pack_ext(entry.req_id, entry.slack_s(now), entry.body)
+        fi = _faults.ACTIVE
+        if fi is not None:
+            act = fi.action("query.send",
+                            seq=entry.meta.get(_timeline.TRACE_SEQ_META))
+            if act == "drop":
+                entry.sent_t = now
+                return  # swallowed; the recv timeout path resends it
+            if act == "disconnect":
+                self._kill_sock()
+                raise OSError("injected fault: query.send disconnect")
+            if act == "corrupt":
+                # guaranteed-detectable: the server's unpack runs out of
+                # bytes, forgets the dedup entry, and kicks us — the
+                # resend after reconnect re-invokes exactly once
+                payload = payload[:max(1, len(payload) // 2)]
+        P.send_msg(self._sock, P.Cmd.TRANSFER_EX, payload)
+        entry.sent_t = now
+
+    def _r_hello(self) -> None:
+        window = max(1, int(self.get_property("max_in_flight")))
+        P.send_msg(self._sock, P.Cmd.HELLO,
+                   f"{self._r_instance}:{max(64, window * 8)}".encode())
+        try:
+            cmd, _payload = P.recv_msg(self._sock)
+        except socket.timeout:
+            raise P.QueryProtocolError(
+                "server did not acknowledge HELLO — reliable mode needs "
+                "a tensor_query_serversrc started with reliable=true"
+            ) from None
+        if cmd is not P.Cmd.HELLO:
+            raise P.QueryProtocolError(
+                f"bad HELLO reply {cmd} — reliable mode needs a "
+                f"tensor_query_serversrc started with reliable=true")
+
+    def _r_resend_pending(self) -> None:
+        """Resend the undelivered suffix in order after a reconnect.
+        Everything still pending is resent — the server's dedup window
+        replays results for frames that DID land, so over-resending is
+        safe and under-resending (the real loss bug) is impossible."""
+        if not self._r_pending:
+            return
+        m = _res.metrics()
+        tl = _timeline.ACTIVE
+        for entry in self._r_pending:
+            self._r_send_entry(entry)
+            m["retries"].inc()
+            if tl is not None:
+                tl.mark("net_retry",
+                        entry.meta.get(_timeline.TRACE_SEQ_META),
+                        track="net", req_id=entry.req_id)
+        self.log.info("resent %d in-flight frame(s) after reconnect",
+                      len(self._r_pending))
+
+    def _r_ensure_connected(self) -> None:
+        """Reconnect with per-endpoint circuit breaking and bounded
+        jittered backoff, then handshake (classic + HELLO) and resend
+        the undelivered suffix."""
+        if self._sock is not None:
+            return
+        servers = self._server_list()
+        policy = _res.RetryPolicy(
+            base_ms=float(self.get_property("reconnect_backoff_ms")
+                          or 50.0),
+            key=self.name)
+        last_err: Optional[Exception] = None
+        attempts = max(1, int(self.get_property("max_retry"))) * \
+            len(servers)
+        for attempt in range(1, attempts + 1):
+            host, port = servers[self._server_idx % len(servers)]
+            breaker = self._r_breaker(host, port)
+            if not breaker.allow():
+                if last_err is None:
+                    last_err = P.QueryProtocolError(
+                        f"breaker open for {host}:{port}")
+                self._server_idx += 1
+                policy.sleep(attempt)
+                continue
+            try:
+                self._connect_one(host, port)
+                self._r_hello()
+                self._r_resend_pending()
+            except (OSError, P.QueryProtocolError) as e:
+                last_err = e
+                breaker.record_failure()
+                self._kill_sock()
+                self._server_idx += 1
+                self.log.warning("connect to %s:%d failed (%s); "
+                                 "backing off", host, port, e)
+                policy.sleep(attempt)
+                continue
+            breaker.record_success()
+            self._r_endpoint = (host, port)
+            return
+        raise P.QueryProtocolError(
+            f"all query servers unreachable: {last_err}")
+
+    def _r_conn_failure(self, err: Exception) -> None:
+        if self._r_endpoint is not None:
+            self._r_breaker(*self._r_endpoint).record_failure()
+        self._kill_sock()
+        self.log.warning("reliable transport error: %s; will reconnect",
+                         err)
+
+    def _r_transmit(self, entry: _res.PendingEntry) -> None:
+        """Send a new entry, reconnecting through failures. Once the
+        entry is in ``_r_pending`` the resend-on-reconnect discipline
+        owns it; this loop only has to get the FIRST copy out."""
+        failures = 0
+        while True:
+            self._r_ensure_connected()
+            try:
+                self._r_send_entry(entry)
+                self._r_pending.append(entry)
+                return
+            except (OSError, P.QueryProtocolError) as e:
+                failures += 1
+                self._r_conn_failure(e)
+                if failures > max(1, int(self.get_property("max_retry"))):
+                    raise
+
+    def _r_recv(self, timeout: float):
+        fi = _faults.ACTIVE
+        if fi is not None:
+            act = fi.action("query.recv")
+            if act == "disconnect":
+                self._kill_sock()
+                raise OSError("injected fault: query.recv disconnect")
+            if act is not None:
+                raise P.QueryProtocolError(
+                    f"injected fault: query.recv {act}")
+        self._sock.settimeout(max(0.001, timeout))
+        return P.recv_msg(self._sock)
+
+    def _r_pop_pending(self, req_id: int) -> Optional[_res.PendingEntry]:
+        for i, entry in enumerate(self._r_pending):
+            if entry.req_id == req_id:
+                return self._r_pending.pop(i)
+        return None
+
+    def _r_drain_locked(self, min_pending: int):
+        """Receive until fewer than ``min_pending`` entries remain in
+        flight (caller holds the lock). Returns ``(done, err)`` where
+        ``done`` is ``[(result, entry), ...]`` in arrival order; a recv
+        timeout hedges to the next replica (when armed) or reconnects,
+        and only after ``max_retry`` consecutive recoveries without
+        progress does ``err`` report the failure (with the still-pending
+        frames dropped and counted — the honest last resort)."""
+        done: List[tuple] = []
+        err: Optional[Exception] = None
+        failures = 0
+        limit = max(1, int(self.get_property("max_retry")))
+        timeout = float(self.get_property("timeout"))
+        hedge_ms = float(self.get_property("hedge_ms") or 0.0)
+        tl = _timeline.ACTIVE
+        while len(self._r_pending) >= min_pending:
+            hedging = hedge_ms > 0.0 and failures == 0
+            recv_t = min(timeout,
+                         self._r_stats.hedge_timeout(hedge_ms / 1e3)) \
+                if hedging else timeout
+            try:
+                self._r_ensure_connected()
+                cmd, payload = self._r_recv(recv_t)
+            except socket.timeout:
+                failures += 1
+                if failures > limit:
+                    err = TimeoutError(
+                        f"{self.name}: no result within {recv_t:.3f}s "
+                        f"after {failures - 1} recovery attempt(s)")
+                    break
+                if hedging:
+                    _res.metrics()["hedges"].inc()
+                    if tl is not None:
+                        tl.mark("net_hedge", None, track="net",
+                                endpoint=str(self._r_endpoint))
+                    self._server_idx += 1  # fail over to the next replica
+                    self.log.warning("hedge timer (%.3fs) fired; failing "
+                                     "over to the next replica", recv_t)
+                else:
+                    self.log.warning("recv timed out after %.3fs; "
+                                     "reconnecting", recv_t)
+                self._kill_sock()
+                continue
+            except (OSError, P.QueryProtocolError) as e:
+                failures += 1
+                self._r_conn_failure(e)
+                if failures > limit:
+                    err = e
+                    break
+                continue
+            if cmd is P.Cmd.RESULT_EX:
+                req_id, _slack, body = P.unpack_ext(payload)
+                entry = self._r_pop_pending(req_id)
+                if entry is None:
+                    continue  # dedup replay of an already-delivered result
+                if entry.sent_t:
+                    self._r_stats.observe(time.monotonic() - entry.sent_t)
+                done.append((P.unpack_buffer(body), entry))
+                failures = 0
+            elif cmd is P.Cmd.EXPIRED:
+                req_id, _slack, _body = P.unpack_ext(payload)
+                entry = self._r_pop_pending(req_id)
+                if entry is not None:
+                    self._props["frames_expired"] = \
+                        int(self._props.get("frames_expired", 0)) + 1
+                    if tl is not None:
+                        tl.mark("net_expired",
+                                entry.meta.get(_timeline.TRACE_SEQ_META),
+                                track="net", req_id=req_id)
+                    self.log.info("frame pts=%s expired remotely "
+                                  "(req %d)", entry.pts, req_id)
+                failures = 0
+            elif cmd is P.Cmd.PING:
+                continue
+            else:
+                failures += 1
+                self._r_conn_failure(P.QueryProtocolError(
+                    f"unexpected {cmd} in reliable mode"))
+                if failures > limit:
+                    err = P.QueryProtocolError(
+                        f"unexpected {cmd} in reliable mode")
+                    break
+        if err is not None:
+            n = self._drop_pending_locked()
+            if n:
+                self.log.warning("reliable transport exhausted (%s); "
+                                 "dropped %d frame(s)", err, n)
+        return done, err
+
+    def _chain_resilient(self, buf):
+        if self._refwire():
+            raise FlowError(
+                "tensor_query_client: reliable=true requires wire=nnstpu")
+        window = max(1, int(self.get_property("max_in_flight")))
+        with self._lock:
+            entry = self._r_make_entry(buf)
+            self._r_transmit(entry)
+            done, err = self._r_drain_locked(min_pending=window)
+        ret = FlowReturn.OK
+        for result, done_entry in done:
+            ret = self._push_result(result, done_entry.pts,
+                                    done_entry.meta)
+        if err is not None:
+            raise err  # after pushing the good results collected so far
+        return ret
+
     def chain(self, pad, buf):
+        if self.get_property("reliable"):
+            return self._chain_resilient(buf)
         window = max(1, int(self.get_property("max_in_flight")))
         if window == 1:
             # synchronous round trip with per-frame resend on reconnect
@@ -333,6 +700,14 @@ class TensorQueryClient(Element):
         sentinel travels paths (e.g. queue worker threads) that do not
         wrap handlers in try/except, so a raise here could kill a worker
         silently instead of failing the pipeline."""
+        if self.get_property("reliable"):
+            with self._lock:
+                done, err = self._r_drain_locked(min_pending=1)
+            for result, entry in done:
+                self._push_result(result, entry.pts, entry.meta)
+            if err is not None:
+                self.post_error(FlowError(f"{self.name}: {err}"))
+            return
         with self._lock:
             done, err = self._drain_locked(min_pending=1)
         for result, pts, meta in done:
@@ -368,6 +743,11 @@ class TensorQueryServerSrc(SourceElement):
         # reconstructs typed tensors from the raw mems and is announced
         # to clients in the APPROVE reply
         "caps": None,
+        # accept the resilient extension (HELLO/TRANSFER_EX): per-client
+        # dedup windows, deadline admission, EXPIRED notices. Forces the
+        # pure-Python transport (the native epoll core only speaks the
+        # classic commands); leave false for byte-identical classic wire
+        "reliable": False,
     }
 
     _SERVERS = {}
@@ -387,6 +767,7 @@ class TensorQueryServerSrc(SourceElement):
             caps_str=str(self.get_property("caps") or ""),
             wire=str(self.get_property("wire")),
             sink_port=int(self.get_property("sink_port") or 0),
+            resilient=bool(self.get_property("reliable")),
         ).start()
         with self._SERVERS_LOCK:
             self._SERVERS[int(self.get_property("id"))] = self.server

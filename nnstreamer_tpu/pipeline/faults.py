@@ -12,6 +12,15 @@ hooks at the five places the async substrate can actually fail:
 - ``queue.push``      — queue ingress (``pipeline/pipeline.py``)
 - ``dispatch.fence``  — dispatch-window fence (``pipeline/dispatch.py``)
 
+plus the transport sites, where the network itself is the failure
+domain (the resilience layer, ``query/resilience.py``, is what's under
+test there):
+
+- ``query.send``      — query-client frame send (``elements/query.py``)
+- ``query.recv``      — query-client result receive (``elements/query.py``)
+- ``grpc.call``       — TensorService stream call (``query/grpc_bridge.py``)
+- ``mqtt.publish``    — MQTT publish (``query/mqtt.py``)
+
 Spec grammar (``NNSTPU_FAULTS``)::
 
     site:key=val,key=val;site:key=val,...
@@ -24,7 +33,13 @@ Per-site keys:
 - ``kind``  — ``raise`` (ordinary exception, recoverable under an
   error-policy), ``crash`` (simulated abrupt worker death — lane
   supervision treats it as a restart, everything else like ``raise``),
-  or ``stall`` (sleep ``ms`` milliseconds — watchdog bait).
+  ``stall`` (sleep ``ms`` milliseconds — watchdog bait), or one of the
+  transport kinds ``drop`` (the bytes silently vanish), ``disconnect``
+  (the connection dies mid-operation), ``corrupt`` (the bytes arrive
+  mangled). Transport kinds are interpreted by :meth:`FaultInjector.
+  action` hooks; at a :meth:`FaultInjector.check` hook (the compute
+  sites) they degrade to ``raise`` — a drop has no meaning for a
+  backend invoke.
 - trigger — exactly one of ``rate=<float>`` (seeded Bernoulli per
   occurrence), ``nth=<int>`` (fire on exactly the nth occurrence,
   1-based), or ``every=<int>`` (every k·every-th occurrence).
@@ -68,9 +83,16 @@ _ENV_SEED = "NNSTPU_FAULTS_SEED"
 
 #: the injection-hook sites wired through the async substrate
 SITES: Tuple[str, ...] = ("filter.invoke", "transfer.h2d", "transfer.d2h",
-                          "lane.worker", "queue.push", "dispatch.fence")
+                          "lane.worker", "queue.push", "dispatch.fence",
+                          "query.send", "query.recv", "grpc.call",
+                          "mqtt.publish")
 
-KINDS: Tuple[str, ...] = ("raise", "crash", "stall")
+KINDS: Tuple[str, ...] = ("raise", "crash", "stall",
+                          "drop", "disconnect", "corrupt")
+
+#: kinds a transport hook interprets itself (returned by :meth:`action`)
+#: rather than having raised at it
+ACTION_KINDS: Tuple[str, ...] = ("drop", "disconnect", "corrupt")
 
 #: the process-wide injector; ``None`` (default) means injection is OFF
 #: and every hook site reduces to one attribute read + is-None test
@@ -225,18 +247,20 @@ class FaultInjector:
             return rng.random() < rule.rate
         return False
 
-    def check(self, site: str, seq: Optional[int] = None) -> None:
-        """The hook entry: count the occurrence, fire per the rule.
-        ``raise``/``crash`` raise; ``stall`` sleeps ``ms`` and returns.
-        ``seq`` is the frame-ledger id for the trace mark."""
+    def _fire(self, site: str, seq: Optional[int]
+              ) -> Optional[Tuple[int, FaultRule]]:
+        """Count the occurrence and decide; on fire, log/meter/mark and
+        return ``(n, rule)`` for the caller to act on. The decision for
+        occurrence ``n`` stays the same pure function of
+        ``(seed, site, n)`` regardless of which hook entry counted it."""
         rule = self._rules.get(site)
         if rule is None:
-            return
+            return None
         with self._lock:
             n = self._counts.get(site, 0) + 1
             self._counts[site] = n
         if not self._decide(rule, n):
-            return
+            return None
         with self._lock:
             self.fired.append((site, n, rule.kind))
         self._count_metric(site, rule.kind)
@@ -246,12 +270,45 @@ class FaultInjector:
                     fault_kind=rule.kind, n=n)
         log.info("fault injected: site=%s kind=%s occurrence=%d seq=%s",
                  site, rule.kind, n, seq)
+        return n, rule
+
+    def check(self, site: str, seq: Optional[int] = None) -> None:
+        """The compute-site hook entry: count the occurrence, fire per
+        the rule. ``raise``/``crash`` raise; ``stall`` sleeps ``ms`` and
+        returns; the transport kinds degrade to ``raise`` (a drop has no
+        meaning mid-invoke). ``seq`` is the frame-ledger id for the
+        trace mark."""
+        fired = self._fire(site, seq)
+        if fired is None:
+            return
+        n, rule = fired
         if rule.kind == "stall":
             time.sleep(rule.ms / 1e3)
             return
         if rule.kind == "crash":
             raise InjectedCrash(site, n)
-        raise InjectedFault(site, n)
+        raise InjectedFault(site, n, kind=rule.kind)
+
+    def action(self, site: str, seq: Optional[int] = None) -> Optional[str]:
+        """The transport-site hook entry: like :meth:`check`, but the
+        kinds a transport can act out itself come back as a verdict —
+        ``"drop"`` / ``"disconnect"`` / ``"corrupt"`` — for the hook to
+        interpret (swallow the send, kill the socket, mangle the bytes).
+        ``None`` means no fault fired; ``stall`` sleeps here and returns
+        ``None``; ``raise``/``crash`` raise exactly as at a check
+        site."""
+        fired = self._fire(site, seq)
+        if fired is None:
+            return None
+        n, rule = fired
+        if rule.kind == "stall":
+            time.sleep(rule.ms / 1e3)
+            return None
+        if rule.kind == "crash":
+            raise InjectedCrash(site, n)
+        if rule.kind == "raise":
+            raise InjectedFault(site, n)
+        return rule.kind
 
 
 # --------------------------------------------------------------------------

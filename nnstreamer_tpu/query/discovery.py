@@ -71,9 +71,17 @@ class ServerDiscovery:
     server list (reference tensor_query_hybrid_subscribe /
     _get_server_info)."""
 
-    def __init__(self, broker_host: str, broker_port: int, operation: str):
+    def __init__(self, broker_host: str, broker_port: int, operation: str,
+                 stale_s: Optional[float] = None):
+        #: entries whose advertised ``ts`` is older than this many
+        #: seconds are filtered out of ``wait_servers`` results — a
+        #: server that died without retracting leaves a retained ad
+        #: behind forever otherwise. ``None`` (default) keeps the
+        #: classic trust-the-broker behavior.
+        self.stale_s = stale_s
         self.client = make_broker_client(broker_host, broker_port)
-        self._servers: Dict[str, Tuple[str, int]] = {}
+        #: key → (host, port, advertised epoch ts; 0.0 = no ts in ad)
+        self._servers: Dict[str, Tuple[str, int, float]] = {}
         self._lock = threading.Lock()
         self._seen = threading.Event()
         self.client.subscribe(f"{TOPIC_PREFIX}{operation}/#", self._on_msg)
@@ -86,29 +94,55 @@ class ServerDiscovery:
             else:
                 try:
                     info = json.loads(body.decode())
-                    self._servers[key] = (info["host"], int(info["port"]))
+                    self._servers[key] = (info["host"], int(info["port"]),
+                                          float(info.get("ts", 0.0)))
                 except (ValueError, KeyError) as e:
                     log.warning("bad discovery payload on %s: %s", topic, e)
                     return
                 self._seen.set()  # only live endpoints count as "seen"
+
+    def _live_locked(self) -> List[Tuple[str, int]]:
+        if self.stale_s is None:
+            return [(h, p) for h, p, _ts in self._servers.values()]
+        # deliberately wall-clock: the advertised ts is a peer's epoch
+        # stamp, comparable only against our own epoch clock
+        wall_now = time.time()
+        cutoff = wall_now - self.stale_s
+        out = []
+        for key, (h, p, ts) in list(self._servers.items()):
+            # ts==0.0 = ad without a timestamp (older peer): trusted,
+            # staleness can only be judged against an advertised clock
+            if ts and ts < cutoff:
+                log.info("discovery: dropping stale ad %s (%.1fs old)",
+                         key, wall_now - ts)
+                self._servers.pop(key)
+                continue
+            out.append((h, p))
+        return out
 
     def wait_servers(self, timeout: float = 5.0,
                      settle: float = 0.2) -> List[Tuple[str, int]]:
         """Wait up to ``timeout`` for at least one live server, then a
         short ``settle`` window so same-burst retained messages land and
         the failover list is complete — a tombstone alone never satisfies
-        the wait."""
+        the wait. Mid-wait retractions are honored: a server that
+        advertises and then tombstones before the settle window closes
+        is not returned."""
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             if self._seen.wait(timeout=min(0.1, max(0.0, deadline -
                                                     time.monotonic()))):
-                break
+                with self._lock:
+                    have = bool(self._live_locked())
+                if have:
+                    break
+                self._seen.clear()  # everything seen so far went stale
         with self._lock:
             have = bool(self._servers)
         if have and settle > 0:
             time.sleep(settle)  # collect the rest of the retained burst
         with self._lock:
-            return list(self._servers.values())
+            return self._live_locked()
 
     def close(self) -> None:
         self.client.close()

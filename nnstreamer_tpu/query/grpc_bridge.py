@@ -29,6 +29,7 @@ from concurrent import futures
 from typing import Callable, Iterator, Optional
 
 from nnstreamer_tpu.log import get_logger
+from nnstreamer_tpu.pipeline import faults as _faults
 from nnstreamer_tpu.tensors.buffer import TensorBuffer
 
 log = get_logger("grpc")
@@ -190,6 +191,7 @@ class TensorServiceClient:
 
         self._encode, self._decode = _codecs(idl)
         self.target = f"{host}:{port}"
+        self._closed = False
         self._channel = grpc.insecure_channel(self.target)
         self._send_rpc = self._channel.stream_unary(
             f"/{SERVICE}/SendTensors",
@@ -202,27 +204,44 @@ class TensorServiceClient:
             response_deserializer=self._decode,
         )
 
-    def __del__(self):  # best-effort channel cleanup
-        try:
-            self._channel.close()
-        except Exception:  # nns-lint: disable=NNS104 -- __del__ at interpreter teardown; even logging can fail here
-            pass
-
     def wait_ready(self, timeout: float = 10.0):
         import grpc
 
         grpc.channel_ready_future(self._channel).result(timeout=timeout)
         return self
 
+    @staticmethod
+    def _fault_hook() -> None:
+        fi = _faults.ACTIVE
+        if fi is not None and fi.action("grpc.call") is not None:
+            # any transport verdict at this site surfaces as the same
+            # error a dead channel would raise; the caller's retry path
+            # (not this bridge) owns recovery
+            raise ConnectionError("injected fault: grpc.call")
+
     def send_stream(self, buffers: Iterator[TensorBuffer],
                     timeout: Optional[float] = None) -> None:
         """Stream buffers to the server (blocks until the server acks)."""
+        self._fault_hook()
         self._send_rpc(iter(buffers), timeout=timeout)
 
     def recv_stream(self, timeout: Optional[float] = None
                     ) -> Iterator[TensorBuffer]:
         """Iterate buffers streamed by the server."""
+        self._fault_hook()
         return self._recv_rpc(None, timeout=timeout)
 
-    def close(self):
+    def close(self) -> None:
+        """Idempotent channel shutdown — element ``stop()`` owns the
+        call (a ``__del__`` here would race interpreter teardown and
+        mask grpc's own cleanup ordering)."""
+        if self._closed:
+            return
+        self._closed = True
         self._channel.close()
+
+    def __enter__(self) -> "TensorServiceClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -38,6 +38,7 @@ import uuid
 from typing import Callable, Dict, List, Optional, Tuple
 
 from nnstreamer_tpu.log import get_logger
+from nnstreamer_tpu.pipeline import faults as _faults
 
 log = get_logger("mqtt")
 
@@ -434,11 +435,11 @@ class MqttClient:
                     for filt, _cb, qos in subs:
                         self._pid = self._pid % 0xFFFF + 1
                         self._resub_pids[self._pid] = filt
-                        sock.sendall(subscribe_packet(self._pid, filt,  # nns-lint: disable=NNS102 -- this lock exists to serialize writes to this very socket
+                        sock.sendall(subscribe_packet(self._pid, filt,  # nns-lint: disable=NNS102,NNS112 -- the lock serializes writes to this socket; SO_SNDTIMEO (set at connect) bounds them
                                                       qos=qos))
                     for pid, (topic, payload, retain,
                               *_rest) in unacked:
-                        sock.sendall(publish_packet(topic, payload, retain,  # nns-lint: disable=NNS102 -- this lock exists to serialize writes to this very socket
+                        sock.sendall(publish_packet(topic, payload, retain,  # nns-lint: disable=NNS102,NNS112 -- the lock serializes writes to this socket; SO_SNDTIMEO (set at connect) bounds them
                                                     qos=1, packet_id=pid,
                                                     dup=True))
                 except OSError:
@@ -507,7 +508,7 @@ class MqttClient:
                         continue
                     entry[4] += 1
                     try:
-                        self._sock.sendall(publish_packet(  # nns-lint: disable=NNS102 -- this lock exists to serialize writes to this very socket
+                        self._sock.sendall(publish_packet(  # nns-lint: disable=NNS102,NNS112 -- the lock serializes writes to this socket; SO_SNDTIMEO (set at connect) bounds them
                             entry[0], entry[1], entry[2], qos=1,
                             packet_id=pid, dup=True))
                     except OSError:
@@ -520,9 +521,30 @@ class MqttClient:
         """Publish. ``qos=1``: blocks until PUBACK when ``timeout`` is
         given; without one it returns immediately and the keepalive
         loop retransmits (DUP) each tick until PUBACK."""
+        act = None
+        fi = _faults.ACTIVE
+        if fi is not None:
+            act = fi.action("mqtt.publish")
+            if act == "disconnect":
+                # sever the broker link; the keepalive loop's reconnect
+                # path owns recovery (QoS1 unacked entries retransmit,
+                # QoS0 is lost — the at-most-once contract)
+                try:
+                    self._sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+            elif act == "corrupt":
+                # a reserved packet type (0xF0): any compliant broker
+                # must drop the connection on it (MQTT-2.2.2-2)
+                with self._lock:
+                    try:
+                        self._sock.sendall(b"\xf0\x00")  # nns-lint: disable=NNS102,NNS112 -- the lock serializes writes to this socket; SO_SNDTIMEO (set at connect) bounds them
+                    except OSError:
+                        pass
         if qos == 0:
-            with self._lock:
-                self._sock.sendall(publish_packet(topic, payload, retain))  # nns-lint: disable=NNS102 -- this lock exists to serialize writes to this very socket
+            if act is None:
+                with self._lock:
+                    self._sock.sendall(publish_packet(topic, payload, retain))  # nns-lint: disable=NNS102,NNS112 -- the lock serializes writes to this socket; SO_SNDTIMEO (set at connect) bounds them
             return
         if qos != 1:
             raise ValueError("mqtt: only QoS 0/1 supported")
@@ -541,8 +563,10 @@ class MqttClient:
             pid = self._pid
             entry = [topic, payload, retain, evt, 0, "pending"]
             self._unacked[pid] = entry
-            self._sock.sendall(publish_packet(topic, payload, retain,  # nns-lint: disable=NNS102 -- this lock exists to serialize writes to this very socket
-                                              qos=1, packet_id=pid))
+            if act is None:  # a dropped first copy recovers via DUP
+                # retransmit — the entry above is already in _unacked
+                self._sock.sendall(publish_packet(topic, payload, retain,  # nns-lint: disable=NNS102,NNS112 -- the lock serializes writes to this socket; SO_SNDTIMEO (set at connect) bounds them
+                                                  qos=1, packet_id=pid))
         if timeout is not None:
             deadline = time.monotonic() + timeout
             while not evt.wait(0.25):
@@ -562,7 +586,7 @@ class MqttClient:
                     # bandwidth here too
                     if pid in self._unacked:
                         try:  # retransmit with DUP while waiting
-                            self._sock.sendall(publish_packet(  # nns-lint: disable=NNS102 -- this lock exists to serialize writes to this very socket
+                            self._sock.sendall(publish_packet(  # nns-lint: disable=NNS102,NNS112 -- the lock serializes writes to this socket; SO_SNDTIMEO (set at connect) bounds them
                                 topic, payload, retain, qos=1,
                                 packet_id=pid, dup=True))
                         except OSError:
@@ -584,7 +608,7 @@ class MqttClient:
             pid = self._pid
             self._subs.append((topic_filter, cb, qos))
             self._pending_subacks[pid] = (evt, slot, topic_filter)
-            self._sock.sendall(subscribe_packet(pid, topic_filter,  # nns-lint: disable=NNS102 -- this lock exists to serialize writes to this very socket
+            self._sock.sendall(subscribe_packet(pid, topic_filter,  # nns-lint: disable=NNS102,NNS112 -- the lock serializes writes to this socket; SO_SNDTIMEO (set at connect) bounds them
                                                 qos=qos))
         try:
             if not evt.wait(timeout):
@@ -617,7 +641,7 @@ class MqttClient:
                         parse_publish(flags, body)
                     if qos and pid is not None:
                         with self._lock:
-                            self._sock.sendall(puback_packet(pid))  # nns-lint: disable=NNS102 -- this lock exists to serialize writes to this very socket
+                            self._sock.sendall(puback_packet(pid))  # nns-lint: disable=NNS102,NNS112 -- the lock serializes writes to this socket; SO_SNDTIMEO (set at connect) bounds them
                     for pattern, cb, _q in list(self._subs):
                         if topic_matches(pattern, topic):
                             try:
@@ -659,7 +683,7 @@ class MqttClient:
                     self._pong_at = time.monotonic()
                 elif ptype == PINGREQ:
                     with self._lock:
-                        self._sock.sendall(pingresp_packet())  # nns-lint: disable=NNS102 -- this lock exists to serialize writes to this very socket
+                        self._sock.sendall(pingresp_packet())  # nns-lint: disable=NNS102,NNS112 -- the lock serializes writes to this socket; SO_SNDTIMEO (set at connect) bounds them
             except Exception as e:  # noqa: BLE001 — malformed peer bytes
                 # framing state is unreliable past a parse error: fail the
                 # connection so pollers of `failed` see it, don't hang
@@ -671,14 +695,14 @@ class MqttClient:
     def ping(self) -> None:
         with self._lock:
             self._ping_at = time.monotonic()
-            self._sock.sendall(pingreq_packet())  # nns-lint: disable=NNS102 -- this lock exists to serialize writes to this very socket
+            self._sock.sendall(pingreq_packet())  # nns-lint: disable=NNS102,NNS112 -- the lock serializes writes to this socket; SO_SNDTIMEO (set at connect) bounds them
 
     def close(self) -> None:
         self._alive = False
         self._stop_evt.set()
         try:
             with self._lock:
-                self._sock.sendall(disconnect_packet())  # nns-lint: disable=NNS102 -- this lock exists to serialize writes to this very socket
+                self._sock.sendall(disconnect_packet())  # nns-lint: disable=NNS102,NNS112 -- the lock serializes writes to this socket; SO_SNDTIMEO (set at connect) bounds them
         except OSError:
             pass
         try:
@@ -735,7 +759,7 @@ class MqttBroker:
             sock.sendall(data)  # pre-registration (CONNACK): single-owner
             return
         with wlock:
-            sock.sendall(data)  # nns-lint: disable=NNS102 -- this lock exists to serialize writes to this very socket
+            sock.sendall(data)  # nns-lint: disable=NNS102,NNS112 -- the lock serializes writes to this socket; SO_SNDTIMEO (set at connect) bounds them
 
     def _retx_loop(self):
         while self._alive:

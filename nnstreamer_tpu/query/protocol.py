@@ -44,6 +44,29 @@ class Cmd(enum.IntEnum):
     CLIENT_ID = 6
     PING = 7
     BYE = 8
+    # -- resilient extension (query/resilience.py) — a client that never
+    # sets a resilience knob never emits these, so the classic wire
+    # (commands 1-8) stays byte-identical to pre-extension builds
+    HELLO = 9        # "<instance>:<dedup window>"; server echoes HELLO
+    TRANSFER_EX = 10  # ext header (req_id, slack_s) + classic buffer
+    RESULT_EX = 11    # ext header (req_id, -1) + classic buffer
+    EXPIRED = 12      # ext header only: deadline missed, frame shed
+
+
+#: extended-command header: u64 request id + f64 deadline slack in
+#: seconds (negative = no deadline; 0.0 = already expired at send time)
+_EXT_HDR = struct.Struct("<Qd")
+
+
+def pack_ext(req_id: int, slack_s: float, body: bytes = b"") -> bytes:
+    return _EXT_HDR.pack(req_id, slack_s) + body
+
+
+def unpack_ext(payload: bytes) -> Tuple[int, float, bytes]:
+    if len(payload) < _EXT_HDR.size:
+        raise QueryProtocolError("short extended header")
+    req_id, slack_s = _EXT_HDR.unpack_from(payload)
+    return req_id, slack_s, payload[_EXT_HDR.size:]
 
 
 class QueryProtocolError(RuntimeError):

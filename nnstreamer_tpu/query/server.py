@@ -11,11 +11,15 @@ from __future__ import annotations
 import os
 import queue as _queue
 import socket
+import struct
+import sys
 import threading
+import time
 from typing import Dict, Optional, Tuple
 
 from nnstreamer_tpu.log import get_logger
 from nnstreamer_tpu.query import protocol as P
+from nnstreamer_tpu.query import resilience as _res
 from nnstreamer_tpu.tensors.buffer import TensorBuffer
 
 log = get_logger("query.server")
@@ -37,11 +41,17 @@ class QueryServer:
 
     def __init__(self, host: str = "0.0.0.0", port: int = 3000,
                  caps_str: str = "", max_queue: int = 64,
-                 wire: str = "nnstpu", sink_port: int = 0):
+                 wire: str = "nnstpu", sink_port: int = 0,
+                 resilient: bool = False):
         self.host = host
         self.port = port
         self.caps_str = caps_str
         self.max_queue = max_queue
+        #: resilient mode: serve the extended protocol (HELLO /
+        #: TRANSFER_EX dedup, deadline propagation, EXPIRED notices) on
+        #: the pure-Python transport — the native epoll core doesn't
+        #: speak the extended commands, so it is bypassed when set
+        self.resilient = bool(resilient)
         #: "nnstpu" = NTQ1 framing (self-describing tensors); "nnstreamer"
         #: = the reference's raw-struct wire (query/refwire.py) on TWO
         #: ports (src=port, sink=sink_port) so reference edge peers can
@@ -59,6 +69,15 @@ class QueryServer:
         self._sink_core = None  # refwire: native sink-port core
         self._refwire = None    # refwire: pure-Python two-port server
         self._config = None     # refwire: TensorsConfig for reconstruction
+        # resilient-protocol state, all keyed by the HELLO-announced
+        # client *instance* (stable across that client's reconnects)
+        self._dedup: Dict[str, _res.DedupWindow] = {}
+        self._instances: Dict[str, int] = {}      # instance → live client id
+        self._conn_instance: Dict[int, str] = {}  # client id → instance
+        #: chaos-test witnesses: duplicate requests absorbed / frames
+        #: expired remotely (mirrors of the nns_net_* counters)
+        self.dedup_hits = 0
+        self.remote_expired = 0
         from nnstreamer_tpu.obs import get_registry
 
         reg = get_registry()
@@ -87,7 +106,11 @@ class QueryServer:
         self._stop.clear()
         if self.wire == "nnstreamer":
             return self._start_refwire()
-        if not os.environ.get("NNSTPU_PURE_PY_SERVER"):
+        if self.resilient:
+            log.info("resilient mode: using the pure-Python transport "
+                     "(the native core does not speak the extended "
+                     "protocol)")
+        elif not os.environ.get("NNSTPU_PURE_PY_SERVER"):
             try:
                 from nnstreamer_tpu.native import NativeServerCore
 
@@ -187,6 +210,14 @@ class QueryServer:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if self.resilient and sys.platform.startswith(
+                    ("linux", "darwin")):
+                # bounded SENDS without touching recv (same trick as
+                # query/mqtt.py): EXPIRED notices and replayed results go
+                # out from scheduler/sink threads — a half-open client
+                # whose window closed must fail the send, not wedge them
+                tv = struct.pack("ll", 5, 0)
+                conn.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO, tv)
             with self._clients_lock:
                 client_id = self._next_id
                 self._next_id += 1
@@ -219,6 +250,12 @@ class QueryServer:
                         break
                     buf.meta["query_client_id"] = client_id
                     self.incoming.put(buf)
+                elif cmd is P.Cmd.HELLO:
+                    self._handle_hello(client_id, conn, payload)
+                elif cmd is P.Cmd.TRANSFER_EX:
+                    if not self._handle_transfer_ex(client_id, conn,
+                                                    payload):
+                        break
                 elif cmd is P.Cmd.PING:
                     P.send_msg(conn, P.Cmd.PING)
                 elif cmd is P.Cmd.BYE:
@@ -228,10 +265,132 @@ class QueryServer:
         finally:
             with self._clients_lock:
                 self._clients.pop(client_id, None)
+                instance = self._conn_instance.pop(client_id, None)
+                # the instance mapping survives only until the client's
+                # NEXT connection claims it (reconnect routing); clear it
+                # if it still points at this dead connection
+                if instance is not None and \
+                        self._instances.get(instance) == client_id:
+                    self._instances.pop(instance, None)
             try:
                 conn.close()
             except OSError:
                 pass
+
+    # -- resilient protocol (HELLO / TRANSFER_EX / EXPIRED) ------------------
+    def _handle_hello(self, client_id: int, conn: socket.socket,
+                      payload: bytes) -> None:
+        """HELLO announces the client's stable instance identity and its
+        dedup-window size; the reply acknowledges extended-protocol
+        support (a classic server would silently ignore the command, so
+        the client treats a missing echo as 'speak classic')."""
+        instance, _, win = payload.decode().partition(":")
+        try:
+            window = max(1, int(win)) if win else 64
+        except ValueError:
+            window = 64
+        with self._clients_lock:
+            self._conn_instance[client_id] = instance
+            self._instances[instance] = client_id
+            if instance not in self._dedup:
+                self._dedup[instance] = _res.DedupWindow(size=window)
+        P.send_msg(conn, P.Cmd.HELLO, b"ok")
+        log.info("client %d is resilient instance %s (dedup window %d)",
+                 client_id, instance[:12], window)
+
+    def _handle_transfer_ex(self, client_id: int, conn: socket.socket,
+                            payload: bytes) -> bool:
+        """One extended transfer: dedup first (a resend of a resolved
+        request replays the cached reply, a still-pending one is
+        dropped), then the deadline gate, then normal ingress. Returns
+        False to disconnect the client (bad frame)."""
+        try:
+            req_id, slack_s, body = P.unpack_ext(payload)
+        except P.QueryProtocolError as e:
+            self._m_errors.inc()
+            log.warning("bad extended frame from client %d (%s); "
+                        "disconnecting it", client_id, e)
+            return False
+        with self._clients_lock:
+            instance = self._conn_instance.get(client_id)
+            dedup = self._dedup.get(instance) if instance else None
+        if dedup is None:
+            self._m_errors.inc()
+            log.warning("TRANSFER_EX from client %d before HELLO; "
+                        "disconnecting it", client_id)
+            return False
+        verdict = dedup.admit(req_id)
+        if verdict is _res.PENDING:
+            # original invocation still in flight — its reply will route
+            # to this instance's current connection when it lands
+            self.dedup_hits += 1
+            _res.metrics()["dedup_hits"].inc()
+            return True
+        if verdict is not _res.NEW:
+            # already resolved: replay the cached reply, don't re-invoke
+            self.dedup_hits += 1
+            _res.metrics()["dedup_hits"].inc()
+            cached_cmd, cached_payload = verdict
+            P.send_msg(conn, cached_cmd, cached_payload)
+            return True
+        now = time.monotonic()
+        if slack_s == 0.0:
+            # the sender clamps an already-blown deadline to exactly 0:
+            # expired on arrival — shed before paying for unpack/invoke
+            self._expire_req(instance, req_id, conn=conn)
+            return True
+        try:
+            buf = P.unpack_buffer(body)
+        except Exception as e:  # noqa: BLE001 — corrupt frame: orderly
+            # disconnect, same as the classic TRANSFER path. Forget the
+            # dedup admit so the client's resend of the intact frame
+            # invokes instead of being dropped as a duplicate
+            dedup.forget(req_id)
+            self._m_errors.inc()
+            log.warning("bad frame from client %d (%s); disconnecting it",
+                        client_id, e)
+            return False
+        buf.meta["query_client_id"] = client_id
+        buf.meta["net_req_id"] = req_id
+        buf.meta["net_instance"] = instance
+        if slack_s > 0.0:
+            # propagated deadline: stamp the remaining budget so the SLO
+            # scheduler's admission test (serving/scheduler.py decide())
+            # sees the sender's clock, and leave a shed hook so
+            # note_shed can notify the origin client
+            buf.meta["deadline_t"] = now + slack_s
+            buf.meta["_net_expire"] = (self, instance, req_id)
+        self.incoming.put(buf)
+        return True
+
+    def _expire_req(self, instance: str, req_id: int,
+                    conn: Optional[socket.socket] = None) -> None:
+        """Record + send an EXPIRED notice; the reply is cached in the
+        dedup window so a resend of the expired request replays the
+        notice instead of re-entering the pipeline."""
+        reply = (P.Cmd.EXPIRED, P.pack_ext(req_id, -1.0))
+        with self._clients_lock:
+            dedup = self._dedup.get(instance)
+        if dedup is not None:
+            dedup.resolve(req_id, reply)
+        self.remote_expired += 1
+        _res.metrics()["expired_remote"].inc()
+        if conn is None:
+            with self._clients_lock:
+                cid = self._instances.get(instance)
+                conn = self._clients.get(cid) if cid is not None else None
+        if conn is None:
+            return
+        try:
+            P.send_msg(conn, *reply)
+        except OSError as e:
+            log.info("EXPIRED notice for req %d not deliverable: %s",
+                     req_id, e)
+
+    def send_expired(self, instance: str, req_id: int) -> None:
+        """Scheduler-shed hook (``resilience.note_remote_shed``): the
+        remote SLO scheduler dropped this frame before dispatch."""
+        self._expire_req(instance, req_id)
 
     # -- reference-wire reconstruction --------------------------------------
     def _refwire_buf(self, client_id: int, info: dict,
@@ -288,6 +447,9 @@ class QueryServer:
                 log.warning("result for client %d not deliverable",
                             client_id)
             return ok
+        req_id = buf.meta.get("net_req_id")
+        if req_id is not None:
+            return self._send_result_ex(client_id, buf, int(req_id))
         with self._clients_lock:
             conn = self._clients.get(client_id)
         if conn is None:
@@ -300,6 +462,36 @@ class QueryServer:
         except OSError as e:
             self._m_errors.inc()
             log.warning("send to client %d failed: %s", client_id, e)
+            return False
+
+    def _send_result_ex(self, client_id: int, buf: TensorBuffer,
+                        req_id: int) -> bool:
+        """Resilient result: cache the reply in the instance's dedup
+        window (so a post-reconnect resend replays it), then send it to
+        the instance's CURRENT connection — which, after a flap, is a
+        different client id than the one the request arrived on."""
+        instance = buf.meta.get("net_instance")
+        reply = (P.Cmd.RESULT_EX, P.pack_ext(req_id, -1.0,
+                                             P.pack_buffer(buf)))
+        with self._clients_lock:
+            dedup = self._dedup.get(instance) if instance else None
+            cid = self._instances.get(instance, client_id) \
+                if instance else client_id
+            conn = self._clients.get(cid)
+        if dedup is not None:
+            dedup.resolve(req_id, reply)
+        if conn is None:
+            # cached for replay: the client's reconnect resend gets it
+            log.info("result for instance %s req %d cached (no live "
+                     "connection)", str(instance)[:12], req_id)
+            return False
+        try:
+            P.send_msg(conn, *reply)
+            return True
+        except OSError as e:
+            self._m_errors.inc()
+            log.warning("resilient result send to client %d failed: %s",
+                        cid, e)
             return False
 
     def get_buffer(self, timeout: Optional[float] = None

@@ -371,6 +371,13 @@ class SloScheduler:
         buf.meta.pop("admitted_t", None)
         buf.meta.pop("deadline_t", None)
         self._m["shed_late" if late else "shed_capacity"].inc()
+        if "_net_expire" in buf.meta:
+            # the frame arrived over the query wire with a propagated
+            # deadline: tell the origin client it was shed so its
+            # in-flight slot frees now instead of timing out
+            from nnstreamer_tpu.query import resilience
+
+            resilience.note_remote_shed(buf)
         tl = _timeline.ACTIVE
         if tl is not None:
             tl.mark("sched_shed", buf.meta.get(_timeline.TRACE_SEQ_META),

@@ -1,0 +1,585 @@
+"""Resilient transport fabric tests — deadline propagation, idempotent
+retry, circuit breaking and chaos coverage (query/resilience.py).
+
+The loopback classes mirror test_query.py's in-process multi-node
+pattern; the chaos classes drive the same split pipeline through the
+deterministic fault injector and assert the exactly-once witnesses
+(zero duplicate server invocations, byte-identical outputs)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import parse_launch
+from nnstreamer_tpu.pipeline import faults as F
+from nnstreamer_tpu.query import protocol as P
+from nnstreamer_tpu.query import resilience as R
+from nnstreamer_tpu.registry import ELEMENT, get_subplugin
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
+
+
+# ---------------------------------------------------------------------------
+# unit: primitives
+# ---------------------------------------------------------------------------
+
+class TestRetryPolicy:
+    def test_deterministic_and_bounded(self):
+        p1 = R.RetryPolicy(base_ms=50.0, key="k")
+        p2 = R.RetryPolicy(base_ms=50.0, key="k")
+        delays = [p1.delay(a) for a in range(1, 12)]
+        assert delays == [p2.delay(a) for a in range(1, 12)]
+        assert all(d <= R.BACKOFF_CAP_S for d in delays)
+        # jitter stays within [0.5x, 1.0x] of the exponential ceiling
+        assert 0.025 <= delays[0] <= 0.05
+
+    def test_key_decorrelates(self):
+        a = [R.RetryPolicy(key="a").delay(n) for n in range(1, 6)]
+        b = [R.RetryPolicy(key="b").delay(n) for n in range(1, 6)]
+        assert a != b
+
+    def test_monotone_ceiling(self):
+        p = R.RetryPolicy(base_ms=100.0, key="m")
+        # ceilings double until the cap; jittered values never exceed it
+        for attempt in range(1, 10):
+            assert p.delay(attempt) <= min(
+                0.1 * 2 ** (attempt - 1), R.BACKOFF_CAP_S)
+
+
+class TestCircuitBreaker:
+    def test_open_after_threshold_and_half_open_probe(self):
+        br = R.CircuitBreaker(failures=3, reset_s=0.05, endpoint="t:1")
+        assert br.state == R.CLOSED
+        for _ in range(3):
+            assert br.allow()
+            br.record_failure()
+        assert br.state == R.OPEN
+        assert not br.allow()  # open: reject immediately
+        time.sleep(0.06)
+        assert br.allow()  # half-open probe admitted
+        assert br.state == R.HALF_OPEN
+        br.record_success()
+        assert br.state == R.CLOSED
+
+    def test_half_open_failure_reopens(self):
+        br = R.CircuitBreaker(failures=1, reset_s=0.01, endpoint="t:2")
+        br.record_failure()
+        assert br.state == R.OPEN
+        time.sleep(0.02)
+        assert br.allow()
+        br.record_failure()
+        assert br.state == R.OPEN
+
+    def test_transitions_witness(self):
+        br = R.CircuitBreaker(failures=1, reset_s=0.01, endpoint="t:3")
+        br.record_failure()
+        time.sleep(0.02)
+        br.allow()
+        br.record_success()
+        states = [s for _t, s in br.transitions]
+        assert states == [R.OPEN, R.HALF_OPEN, R.CLOSED]
+
+
+class TestDedupWindow:
+    def test_new_pending_resolved_replay(self):
+        w = R.DedupWindow(size=8)
+        assert w.admit(1) is R.NEW
+        assert w.admit(1) is R.PENDING  # in flight: duplicate dropped
+        w.resolve(1, ("cmd", b"payload"))
+        assert w.admit(1) == ("cmd", b"payload")  # replay, no re-invoke
+
+    def test_forget_allows_reinvoke(self):
+        w = R.DedupWindow(size=8)
+        assert w.admit(5) is R.NEW
+        w.forget(5)  # bad frame: admission rolled back
+        assert w.admit(5) is R.NEW  # the intact resend invokes again
+
+    def test_fifo_trim(self):
+        w = R.DedupWindow(size=4)
+        for i in range(10):
+            w.admit(i)
+        assert len(w) == 4
+
+    def test_threaded_admits_single_new(self):
+        w = R.DedupWindow(size=64)
+        verdicts = []
+        barrier = threading.Barrier(8)
+
+        def racer():
+            barrier.wait()
+            verdicts.append(w.admit(42))
+
+        threads = [threading.Thread(target=racer, daemon=True)
+                   for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(5)
+        assert verdicts.count(R.NEW) == 1
+        assert verdicts.count(R.PENDING) == 7
+
+
+class TestEndpointStats:
+    def test_cold_uses_floor(self):
+        s = R.EndpointStats()
+        assert s.hedge_timeout(0.25) == 0.25
+
+    def test_p99_scaling(self):
+        s = R.EndpointStats()
+        for _ in range(100):
+            s.observe(0.010)
+        s.observe(0.200)  # one outlier
+        p99 = s.p99()
+        assert 0.010 <= p99 <= 0.200
+        assert s.hedge_timeout(0.001) == pytest.approx(
+            max(0.001, p99 * R.HEDGE_P99_FACTOR))
+        assert 0.009 < s.ewma() < 0.05
+
+
+class TestPendingEntry:
+    def test_slack_no_deadline(self):
+        e = R.PendingEntry(1, 0, {}, b"x")
+        assert e.slack_s(time.monotonic()) == -1.0
+
+    def test_slack_clamps_to_zero(self):
+        now = time.monotonic()
+        e = R.PendingEntry(1, 0, {}, b"x", deadline_t=now - 5.0)
+        assert e.slack_s(now) == 0.0  # blown deadline → exactly 0
+        e2 = R.PendingEntry(2, 0, {}, b"x", deadline_t=now + 2.0)
+        assert 1.9 < e2.slack_s(now) <= 2.0
+
+
+# ---------------------------------------------------------------------------
+# unit: protocol extension
+# ---------------------------------------------------------------------------
+
+class TestExtendedProtocol:
+    def test_ext_roundtrip(self):
+        req_id, slack, body = P.unpack_ext(P.pack_ext(77, 1.5, b"abc"))
+        assert (req_id, slack, body) == (77, 1.5, b"abc")
+
+    def test_short_header_raises(self):
+        with pytest.raises(P.QueryProtocolError):
+            P.unpack_ext(b"\x00\x01")
+
+    def test_classic_commands_unchanged(self):
+        # the resilient extension appends commands; the classic ids the
+        # native core speaks must never move
+        assert [int(c) for c in (P.Cmd.REQUEST_INFO, P.Cmd.APPROVE,
+                                 P.Cmd.DENY, P.Cmd.TRANSFER, P.Cmd.RESULT,
+                                 P.Cmd.CLIENT_ID, P.Cmd.PING, P.Cmd.BYE)
+                ] == [1, 2, 3, 4, 5, 6, 7, 8]
+        assert int(P.Cmd.HELLO) == 9
+        assert int(P.Cmd.TRANSFER_EX) == 10
+        assert int(P.Cmd.RESULT_EX) == 11
+        assert int(P.Cmd.EXPIRED) == 12
+
+
+# ---------------------------------------------------------------------------
+# unit: fault-injector transport sites
+# ---------------------------------------------------------------------------
+
+class TestTransportFaultSites:
+    def test_new_sites_and_kinds_parse(self):
+        rules = F.parse_faults(
+            "query.send:rate=0.5,kind=drop;"
+            "query.recv:kind=disconnect,nth=3;"
+            "grpc.call:kind=corrupt,every=2;"
+            "mqtt.publish:kind=drop,rate=0.1")
+        assert {r.site for r in rules} == {
+            "query.send", "query.recv", "grpc.call", "mqtt.publish"}
+
+    def test_unknown_transport_kind_rejected(self):
+        with pytest.raises(ValueError):
+            F.parse_faults("query.send:kind=explode")
+
+    def test_action_verdicts_deterministic(self):
+        rules = F.parse_faults("query.send:rate=0.3,kind=drop")
+        a = F.FaultInjector(rules, seed=9)
+        b = F.FaultInjector(rules, seed=9)
+        va = [a.action("query.send") for _ in range(200)]
+        vb = [b.action("query.send") for _ in range(200)]
+        assert va == vb
+        assert "drop" in va and None in va
+
+    def test_check_degrades_transport_kind_to_raise(self):
+        rules = F.parse_faults("query.send:nth=1,kind=drop")
+        fi = F.FaultInjector(rules, seed=0)
+        with pytest.raises(F.InjectedFault):
+            fi.check("query.send")  # a drop has no meaning mid-invoke
+
+    def test_action_passes_raise_through(self):
+        rules = F.parse_faults("grpc.call:nth=1,kind=raise")
+        fi = F.FaultInjector(rules, seed=0)
+        with pytest.raises(F.InjectedFault):
+            fi.action("grpc.call")
+
+
+# ---------------------------------------------------------------------------
+# loopback: exactly-once offload
+# ---------------------------------------------------------------------------
+
+def _echo_server(reliable=True):
+    """(serversrc element, worker stopper, invoke list): echoes each
+    frame doubled, recording every net_req_id it actually invokes."""
+    Src = get_subplugin(ELEMENT, "tensor_query_serversrc")
+    src = Src(port=0, reliable=reliable)
+    src.start()
+    server = src.server
+    stop = threading.Event()
+    invokes = []
+
+    def worker():
+        while not stop.is_set():
+            try:
+                buf = server.incoming.get(timeout=0.2)
+            except Exception:
+                continue
+            if buf is None:  # stop sentinel
+                continue
+            invokes.append(buf.meta.get("net_req_id"))
+            out = TensorBuffer([t * 2 for t in buf.to_host().tensors],
+                               pts=buf.pts)
+            out.meta.update(buf.meta)
+            server.send_result(buf.meta["query_client_id"], out)
+
+    threading.Thread(target=worker, daemon=True).start()
+    return src, stop, invokes
+
+
+class TestReliableLoopback:
+    def _run(self, n, client_props, fault_spec=None, seed=11):
+        src, stop, invokes = _echo_server()
+        Client = get_subplugin(ELEMENT, "tensor_query_client")
+        cl = Client(port=src.port, reliable=True, **client_props)
+        outs = []
+        cl.srcpad.push = lambda b: outs.append(b)
+        old = F.ACTIVE
+        if fault_spec:
+            F.ACTIVE = F.FaultInjector(F.parse_faults(fault_spec),
+                                       seed=seed)
+        try:
+            for i in range(n):
+                cl.chain(cl.sinkpad, TensorBuffer(
+                    [np.full((4,), i, dtype=np.float32)], pts=i))
+            cl.handle_eos()
+        finally:
+            F.ACTIVE = old
+            stop.set()
+            server = src.server  # src.stop() nulls the handle
+            cl.stop()
+            src.stop()
+        return outs, invokes, server
+
+    def test_clean_run_exactly_once(self):
+        outs, invokes, server = self._run(
+            30, dict(max_in_flight=4, timeout=5.0))
+        assert len(outs) == 30
+        assert sorted(int(o.to_host().tensors[0][0]) for o in outs) == \
+            [2 * i for i in range(30)]
+        assert len(invokes) == 30 and len(set(invokes)) == 30
+
+    def test_chaos_zero_loss_zero_double_invoke(self):
+        """The acceptance witness: under disconnect+drop chaos every
+        frame arrives byte-identical, the server invoked each request
+        exactly once, and the dedup window absorbed the resends."""
+        outs, invokes, server = self._run(
+            120,
+            dict(max_in_flight=4, timeout=0.5, max_retry=8,
+                 reconnect_backoff_ms=10.0),
+            fault_spec="query.send:rate=0.05,kind=disconnect;"
+                       "query.recv:rate=0.05,kind=drop")
+        assert len(outs) == 120  # zero loss
+        assert sorted(int(o.to_host().tensors[0][0]) for o in outs) == \
+            [2 * i for i in range(120)]  # byte-identical values
+        assert len(invokes) - len(set(invokes)) == 0  # no double invoke
+        assert server.dedup_hits > 0  # dedup actually exercised
+
+    def test_corrupt_frames_recover_via_forget(self):
+        outs, invokes, server = self._run(
+            40,
+            dict(max_in_flight=2, timeout=0.5, max_retry=8,
+                 reconnect_backoff_ms=10.0),
+            fault_spec="query.send:rate=0.1,kind=corrupt")
+        assert len(outs) == 40
+        assert len(invokes) - len(set(invokes)) == 0
+
+    def test_reliable_requires_reliable_server(self):
+        # classic server never echoes HELLO → a clear, early error
+        Src = get_subplugin(ELEMENT, "tensor_query_serversrc")
+        src = Src(port=0)  # classic
+        src.start()
+        Client = get_subplugin(ELEMENT, "tensor_query_client")
+        cl = Client(port=src.port, reliable=True, timeout=0.5, max_retry=1)
+        try:
+            with pytest.raises(P.QueryProtocolError):
+                cl.chain(cl.sinkpad, TensorBuffer(
+                    [np.zeros(2, np.float32)], pts=0))
+        finally:
+            cl.stop()
+            src.stop()
+
+    def test_frames_expired_is_read_only(self):
+        Client = get_subplugin(ELEMENT, "tensor_query_client")
+        cl = Client()
+        with pytest.raises(ValueError):
+            cl.set_property("frames_expired", 7)
+
+
+class TestDeadlinePropagation:
+    def test_blown_deadline_expires_remotely(self):
+        src, stop, invokes = _echo_server()
+        Client = get_subplugin(ELEMENT, "tensor_query_client")
+        cl = Client(port=src.port, reliable=True, propagate_deadline=True,
+                    timeout=5.0)
+        outs = []
+        cl.srcpad.push = lambda b: outs.append(b)
+        try:
+            now = time.monotonic()
+            live = TensorBuffer([np.ones(4, np.float32)], pts=0)
+            live.meta["deadline_t"] = now + 10.0
+            blown = TensorBuffer([np.ones(4, np.float32)], pts=1)
+            blown.meta["deadline_t"] = now - 1.0
+            cl.chain(cl.sinkpad, live)
+            cl.chain(cl.sinkpad, blown)
+            cl.handle_eos()
+            assert len(outs) == 1  # only the live frame came back
+            assert len(invokes) == 1  # the blown one never invoked
+            assert src.server.remote_expired == 1
+            assert cl.get_property("frames_expired") == 1
+        finally:
+            stop.set()
+            cl.stop()
+            src.stop()
+
+    def test_no_deadline_means_negative_slack_on_wire(self):
+        e = R.PendingEntry(1, 0, {}, b"")
+        payload = P.pack_ext(e.req_id, e.slack_s(time.monotonic()), b"")
+        _rid, slack, _b = P.unpack_ext(payload)
+        assert slack < 0  # "no deadline", never "expired"
+
+    def test_scheduler_shed_notifies_origin(self):
+        src, stop, _invokes = _echo_server()
+        try:
+            server = src.server
+            buf = TensorBuffer([np.ones(2, np.float32)], pts=0)
+            buf.meta["_net_expire"] = (server, "nobody", 123)
+            R.note_remote_shed(buf)  # unknown instance: counted, no send
+            assert server.remote_expired == 1
+        finally:
+            stop.set()
+            src.stop()
+
+
+# ---------------------------------------------------------------------------
+# byte-identity: knobs unset
+# ---------------------------------------------------------------------------
+
+class TestClassicByteIdentity:
+    def test_classic_wire_bytes_unchanged(self):
+        """With no resilience knobs, the client's TRANSFER payload is
+        byte-for-byte the classic pack_buffer framing."""
+        sent = []
+
+        class FakeSock:
+            def sendall(self, data):
+                sent.append(bytes(data))
+
+            def gettimeout(self):
+                return 1.0
+
+        Client = get_subplugin(ELEMENT, "tensor_query_client")
+        cl = Client()
+        cl._sock = FakeSock()
+        buf = TensorBuffer([np.arange(6, dtype=np.float32)], pts=9)
+        cl._send_buf(buf)
+        wire = b"".join(sent)
+        hdr = P._HDR.pack(P._MAGIC, int(P.Cmd.TRANSFER),
+                          len(P.pack_buffer(buf)))
+        assert wire == hdr + P.pack_buffer(buf)
+
+    def test_classic_loopback_still_lossless(self):
+        from nnstreamer_tpu.filters import register_custom_easy
+        from nnstreamer_tpu.tensors.types import TensorsInfo
+
+        info = TensorsInfo.from_str("3:8:8:1", "uint8")
+        register_custom_easy(
+            "double_u8_res",
+            lambda ins: [(np.asarray(ins[0]) * 2).astype(np.uint8)],
+            info, info,
+        )
+        server = parse_launch(
+            "tensor_query_serversrc name=ssrc port=0 ! "
+            "tensor_filter framework=custom-easy model=double_u8_res ! "
+            "tensor_query_serversink")
+        server.start()
+        try:
+            port = server.get("ssrc").port
+            client = parse_launch(
+                "videotestsrc num-buffers=4 width=8 height=8 "
+                "pattern=gradient ! tensor_converter ! "
+                f"tensor_query_client dest-host=127.0.0.1 "
+                f"dest-port={port} ! tensor_sink name=out")
+            msg = client.run(timeout=30)
+            assert msg.kind == "eos"
+            assert len(client.get("out").buffers) == 4
+        finally:
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# grpc: explicit close lifecycle (satellite)
+# ---------------------------------------------------------------------------
+
+class TestGrpcClientLifecycle:
+    def test_close_idempotent_and_context_manager(self):
+        pytest.importorskip("grpc")
+        from nnstreamer_tpu.query.grpc_bridge import (
+            TensorServiceClient,
+            TensorServiceServer,
+        )
+
+        svc = TensorServiceServer(port=0).start()
+        try:
+            with TensorServiceClient(port=svc.port) as client:
+                client.wait_ready(timeout=10)
+            client.close()  # second close: no raise
+            client.close()
+            assert not hasattr(client, "__del__")
+        finally:
+            svc.stop()
+
+    def test_grpc_call_fault_raises_connection_error(self):
+        pytest.importorskip("grpc")
+        from nnstreamer_tpu.query.grpc_bridge import (
+            TensorServiceClient,
+            TensorServiceServer,
+        )
+
+        svc = TensorServiceServer(port=0).start()
+        old = F.ACTIVE
+        F.ACTIVE = F.FaultInjector(
+            F.parse_faults("grpc.call:nth=1,kind=disconnect"), seed=0)
+        try:
+            client = TensorServiceClient(port=svc.port)
+            with pytest.raises(ConnectionError):
+                client.send_stream(iter([]))
+            client.close()
+        finally:
+            F.ACTIVE = old
+            svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# discovery under broker flap (satellite)
+# ---------------------------------------------------------------------------
+
+class TestDiscoveryFlap:
+    def test_retract_mid_wait_then_readvertise(self):
+        from nnstreamer_tpu.query.discovery import (
+            ServerAdvertiser,
+            ServerDiscovery,
+        )
+        from nnstreamer_tpu.query.pubsub import Broker
+
+        broker = Broker(port=0).start()
+        try:
+            ad = ServerAdvertiser("127.0.0.1", broker.port, "op-flap",
+                                  "10.0.0.1", 5001)
+            ad.publish()
+            disco = ServerDiscovery("127.0.0.1", broker.port, "op-flap")
+            assert disco.wait_servers(timeout=5) == [("10.0.0.1", 5001)]
+            # flap: retract, confirm gone, re-advertise, confirm back
+            ad2 = ServerAdvertiser("127.0.0.1", broker.port, "op-flap",
+                                   "10.0.0.1", 5001)
+            ad.retract()
+            deadline = time.monotonic() + 5
+            while disco.wait_servers(timeout=0.2, settle=0) and \
+                    time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert disco.wait_servers(timeout=0.2, settle=0) == []
+            ad2.publish()
+            deadline = time.monotonic() + 5
+            while not disco.wait_servers(timeout=0.2, settle=0) and \
+                    time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert disco.wait_servers(timeout=1) == [("10.0.0.1", 5001)]
+            disco.close()
+            ad2.retract()
+        finally:
+            broker.stop()
+
+    def test_stale_ads_expire(self):
+        import json
+
+        from nnstreamer_tpu.query.discovery import (
+            TOPIC_PREFIX,
+            ServerDiscovery,
+        )
+        from nnstreamer_tpu.query.pubsub import Broker, Client
+
+        broker = Broker(port=0).start()
+        try:
+            pub = Client("127.0.0.1", broker.port)
+            wall_old = time.time() - 3600  # an hour-old ad
+            pub.publish(
+                f"{TOPIC_PREFIX}op-stale/10.0.0.9:9000",
+                json.dumps({"host": "10.0.0.9", "port": 9000,
+                            "ts": wall_old}).encode(),
+                retain=True)
+            pub.publish(
+                f"{TOPIC_PREFIX}op-stale/10.0.0.8:8000",
+                json.dumps({"host": "10.0.0.8", "port": 8000,
+                            "ts": time.time()}).encode(),
+                retain=True)
+            disco = ServerDiscovery("127.0.0.1", broker.port, "op-stale",
+                                    stale_s=60.0)
+            assert disco.wait_servers(timeout=5) == [("10.0.0.8", 8000)]
+            disco.close()
+            # default (stale_s=None) keeps the classic trust-the-broker
+            # behavior: both ads count
+            disco2 = ServerDiscovery("127.0.0.1", broker.port, "op-stale")
+            assert sorted(disco2.wait_servers(timeout=5)) == [
+                ("10.0.0.8", 8000), ("10.0.0.9", 9000)]
+            disco2.close()
+            pub.close()
+        finally:
+            broker.stop()
+
+    def test_ad_without_ts_survives_stale_filter(self):
+        import json
+
+        from nnstreamer_tpu.query.discovery import (
+            TOPIC_PREFIX,
+            ServerDiscovery,
+        )
+        from nnstreamer_tpu.query.pubsub import Broker, Client
+
+        broker = Broker(port=0).start()
+        try:
+            pub = Client("127.0.0.1", broker.port)
+            pub.publish(
+                f"{TOPIC_PREFIX}op-nots/10.0.0.7:7000",
+                json.dumps({"host": "10.0.0.7", "port": 7000}).encode(),
+                retain=True)
+            disco = ServerDiscovery("127.0.0.1", broker.port, "op-nots",
+                                    stale_s=1.0)
+            assert disco.wait_servers(timeout=5) == [("10.0.0.7", 7000)]
+            disco.close()
+            pub.close()
+        finally:
+            broker.stop()
+
+
+# ---------------------------------------------------------------------------
+# metrics wiring
+# ---------------------------------------------------------------------------
+
+class TestResilienceMetrics:
+    def test_metric_names(self):
+        m = R.metrics()
+        assert set(m) == {"retries", "hedges", "dedup_hits",
+                          "expired_remote"}
+        g = R.breaker_gauge("h:1")
+        assert g is R.breaker_gauge("h:1")  # cached per endpoint
