@@ -63,6 +63,13 @@ These rules encode invariants this codebase has already been burned by
   function passes ``timeout=`` at the call, calls ``settimeout(...)``
   on the socket, or sets ``SO_SNDTIMEO``/``SO_RCVTIMEO`` (the
   send-side discipline used by ``query/mqtt.py``).
+- NNS113: a direct ``jax.device_put`` outside the HBM budget
+  accountant's tracked entry points (``TensorBuffer.to_device`` /
+  ``upload_many``, the backend ``open()`` weight load — see
+  ``_MEM_SANCTIONED_FUNCS``): bytes it moves land in device memory
+  that ``nns_mem_used_bytes`` never sees, so the pressure ladder and
+  residency eviction math (``tensors/memory.py``) run against an
+  undercount exactly when HBM is the scarce resource.
 
 Findings are suppressed per-line with::
 
@@ -141,6 +148,13 @@ _MATERIALIZE_CALLS = {"np.asarray", "numpy.asarray", "jax.device_get"}
 #: functions that ARE the sanctioned materialization site — anything
 #: inside them is exempt from NNS108
 _SANCTIONED_FUNCS = {"to_host"}
+
+#: the HBM budget accountant's tracked entry points (NNS113): the only
+#: functions allowed to call jax.device_put directly, because they are
+#: where the moved bytes register against tensors/memory.py — to_device/
+#: upload_many (frame transfers) and the backend open() weight load
+#: (residency-unit registration)
+_MEM_SANCTIONED_FUNCS = {"to_device", "upload_many", "open"}
 
 
 def _parse_pragmas(text: str) -> Tuple[Dict[int, Set[str]], List[int]]:
@@ -246,6 +260,7 @@ class _FileLinter(ast.NodeVisitor):
         self._rule_nns108(node, dotted)
         self._rule_nns110(node, dotted)
         self._rule_nns112(node, dotted)
+        self._rule_nns113(node, dotted)
         self.generic_visit(node)
 
     def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
@@ -497,6 +512,21 @@ class _FileLinter(ast.NodeVisitor):
             f"observes",
             hint="pass timeout=, call settimeout(...) in this function, "
                  "set SO_SNDTIMEO/SO_RCVTIMEO, or justify with a pragma")
+
+    def _rule_nns113(self, node: ast.Call, dotted: str) -> None:
+        if dotted != "jax.device_put":
+            return
+        if any(f in _MEM_SANCTIONED_FUNCS for f in self._func_stack):
+            return
+        self.emit(
+            "NNS113", node,
+            "direct jax.device_put outside the HBM budget accountant's "
+            "tracked entry points — the moved bytes never register "
+            "against nns_mem_used_bytes, so the pressure ladder and "
+            "residency eviction math run on an undercount",
+            hint="route the upload through TensorBuffer.to_device/"
+                 "upload_many, register the bytes with tensors/memory.py "
+                 "(residency unit or note_h2d), or justify with a pragma")
 
     def _enclosing_has_timeout_discipline(self) -> bool:
         """True when the innermost enclosing function visibly bounds its

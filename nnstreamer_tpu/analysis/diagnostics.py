@@ -69,6 +69,10 @@ CODE_TABLE: Dict[str, str] = {
     "NNS112": "socket/channel send-recv in a transport hot path without "
               "an explicit timeout (a dead peer hangs the path instead "
               "of feeding the retry/hedge/breaker machinery)",
+    "NNS113": "direct jax.device_put outside the HBM budget accountant's "
+              "tracked entry points (bytes land in device memory that "
+              "nns_mem_used_bytes never sees, so the pressure ladder "
+              "runs on an undercount)",
     "NNS199": "nns-lint pragma without a justification",
 }
 

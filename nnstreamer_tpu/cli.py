@@ -463,6 +463,16 @@ def _print_stats(pipe) -> None:
               f"batch-cap {sched['batch_cap']}, "
               f"inflight {sched['inflight_target']}, "
               f"lanes-hint {sched['lanes_hint']}")
+    mem = full.get("memory")
+    if mem:
+        mib = 1 << 20
+        print(f"-- hbm budget: {mem['used_bytes'] / mib:.1f}/"
+              f"{mem['budget_bytes'] / mib:.1f} MiB used "
+              f"(high-water {mem['high_water_bytes'] / mib:.1f} MiB), "
+              f"{mem['evictions']} evictions / "
+              f"{mem['prefetches']} prefetches, "
+              f"{mem['resident_units']} resident unit(s), "
+              f"{mem['pressure_events']} pressure event(s)")
 
 
 if __name__ == "__main__":

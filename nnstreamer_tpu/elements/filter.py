@@ -198,6 +198,11 @@ class TensorFilter(Element):
             is_updatable=bool(self.get_property("is_updatable")),
             shared_key=self.get_property("shared_tensor_filter_key"),
         )
+        fi = _faults.ACTIVE
+        if fi is not None:
+            # chaos hook: an injected open failure — kind=oom models the
+            # weight load losing the HBM allocation race
+            fi.check("filter.open")
         fw.open(props)
         self.fw = fw
         self._obs_invoke()["opens"].inc()

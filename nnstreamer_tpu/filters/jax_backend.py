@@ -53,6 +53,7 @@ from nnstreamer_tpu.filters.api import (
 )
 from nnstreamer_tpu.config import ARTIFACT_EXTS
 from nnstreamer_tpu.registry import FILTER, subplugin
+from nnstreamer_tpu.tensors import memory as _memory
 from nnstreamer_tpu.tensors.types import TensorInfo, TensorsInfo, TensorType
 
 _registered: Dict[str, dict] = {}
@@ -168,6 +169,9 @@ class JaxFilter(FilterFramework):
         self._jitted: Optional[Callable] = None
         self._device = None
         self._sharding = None
+        #: residency unit holding the device params when an HBM budget
+        #: is active (tensors/memory.py); None = plain resident weights
+        self._resident = None
 
     # -- lifecycle -----------------------------------------------------------
     def open(self, props: FilterProperties) -> None:
@@ -216,7 +220,24 @@ class JaxFilter(FilterFramework):
 
         if self._params is not None:
             tgt = self._sharding.replicated() if self._sharding else self._device
-            self._params = jax.device_put(self._params, tgt)
+            acct = _memory.ACTIVE
+            if acct is not None:
+                # budgeted mode: the weights become an evictable residency
+                # unit — self._params stays the HOST pytree (shapes for
+                # eval_shape), the device copy is fetched per invoke via
+                # the unit so an eviction genuinely frees the HBM
+                host_params = self._params
+
+                def _load(hp, _tgt=tgt):
+                    return jax.device_put(hp, _tgt)
+
+                self._resident = acct.residency.register(
+                    key=f"jax:{id(self)}", host_value=host_params,
+                    nbytes=_memory.pytree_nbytes(host_params),
+                    loader=_load, label=str(model))
+                self._resident.value()  # initial load, under the budget
+            else:
+                self._params = jax.device_put(self._params, tgt)
         self._jitted = None  # (re)built lazily per dtype/shape set
 
     def _load(self, model: str, props: FilterProperties) -> dict:
@@ -255,6 +276,11 @@ class JaxFilter(FilterFramework):
         )
 
     def close(self) -> None:
+        if self._resident is not None:
+            acct = _memory.ACTIVE
+            if acct is not None:
+                acct.residency.unregister(self._resident.key)
+            self._resident = None
         self._fn = self._params = self._jitted = None
         super().close()
 
@@ -297,8 +323,12 @@ class JaxFilter(FilterFramework):
 
         Not fusible with batch sharding or an explicitly-requested platform:
         invoke() places inputs with NamedSharding / onto the chosen device,
-        and a plain fused jit would silently drop that placement."""
+        and a plain fused jit would silently drop that placement. Not
+        fusible either while an HBM budget holds the weights as an
+        evictable residency unit — fused consts would pin the evicted
+        device copy alive and the eviction would free nothing."""
         if self._fn is None or self._sharding is not None or \
+                self._resident is not None or \
                 getattr(self, "_explicit_platform", None):
             return None
         from nnstreamer_tpu.pipeline.fuse import DeviceStage
@@ -321,7 +351,11 @@ class JaxFilter(FilterFramework):
                 dev_inputs.append(x)
             else:
                 tgt = self._sharding.batched() if self._sharding else self._device
-                dev_inputs.append(jax.device_put(x, tgt))
+                dev_inputs.append(jax.device_put(x, tgt))  # nns-lint: disable=NNS113 -- transient invoke input; the frame's bytes are tracked upstream at to_device/upload_many
+        # budgeted mode routes through the residency unit: an evicted
+        # model prefetches back in here (LRU touch per invoke)
+        params = self._resident.value() if self._resident is not None \
+            else self._params
         with self.global_stats().measure():
-            out = self._jitted(self._params, *dev_inputs)
+            out = self._jitted(params, *dev_inputs)
         return out

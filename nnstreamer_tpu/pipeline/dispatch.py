@@ -40,7 +40,7 @@ from typing import Any, Deque, List, Optional, Tuple
 from nnstreamer_tpu.log import get_logger
 from nnstreamer_tpu.obs import timeline as _timeline
 from nnstreamer_tpu.pipeline import faults as _faults
-from nnstreamer_tpu.tensors.buffer import is_device_array
+from nnstreamer_tpu.tensors.buffer import H2D_EXCLUSIVE_META, is_device_array
 
 log = get_logger("dispatch")
 
@@ -49,6 +49,32 @@ log = get_logger("dispatch")
 #: window upload additionally parks its shared window slab on the run's
 #: last buffer here)
 POOL_STASH_META = "pool_stash"
+
+
+def release_shed_payload(buf) -> None:
+    """Release a shed/revoked frame's device payload and pool pins NOW.
+
+    A frame the EDF scheduler sheds (or that admission revokes) never
+    reaches a fence, so nothing would release its staged pool slabs or
+    drop its freshly-uploaded device tensors until GC happens to find
+    the dead wrapper — shed work silently pinning HBM and slab bytes is
+    exactly the failure mode the memory budget exists to prevent. Safe
+    on any buffer: pops the fence-deferred ``pool_stash`` back to the
+    pool, and clears the device tensor list only when the payload is
+    marked ``h2d_exclusive`` (an upload point created it for exactly one
+    downstream consumer — us — so no other reader exists)."""
+    meta = getattr(buf, "meta", None)
+    if meta is None or not hasattr(meta, "pop"):
+        return
+    stash = meta.pop(POOL_STASH_META, None)
+    if stash:
+        from nnstreamer_tpu.tensors.pool import get_pool
+
+        get_pool().release_many(stash)
+    if meta.pop(H2D_EXCLUSIVE_META, None):
+        tensors = getattr(buf, "tensors", None)
+        if tensors and all(is_device_array(t) for t in tensors):
+            tensors.clear()
 
 
 class DispatchWindow:

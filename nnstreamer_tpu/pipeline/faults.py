@@ -6,8 +6,10 @@ demand, repeatably. This module is a seeded, spec-driven injector with
 hooks at the five places the async substrate can actually fail:
 
 - ``filter.invoke``   — backend invoke in ``elements/filter.py``
+- ``filter.open``     — backend open / weight load (``elements/filter.py``)
 - ``transfer.h2d``    — host→device upload (``tensors/buffer.py``)
 - ``transfer.d2h``    — device→host materialization (``tensors/buffer.py``)
+- ``pool.alloc``      — pool slab growth (``tensors/pool.py``)
 - ``lane.worker``     — per-frame lane worker loop (``pipeline/lanes.py``)
 - ``queue.push``      — queue ingress (``pipeline/pipeline.py``)
 - ``dispatch.fence``  — dispatch-window fence (``pipeline/dispatch.py``)
@@ -33,7 +35,11 @@ Per-site keys:
 - ``kind``  — ``raise`` (ordinary exception, recoverable under an
   error-policy), ``crash`` (simulated abrupt worker death — lane
   supervision treats it as a restart, everything else like ``raise``),
-  ``stall`` (sleep ``ms`` milliseconds — watchdog bait), or one of the
+  ``stall`` (sleep ``ms`` milliseconds — watchdog bait), ``oom``
+  (simulated device-memory exhaustion — raises :class:`InjectedOom`,
+  which the supervision layer's memory-pressure ladder recovers: evict
+  residency units → release pools → shed at admission → CPU fallback;
+  see ``tensors/memory.py`` and docs/robustness.md), or one of the
   transport kinds ``drop`` (the bytes silently vanish), ``disconnect``
   (the connection dies mid-operation), ``corrupt`` (the bytes arrive
   mangled). Transport kinds are interpreted by :meth:`FaultInjector.
@@ -82,12 +88,13 @@ _ENV = "NNSTPU_FAULTS"
 _ENV_SEED = "NNSTPU_FAULTS_SEED"
 
 #: the injection-hook sites wired through the async substrate
-SITES: Tuple[str, ...] = ("filter.invoke", "transfer.h2d", "transfer.d2h",
+SITES: Tuple[str, ...] = ("filter.invoke", "filter.open",
+                          "transfer.h2d", "transfer.d2h", "pool.alloc",
                           "lane.worker", "queue.push", "dispatch.fence",
                           "query.send", "query.recv", "grpc.call",
                           "mqtt.publish")
 
-KINDS: Tuple[str, ...] = ("raise", "crash", "stall",
+KINDS: Tuple[str, ...] = ("raise", "crash", "stall", "oom",
                           "drop", "disconnect", "corrupt")
 
 #: kinds a transport hook interprets itself (returned by :meth:`action`)
@@ -117,6 +124,17 @@ class InjectedCrash(InjectedFault):
 
     def __init__(self, site: str, n: int):
         super().__init__(site, n, kind="crash")
+
+
+class InjectedOom(InjectedFault):
+    """``kind=oom``: simulated device-memory exhaustion (the shape of a
+    real ``RESOURCE_EXHAUSTED``). Under ``error-policy=degrade`` the
+    supervision layer routes this through the memory-pressure ladder
+    (evict → pool → shed → cpu) instead of the plain reload ladder;
+    everywhere else it behaves like :class:`InjectedFault`."""
+
+    def __init__(self, site: str, n: int):
+        super().__init__(site, n, kind="oom")
 
 
 @dataclasses.dataclass
@@ -287,6 +305,8 @@ class FaultInjector:
             return
         if rule.kind == "crash":
             raise InjectedCrash(site, n)
+        if rule.kind == "oom":
+            raise InjectedOom(site, n)
         raise InjectedFault(site, n, kind=rule.kind)
 
     def action(self, site: str, seq: Optional[int] = None) -> Optional[str]:
@@ -306,6 +326,8 @@ class FaultInjector:
             return None
         if rule.kind == "crash":
             raise InjectedCrash(site, n)
+        if rule.kind == "oom":
+            raise InjectedOom(site, n)
         if rule.kind == "raise":
             raise InjectedFault(site, n)
         return rule.kind

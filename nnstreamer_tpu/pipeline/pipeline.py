@@ -37,6 +37,7 @@ from nnstreamer_tpu.pipeline.element import (
     Pad,
 )
 from nnstreamer_tpu.registry import ELEMENT, subplugin
+from nnstreamer_tpu.tensors import memory as _memory
 from nnstreamer_tpu.tensors.buffer import TensorBuffer
 
 log = get_logger("pipeline")
@@ -439,10 +440,18 @@ class Queue(Element):
                         # outlier) and count the revocation so admitted
                         # accounting nets out
                         if not (dropped is self._EOS
-                                or isinstance(dropped, Event)) and \
-                                dropped.meta.pop("admitted_t",
-                                                 None) is not None:
-                            self._m_adm_revoked.inc()
+                                or isinstance(dropped, Event)):
+                            if dropped.meta.pop("admitted_t",
+                                                None) is not None:
+                                self._m_adm_revoked.inc()
+                            # the dropped frame never reaches a fence:
+                            # release its staged pool slabs / exclusive
+                            # device payload now, not at GC
+                            from nnstreamer_tpu.pipeline.dispatch import (
+                                release_shed_payload,
+                            )
+
+                            release_shed_payload(dropped)
                     except _queue.Empty:
                         pass
         else:
@@ -938,6 +947,8 @@ class Pipeline:
                             for ex in self._lane_execs}
         if self._slo_scheduler is not None:
             out["scheduler"] = self._slo_scheduler.snapshot()
+        if _memory.ACTIVE is not None:
+            out["memory"] = _memory.ACTIVE.snapshot()
         return out
 
     # -- state ----------------------------------------------------------------
@@ -956,6 +967,10 @@ class Pipeline:
         # NNSTPU_FAULTS unset leaves faults.ACTIVE None and every hook
         # is one attribute read on the byte-identical path
         _faults.maybe_activate_env()
+        # HBM budget accountant (tensors/memory.py): same kill switch —
+        # NNSTPU_HBM_BUDGET unset leaves memory.ACTIVE None and no
+        # accounting hook anywhere ever fires
+        _memory.maybe_activate_env()
         sources = [e for e in self.elements if isinstance(e, SourceElement)]
         others = [e for e in self.elements if not isinstance(e, SourceElement)]
         # SLO scheduler before any element starts: admission-point

@@ -24,6 +24,15 @@ property, enforced at the uniform ``_chain_entry`` boundary
   presumed sick) and retry once more; still failing → ``halt``. Other
   elements fall back to ``retry`` semantics.
 
+  A *memory-pressure* failure (injected ``kind=oom`` or a real
+  ``RESOURCE_EXHAUSTED``) takes the memory ladder instead — in order:
+  **evict** cold residency units to host staging
+  (``tensors/memory.py``) → **pool**: drain the dispatch window and
+  release every pool arena's free slabs → **shed**: raise the SLO
+  scheduler's memory-backlog term so new frames shed at admission →
+  **cpu**: reopen ``accelerator=cpu``, today's last rung. Each rung
+  retries the frame; zero frame loss when any rung recovers.
+
 The **watchdog** (:class:`PipelineWatchdog`) is the liveness half: a
 thread that samples a pipeline-wide progress vector (chain invokes,
 lane deliveries, sink completions) and, when in-flight work exists but
@@ -233,12 +242,30 @@ def _retry(el, pad, buf, exc: BaseException,
         f"attempt(s): {last}") from last
 
 
+def _is_memory_pressure(exc: BaseException) -> bool:
+    """Discriminate an OOM-class failure from an ordinary backend fault:
+    an injected ``kind=oom`` fault, or a runtime error whose text carries
+    the XLA/driver exhaustion signatures."""
+    from nnstreamer_tpu.pipeline.faults import InjectedFault
+
+    if isinstance(exc, InjectedFault):
+        return exc.kind == "oom"
+    text = str(exc).lower()
+    return ("resource_exhausted" in text or "out of memory" in text
+            or "ran out of memory" in text)
+
+
 def _degrade(el, pad, buf, exc: BaseException) -> FlowReturn:
     """The tensor_filter degrade ladder: (1) reload the backend in place
     and retry — a wedged session/compilation cache is the common
     transient; (2) reopen with ``accelerator=cpu`` and retry — the
     accelerator is presumed sick, serve degraded rather than die;
-    (3) halt. Elements without a backend get ``retry`` semantics."""
+    (3) halt. Elements without a backend get ``retry`` semantics.
+    OOM-class failures take :func:`_pressure_ladder` instead — the
+    accelerator isn't sick, it's FULL, and a reload would re-lose the
+    same allocation race."""
+    if _is_memory_pressure(exc):
+        return _pressure_ladder(el, pad, buf, exc)
     if not hasattr(el, "_open_fw"):
         log.warning("%s: error-policy=degrade on a non-filter element — "
                     "applying retry semantics", el.name)
@@ -273,6 +300,100 @@ def _degrade(el, pad, buf, exc: BaseException) -> FlowReturn:
         f"(reload + CPU fallback both failed): {last}") from last
 
 
+def _pressure_ladder(el, pad, buf, exc: BaseException) -> FlowReturn:
+    """The memory-pressure rungs, in escalation order (see
+    ``tensors/memory.py`` PRESSURE_RUNGS and docs/robustness.md):
+
+    1. ``evict`` — drop every resident weight unit to host staging; the
+       one this frame needs prefetches back in on the retry.
+    2. ``pool``  — drain the element's dispatch window (outstanding
+       batches release their staging stashes) and free every pool
+       arena's free-listed slabs.
+    3. ``shed``  — tell the SLO scheduler to shed at admission for a
+       while (memory-backlog term) so retried work isn't racing fresh
+       arrivals for the same headroom; reclaim again.
+    4. ``cpu``   — reopen with ``accelerator=cpu`` (filters only): host
+       RAM is the spill of last resort, exactly today's final rung.
+
+    Every rung counts ``nns_fault_degraded_total`` and
+    ``nns_mem_pressure_events_total{rung=...}`` and marks the ledger, so
+    a recovery is attributable to the rung that made room."""
+    m = _metrics(el)
+    last = exc
+    rungs = ["evict", "pool", "shed"]
+    if hasattr(el, "_open_fw"):
+        rungs.append("cpu")
+    for rung in rungs:
+        m["degraded"].inc()
+        _mark("fault_degrade", buf, element=el.name, stage=rung)
+        _count_pressure_rung(rung)
+        try:
+            _apply_pressure_rung(el, rung)
+        except Exception as e:  # noqa: BLE001 — a failed rung is just a
+            # failed rung; escalation continues and halt is below
+            el.log.warning("%s: pressure rung %r failed: %s",
+                           el.name, rung, e)
+            last = e
+            continue
+        m["retries"].inc()
+        try:
+            ret = el.chain(pad, buf)
+        except Exception as e:  # noqa: BLE001 — next rung or halt below
+            last = e
+            continue
+        m["recovered"].inc()
+        el.log.warning("%s: recovered from memory pressure at rung %r "
+                       "(first failure: %s)", el.name, rung, exc)
+        return FlowReturn.OK if ret is None else ret
+    raise FlowError(
+        f"{el.name}: memory-pressure ladder exhausted "
+        f"({' → '.join(rungs)} all failed): {last}") from last
+
+
+def _count_pressure_rung(rung: str) -> None:
+    import sys
+
+    mem = sys.modules.get("nnstreamer_tpu.tensors.memory")
+    if mem is not None and mem.ACTIVE is not None:
+        mem.ACTIVE.pressure_events += 1
+        mem.ACTIVE.count_pressure(rung)
+
+
+def _apply_pressure_rung(el, rung: str) -> None:
+    """The reclamation action for one rung (no retry here — the caller
+    owns the retry loop)."""
+    import sys
+
+    mem = sys.modules.get("nnstreamer_tpu.tensors.memory")
+    acct = mem.ACTIVE if mem is not None else None
+    if rung == "evict":
+        if acct is not None:
+            acct.residency.evict_all()
+        return
+    if rung == "pool":
+        from nnstreamer_tpu.tensors.pool import release_all_pools
+
+        window = getattr(el, "_window", None)
+        if window is not None:
+            window.drain(on_error="log")
+        release_all_pools()
+        return
+    if rung == "shed":
+        sched = getattr(el.pipeline, "_slo_scheduler", None)
+        if sched is not None:
+            sched.note_memory_pressure()
+        # shedding only relieves FUTURE admissions; this frame still
+        # needs room now, so run the reclamation rungs again too
+        if acct is not None:
+            acct.residency.evict_all()
+        from nnstreamer_tpu.tensors.pool import release_all_pools
+
+        release_all_pools()
+        return
+    if rung == "cpu":
+        _reopen_backend(el, force_cpu=True)
+
+
 def _reopen_backend(el, force_cpu: bool) -> None:
     """Close and reopen a tensor_filter's backend, optionally pinned to
     the CPU. Outstanding dispatches read the old backend's params, so
@@ -281,6 +402,12 @@ def _reopen_backend(el, force_cpu: bool) -> None:
     window = getattr(el, "_window", None)
     if window is not None:
         window.drain(on_error="log")
+    # the drained window just released its staging stashes — return the
+    # arenas' free slabs too: a reopen (especially force_cpu) means the
+    # old working set's peak-rate slabs are dead weight
+    from nnstreamer_tpu.tensors.pool import release_all_pools
+
+    release_all_pools()
     if el.fw is not None:
         try:
             el.fw.close()
@@ -424,4 +551,10 @@ class PipelineWatchdog:
         for el in self.pipeline.elements:
             if isinstance(el, SourceElement):
                 el._stop_evt.set()
+        # a stalled pipeline's staging arenas hold its peak working set;
+        # nothing will recycle them while the stall holds, so free the
+        # pools' idle slabs as part of failing it
+        from nnstreamer_tpu.tensors.pool import release_all_pools
+
+        release_all_pools()
         self.pipeline.post_error(None, err)

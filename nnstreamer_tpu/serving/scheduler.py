@@ -227,6 +227,11 @@ class SloScheduler:
             batch_cap_max=batch_cap_max, inflight=inflight0,
             inflight_max=inflight_max)
         self._lanes_hint = self._current_lanes()
+        #: decaying synthetic backlog set by the supervision layer's
+        #: memory-pressure ladder (shed rung): each admission decision
+        #: consumes one unit, so a pressure burst sheds at the door for
+        #: a bounded run of arrivals and then self-heals
+        self._mem_hold = 0
         self._obs_ready = False
         self._m: Dict[str, Any] = {}
         self._obs_init()
@@ -313,14 +318,52 @@ class SloScheduler:
         ``(admit, deadline_t, slack_s)``. ``backlog`` is the number of
         frames already ahead of this one (queued + undelivered); the
         estimated completion is ``now + (backlog + 1) * service_time``.
-        A cold estimator (service_time 0) admits everything."""
+        Device-memory pressure adds a synthetic memory-backlog term
+        (:meth:`_memory_backlog`) so an HBM-bound pipeline sheds at the
+        door instead of OOM-ing mid-pipeline. A cold estimator
+        (service_time 0) admits everything."""
         budget_s = (float(budget_ms) / 1e3 if budget_ms else self.budget_s)
         if deadline_t is None:
             deadline_t = now + budget_s
-        est_done = now + (max(0, backlog) + 1) * \
+        est_done = now + \
+            (max(0, backlog) + 1 + self._memory_backlog()) * \
             self.estimator.service_time_s()
         slack = deadline_t - est_done
         return slack >= 0.0, deadline_t, slack
+
+    def _memory_backlog(self) -> int:
+        """The admission-side memory-pressure term: the HBM budget
+        accountant's current overage expressed in frames, plus the
+        decaying hold the supervision ladder's shed rung requested. Pure
+        state reads — no waits, no clock (NNS110-safe); zero with no
+        accountant and no pressure (the kill-switch path is one dict
+        lookup)."""
+        import sys
+
+        extra = 0
+        mem = sys.modules.get("nnstreamer_tpu.tensors.memory")
+        if mem is not None and mem.ACTIVE is not None:
+            extra = mem.ACTIVE.admission_backlog()
+        hold = self._mem_hold
+        if hold > 0:
+            self._mem_hold = hold - 1  # one unit per admission decision
+        return extra + hold
+
+    def note_memory_pressure(self, frames: int = 8) -> None:
+        """The pressure ladder's shed rung: hold admission down for the
+        next ``frames`` decisions while reclamation and retries race
+        fresh arrivals for the same headroom."""
+        self._mem_hold = max(self._mem_hold, int(frames))
+        m = self._m.get("mem_pressure")
+        if m is None:
+            from nnstreamer_tpu.obs import get_registry
+
+            m = self._m["mem_pressure"] = get_registry().counter(
+                "nns_sched_mem_pressure_total",
+                "Memory-pressure shed requests from the supervision "
+                "ladder (admission held down while reclamation runs)",
+                pipeline=self.name)
+        m.inc()
 
     def admit(self, buf, now: float, backlog: int,
               budget_ms: Optional[float] = None) -> bool:
@@ -371,6 +414,12 @@ class SloScheduler:
         buf.meta.pop("admitted_t", None)
         buf.meta.pop("deadline_t", None)
         self._m["shed_late" if late else "shed_capacity"].inc()
+        # a shed frame never reaches a dispatch fence: release its pool
+        # staging stash and an exclusively-owned device payload now
+        # rather than letting shed work pin HBM/slabs until GC
+        from nnstreamer_tpu.pipeline.dispatch import release_shed_payload
+
+        release_shed_payload(buf)
         if "_net_expire" in buf.meta:
             # the frame arrived over the query wire with a propagated
             # deadline: tell the origin client it was shed so its
@@ -457,6 +506,7 @@ class SloScheduler:
             "controller_steps": c.steps,
             "p99_ms": round((c.last_p99_s or 0.0) * 1e3, 3),
             "lanes_hint": self._lanes_hint,
+            "memory_hold": self._mem_hold,
         }
 
     def shed_total(self) -> int:

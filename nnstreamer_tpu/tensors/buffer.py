@@ -174,6 +174,21 @@ def _fault_check(site: str, meta: Dict[str, Any]) -> None:
     faults.ACTIVE.check(site, seq=meta.get(_timeline.TRACE_SEQ_META))
 
 
+def _mem_note_h2d(nbytes: int, owner) -> None:
+    """Register an H2D transfer's bytes with the HBM budget accountant
+    (``tensors/memory.py``); ``owner`` is the Python buffer wrapper whose
+    death releases the device payload, so the accountant's ``frames``
+    category tracks the live device working set. Same ``sys.modules``
+    kill-switch shape as :func:`_fault_check`: no accountant, one dict
+    lookup, out."""
+    import sys
+
+    mem = sys.modules.get("nnstreamer_tpu.tensors.memory")
+    if mem is None or mem.ACTIVE is None:
+        return
+    mem.ACTIVE.note_h2d(nbytes, owner)
+
+
 def record_residency_entry(resident: bool) -> None:
     """Tally one DeviceBuffer pad entry: ``resident`` means the element
     declared DEVICE_PASSTHROUGH and the buffer crossed the pad without a
@@ -334,11 +349,13 @@ class TensorBuffer:
                     if not is_device_array(t))
         out = [jax.device_put(t, tgt) if tgt is not None else jax.device_put(t)
                for t in self.tensors]
+        buf = self.replace(tensors=out)
         if moved:
             _fault_check("transfer.h2d", self.meta)
             _record_h2d(moved)
             _tl_xfer_span("h2d", self.meta, t0, nbytes=moved)
-        return self.replace(tensors=out)
+            _mem_note_h2d(moved, buf)
+        return buf
 
     def pad_rows_device(self) -> "TensorBuffer":
         """Apply a deferred partial-window pad (aggregator
@@ -572,7 +589,12 @@ def upload_many(bufs: List[TensorBuffer]) -> (
         nb.meta[H2D_EXCLUSIVE_META] = True
         # the pre-upload host arrays become the wrapper's zero-copy host
         # view, exactly like the per-buffer prefetch path
-        out.append(as_device_buffer(nb, host_view=list(b.tensors)))
+        wrapped = as_device_buffer(nb, host_view=list(b.tensors))
+        # each frame view shares the window's device slabs; the budget
+        # accountant sees a per-frame share so the frames category tracks
+        # the live working set as views die
+        _mem_note_h2d(moved // k, wrapped)
+        out.append(wrapped)
     return out, slabs
 
 

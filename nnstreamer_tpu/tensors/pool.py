@@ -66,6 +66,42 @@ def _size_class(nbytes: int) -> int:
     return 1 << (nbytes - 1).bit_length()
 
 
+def _alloc_fault_check(nbytes: int) -> None:
+    """``pool.alloc`` chaos hook on the slab-growth (miss) path only —
+    the recycled-slab hot path never pays. Resolved via sys.modules so
+    the tensors layer never imports the pipeline layer: an injector can
+    only exist once its module is imported."""
+    import sys
+
+    faults = sys.modules.get("nnstreamer_tpu.pipeline.faults")
+    if faults is None:
+        return
+    fi = faults.ACTIVE
+    if fi is not None:
+        fi.check("pool.alloc")
+
+
+def _mem_account(nbytes: int, grow: bool) -> None:
+    """Register/un-register slab bytes with the HBM budget accountant
+    (``tensors/memory.py``). Pool slabs are host staging, but they are
+    pinned transfer sources whose lifetime bounds device windows — the
+    accountant tracks them as the ``pool`` category so the pressure
+    ladder's release-pools rung has a number to reclaim. No accountant
+    (the default) means one dict lookup and out."""
+    import sys
+
+    mem = sys.modules.get("nnstreamer_tpu.tensors.memory")
+    if mem is None:
+        return
+    acct = mem.ACTIVE
+    if acct is None:
+        return
+    if grow:
+        acct.register(nbytes, "pool")
+    else:
+        acct.unregister(nbytes, "pool")
+
+
 class BufferPool:
     """Thread-safe, size-classed pool of aligned host staging buffers."""
 
@@ -144,7 +180,9 @@ class BufferPool:
             self.grows += 1
             obs["misses"].inc()
             obs["grows"].inc()
+            _alloc_fault_check(cls + self.align)
             slab = np.empty(cls + self.align, np.uint8)
+            _mem_account(cls + self.align, grow=True)
         else:
             self.hits += 1
             obs["hits"].inc()
@@ -181,10 +219,14 @@ class BufferPool:
             # view's .base (tp_dealloc fires weakref callbacks before it
             # drops the instance's own references) == 3
             if sys.getrefcount(slab) > 3:
-                return  # a derived view is still live — never alias it
+                # a derived view is still live — never alias it
+                _mem_account(cls + self.align, grow=False)
+                return
             free = self._free.setdefault(cls, [])
             if len(free) < self.max_per_class:
                 free.append(slab)
+            else:
+                _mem_account(cls + self.align, grow=False)
 
     def owns(self, arr) -> bool:
         """True if ``arr`` is a view this pool handed out (not a derived
@@ -241,10 +283,13 @@ class BufferPool:
             # slab) is still live somewhere — drop the slab instead of
             # recycling it under that reader
             if sys.getrefcount(slab) > 3:
+                _mem_account(cls + self.align, grow=False)
                 return True
             free = self._free.setdefault(cls, [])
             if len(free) < self.max_per_class:
                 free.append(slab)
+            else:
+                _mem_account(cls + self.align, grow=False)
             return True
 
     def release_many(self, arrs) -> int:
@@ -296,7 +341,11 @@ class BufferPool:
         this so a stopped pipeline's staging arenas don't pin peak-rate
         slab bytes for the life of the process."""
         with self._lock:
+            dropped = sum((cls + self.align) * len(v)
+                          for cls, v in self._free.items())
             self._free.clear()
+        if dropped:
+            _mem_account(dropped, grow=False)
 
 
 def release_all_pools() -> None:

@@ -1,0 +1,513 @@
+"""HBM budget accounting, weight residency, and the memory-pressure
+ladder (tensors/memory.py, pipeline/supervise.py, serving/scheduler.py).
+
+The contract under test, per docs/profiling.md ("HBM budget") and
+docs/robustness.md ("Memory-pressure ladder"):
+
+- ``NNSTPU_HBM_BUDGET`` unset means ``memory.ACTIVE is None`` and every
+  hook is a single module-attribute read — the pipeline is
+  byte-identical to a build without the accountant;
+- every pool slab, H2D frame upload, and backend weight load registers
+  its bytes against the budget; the high-water mark is the pipeline's
+  true HBM footprint;
+- under a budget smaller than the summed weights, two models
+  time-share HBM through the residency manager (LRU evict to host,
+  prefetch-on-route back) and the output stays byte-identical;
+- an injected ``kind=oom`` fault under ``error-policy=degrade`` climbs
+  the pressure ladder (evict -> pool -> shed -> cpu) and recovers with
+  zero frame loss, without reaching the cpu rung;
+- frames shed by the scheduler (or revoked at admission) release their
+  device payload and pool pins immediately, not at GC;
+- repeated degrade cycles in one run reopen the backend exactly once
+  per fault and leave no dispatch window entries behind.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from nnstreamer_tpu import parse_launch
+from nnstreamer_tpu.filters.jax_backend import (
+    register_jax_model,
+    unregister_jax_model,
+)
+from nnstreamer_tpu.obs import get_registry
+from nnstreamer_tpu.pipeline import faults
+from nnstreamer_tpu.pipeline.dispatch import (
+    H2D_EXCLUSIVE_META,
+    POOL_STASH_META,
+    release_shed_payload,
+)
+from nnstreamer_tpu.serving.scheduler import SloScheduler
+from nnstreamer_tpu.tensors import memory
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
+from nnstreamer_tpu.tensors.pool import BufferPool, get_pool
+
+# -- helpers ------------------------------------------------------------------
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    faults.deactivate()
+    memory.deactivate()
+    yield
+    faults.deactivate()
+    memory.deactivate()
+
+
+def _cval(name, **labels):
+    m = get_registry().get(name, **labels)
+    return 0.0 if m is None else m.value
+
+
+def _register_ballast_model(name, scale, shape=(128, 128)):
+    """A jax model carrying ``shape`` float32 ballast params (64 KiB at
+    the default) whose output depends on the params — an eviction that
+    lost or corrupted the weights would show up in the bytes."""
+    ballast = jnp.ones(shape, jnp.float32) * scale
+    register_jax_model(
+        name, lambda p, x: (x.astype(jnp.float32) * p["w"][0, 0],),
+        {"w": ballast})
+    return int(np.prod(shape)) * 4
+
+
+def _run_video_pipe(desc, policy="halt", timeout=120):
+    pipe = parse_launch(desc, error_policy=policy)
+    outs = []
+    pipe.get("out").connect(
+        lambda b: outs.append(np.asarray(b.tensors[0]).copy()))
+    msg = pipe.run(timeout=timeout)
+    assert msg is not None and msg.kind == "eos", msg
+    return pipe, outs
+
+
+def _assert_streams_equal(base, outs):
+    assert len(base) == len(outs), (len(base), len(outs))
+    for i, (a, b) in enumerate(zip(base, outs)):
+        assert a.dtype == b.dtype and np.array_equal(a, b), \
+            f"frame {i} diverged"
+
+
+# -- parse_bytes --------------------------------------------------------------
+
+
+class TestParseBytes:
+    @pytest.mark.parametrize("text,expect", [
+        ("512", 512),
+        ("512b", 512),
+        ("4k", 4 << 10),
+        ("16K", 16 << 10),
+        ("6m", 6 << 20),
+        ("2g", 2 << 30),
+        (" 8M ", 8 << 20),
+    ])
+    def test_suffixes(self, text, expect):
+        assert memory.parse_bytes(text) == expect
+
+    @pytest.mark.parametrize("text", ["", "cat", "12q", "-4k", "0"])
+    def test_rejects(self, text):
+        with pytest.raises(ValueError):
+            memory.parse_bytes(text)
+
+
+# -- the accountant -----------------------------------------------------------
+
+
+class TestBudgetAccounting:
+    def test_register_unregister_and_high_water(self):
+        acct = memory.activate(1000)
+        acct.register(400, "pool", reclaim=False)
+        acct.register(300, "frames", reclaim=False)
+        assert acct.used_bytes() == 700
+        assert acct.headroom() == 300
+        assert not acct.breached()
+        acct.register(500, "weights", reclaim=False)
+        assert acct.breached()
+        assert acct.overage() == 200
+        assert acct.high_water == 1200
+        acct.unregister(300, "frames")
+        acct.unregister(500, "weights")
+        assert acct.used_bytes() == 400
+        # high water never retreats
+        assert acct.high_water == 1200
+        snap = acct.snapshot()
+        assert snap["budget_bytes"] == 1000
+        assert snap["used_bytes"] == 400
+        assert snap["used_by_category"] == {"pool": 400}
+        assert snap["high_water_bytes"] == 1200
+
+    def test_underflow_warns_but_never_goes_negative(self):
+        acct = memory.activate(1000)
+        acct.register(100, "pool", reclaim=False)
+        acct.unregister(250, "pool")  # over-release: clamp, don't raise
+        assert acct.used_bytes() == 0
+        assert "pool" not in acct.snapshot()["used_by_category"]
+
+    def test_env_kill_switch(self, monkeypatch):
+        monkeypatch.delenv("NNSTPU_HBM_BUDGET", raising=False)
+        assert memory.maybe_activate_env() is None
+        assert memory.ACTIVE is None
+        monkeypatch.setenv("NNSTPU_HBM_BUDGET", "64k")
+        acct = memory.maybe_activate_env()
+        assert acct is memory.ACTIVE and acct.limit == 64 << 10
+        # an explicitly installed accountant wins over the env
+        explicit = memory.activate(123)
+        monkeypatch.setenv("NNSTPU_HBM_BUDGET", "1g")
+        assert memory.maybe_activate_env() is explicit
+        assert memory.ACTIVE.limit == 123
+
+    def test_pool_slabs_register_and_release(self):
+        acct = memory.activate(1 << 20)
+        pool = BufferPool(name="membudget-test")
+        a = pool.acquire((1024,), np.uint8)
+        held = acct.snapshot()["used_by_category"].get("pool", 0)
+        assert held >= 1024
+        pool.release(a)
+        # a free-listed slab is still device-addressable memory: it
+        # stays registered until the pool actually drops it
+        assert acct.snapshot()["used_by_category"].get("pool", 0) == held
+        del a
+        pool.clear()
+        assert acct.snapshot()["used_by_category"].get("pool", 0) == 0
+
+    def test_h2d_bytes_track_the_wrapper_lifetime(self):
+        acct = memory.activate(1 << 20)
+
+        class Owner:
+            pass
+
+        o = Owner()
+        acct.note_h2d(4096, owner=o)
+        assert acct.snapshot()["used_by_category"].get("frames", 0) == 4096
+        del o
+        import gc
+
+        gc.collect()
+        assert acct.snapshot()["used_by_category"].get("frames", 0) == 0
+
+
+# -- residency ----------------------------------------------------------------
+
+
+class TestResidencyManager:
+    @staticmethod
+    def _loader(host):
+        # stand-in for jax.device_put: a distinct object wrapping host
+        return [np.asarray(h).copy() for h in host]
+
+    def test_lru_evicts_coldest_and_prefetches_back(self):
+        acct = memory.activate(10_000)
+        res = acct.residency
+        a = res.register("a", [np.arange(8)], 4000, self._loader)
+        b = res.register("b", [np.arange(8) * 2], 4000, self._loader)
+        c = res.register("c", [np.arange(8) * 3], 4000, self._loader)
+        # register does not load
+        assert res.resident_count() == 0
+        va, vb = a.value(), b.value()
+        assert a.resident and b.resident and res.resident_count() == 2
+        assert np.array_equal(va[0], np.arange(8))
+        # loading c must evict the coldest (a), not b
+        c.value()
+        assert not a.resident and b.resident and c.resident
+        assert acct.used_bytes() == 8000
+        # a LRU touch protects b: reload a -> b is now coldest, evicted
+        va2 = a.value()
+        assert a.resident and not b.resident and c.resident
+        assert np.array_equal(va2[0], np.arange(8)), \
+            "reloaded weights diverged from host staging"
+        snap = acct.snapshot()
+        assert snap["evictions"] == 2
+        assert snap["prefetches"] == 1  # a's second load; c's first isn't
+        assert a.loads == 2 and a.evictions == 1
+
+    def test_unregister_frees_budget(self):
+        acct = memory.activate(10_000)
+        res = acct.residency
+        u = res.register("u", [np.zeros(4)], 4000, self._loader)
+        u.value()
+        assert acct.used_bytes() == 4000
+        res.unregister("u")
+        assert acct.used_bytes() == 0
+        assert res.resident_count() == 0
+
+    def test_breach_reclaims_cold_units_inline(self):
+        acct = memory.activate(8000)
+        res = acct.residency
+        u = res.register("u", [np.zeros(4)], 4000, self._loader)
+        u.value()
+        # a non-weight registration that breaches the budget evicts the
+        # cold unit inline (pressure rung 1, no supervisor involved)
+        acct.register(6000, "frames")
+        assert not u.resident
+        assert acct.snapshot()["pressure_events"] >= 1
+        acct.unregister(6000, "frames")
+
+
+# -- oom fault kind -----------------------------------------------------------
+
+
+class TestInjectedOom:
+    @pytest.mark.parametrize("site", [
+        "pool.alloc", "transfer.h2d", "filter.open", "filter.invoke"])
+    def test_oom_raises_at_every_contract_site(self, site):
+        fi = faults.activate(f"{site}:nth=1,kind=oom", seed=3)
+        with pytest.raises(faults.InjectedOom) as ei:
+            fi.check(site)
+        assert ei.value.kind == "oom"
+        assert site in str(ei.value)
+        faults.deactivate()
+
+    def test_oom_is_classified_as_memory_pressure(self):
+        from nnstreamer_tpu.pipeline.supervise import _is_memory_pressure
+
+        assert _is_memory_pressure(faults.InjectedOom("pool.alloc", 1))
+        assert _is_memory_pressure(RuntimeError("RESOURCE_EXHAUSTED: ..."))
+        assert _is_memory_pressure(
+            RuntimeError("jaxlib: ran out of memory allocating 1g"))
+        assert not _is_memory_pressure(RuntimeError("shape mismatch"))
+
+    def test_pool_alloc_site_fires_on_slab_miss(self):
+        fi = faults.activate("pool.alloc:nth=1,kind=oom", seed=3)
+        pool = BufferPool(name="oomsite-test")
+        with pytest.raises(faults.InjectedOom):
+            pool.acquire((64,), np.uint8)
+        # the nth=1 rule is spent; a retry allocates fine (and a free-
+        # list hit never re-enters the allocator site at all)
+        a = pool.acquire((64,), np.uint8)
+        pool.release(a)
+        b = pool.acquire((64,), np.uint8)
+        assert fi.injected("pool.alloc") == 1
+        pool.release(b)
+        pool.clear()
+        faults.deactivate()
+
+
+# -- shed/revoked frames free their payload now (satellite) -------------------
+
+
+class TestShedReleasesPayload:
+    def test_pool_stash_returns_to_pool(self):
+        pool = get_pool()
+        arr = pool.acquire((256,), np.uint8)
+        assert id(arr) in pool._out
+        buf = TensorBuffer([np.zeros(4, np.float32)])
+        buf.meta[POOL_STASH_META] = [arr]
+        release_shed_payload(buf)
+        assert POOL_STASH_META not in buf.meta
+        assert id(arr) not in pool._out
+        del arr
+        pool.clear()
+
+    def test_exclusive_device_payload_is_dropped(self):
+        dev = jnp.ones((4,), jnp.float32)
+        buf = TensorBuffer([dev])
+        buf.meta[H2D_EXCLUSIVE_META] = True
+        release_shed_payload(buf)
+        assert len(buf.tensors) == 0
+        assert H2D_EXCLUSIVE_META not in buf.meta
+
+    def test_shared_payload_is_left_alone(self):
+        host = np.ones(4, np.float32)
+        buf = TensorBuffer([host])  # no exclusivity claim, host tensor
+        release_shed_payload(buf)
+        assert len(buf.tensors) == 1
+
+    def test_scheduler_shed_path_releases(self):
+        sched = SloScheduler(budget_ms=100.0, name="memshed-test")
+        dev = jnp.ones((4,), jnp.float32)
+        buf = TensorBuffer([dev])
+        buf.meta.update({"admitted_t": 0.0, "deadline_t": 0.0,
+                         H2D_EXCLUSIVE_META: True})
+        sched.note_shed(buf, now=1.0)
+        assert "admitted_t" not in buf.meta
+        assert len(buf.tensors) == 0
+
+
+# -- scheduler memory term ----------------------------------------------------
+
+
+class TestSchedulerMemoryTerm:
+    def test_admission_backlog_from_overage(self):
+        acct = memory.activate(1000)
+        assert acct.admission_backlog() == 0
+        acct.register(1500, "weights", reclaim=False)
+        # overage with a cold frame-size estimate: minimum one frame
+        assert acct.admission_backlog() == 1
+        acct._frame_bytes_ewma = 100.0
+        assert acct.admission_backlog() == 5  # 500 over / 100 per frame
+
+    def test_decide_sheds_under_pressure_and_self_heals(self):
+        sched = SloScheduler(budget_ms=50.0, name="memterm-test")
+        sched.observe_service(0.010)  # 10ms per frame, 50ms budget
+        admit, _, _ = sched.decide(now=0.0, backlog=0)
+        assert admit
+        acct = memory.activate(1000)
+        acct.register(2000, "weights", reclaim=False)
+        acct._frame_bytes_ewma = 100.0  # 10 phantom frames of overage
+        admit, _, slack = sched.decide(now=0.0, backlog=0)
+        assert not admit and slack < 0
+        # releasing the overage heals admission with no further action
+        acct.unregister(2000, "weights")
+        admit, _, _ = sched.decide(now=0.0, backlog=0)
+        assert admit
+
+    def test_pressure_hold_decays_per_decision(self):
+        sched = SloScheduler(budget_ms=50.0, name="memhold-test")
+        # 30ms/frame against a 50ms budget: one frame fits, any synthetic
+        # backlog does not
+        sched.observe_service(0.030)
+        sched.note_memory_pressure(frames=2)
+        assert sched.snapshot()["memory_hold"] == 2
+        a1, _, _ = sched.decide(now=0.0, backlog=0)
+        a2, _, _ = sched.decide(now=0.0, backlog=0)
+        assert not a1 and not a2  # held down while the ladder reclaims
+        a3, _, _ = sched.decide(now=0.0, backlog=0)
+        assert a3  # hold consumed: admission self-heals
+        assert sched.snapshot()["memory_hold"] == 0
+
+
+# -- pipelines ----------------------------------------------------------------
+
+
+N_FRAMES = 24
+
+
+def _two_model_desc(n=N_FRAMES):
+    return (f"videotestsrc pattern=ball num-buffers={n} "
+            "width=8 height=8 ! tensor_converter ! "
+            "queue name=q max-size-buffers=8 ! "
+            "tensor_filter framework=jax model=mem_a name=fa ! "
+            "tensor_filter framework=jax model=mem_b name=fb ! "
+            "queue materialize-host=true ! tensor_sink name=out")
+
+
+class TestPipelineUnderBudget:
+    def test_two_models_time_share_hbm_byte_identically(self):
+        wa = _register_ballast_model("mem_a", 2.0)
+        wb = _register_ballast_model("mem_b", 3.0)
+        try:
+            _, base = _run_video_pipe(_two_model_desc())
+            assert len(base) == N_FRAMES
+            assert memory.ACTIVE is None  # baseline ran unbudgeted
+
+            # budget < summed weights: the models cannot both stay
+            # resident, yet the pipeline must serve byte-identically
+            acct = memory.activate(wa + wb - (wb // 2))
+            _, outs = _run_video_pipe(_two_model_desc())
+            snap = acct.snapshot()
+            assert snap["evictions"] > 0, \
+                "models never time-shared HBM under the budget"
+            assert snap["prefetches"] > 0
+            assert snap["high_water_bytes"] < wa + wb
+            _assert_streams_equal(base, outs)
+        finally:
+            unregister_jax_model("mem_a")
+            unregister_jax_model("mem_b")
+
+    def test_budget_unset_is_a_noop(self, monkeypatch):
+        monkeypatch.delenv("NNSTPU_HBM_BUDGET", raising=False)
+        _register_ballast_model("mem_a", 2.0)
+        _register_ballast_model("mem_b", 3.0)
+        try:
+            _, outs = _run_video_pipe(_two_model_desc())
+            assert memory.ACTIVE is None
+            assert len(outs) == N_FRAMES
+        finally:
+            unregister_jax_model("mem_a")
+            unregister_jax_model("mem_b")
+
+
+class TestOomPressureLadder:
+    def _desc(self, n=N_FRAMES):
+        return (f"videotestsrc pattern=ball num-buffers={n} "
+                "width=8 height=8 ! tensor_converter ! "
+                "queue name=q max-size-buffers=8 ! "
+                "tensor_filter framework=jax model=mem_l name=f ! "
+                "queue materialize-host=true ! tensor_sink name=out")
+
+    def test_injected_oom_recovers_zero_loss(self):
+        _register_ballast_model("mem_l", 2.5)
+        labels = dict(pipeline="pipeline", element="f")
+        try:
+            _, base = _run_video_pipe(self._desc())
+
+            memory.activate(1 << 20)
+            fi = faults.activate("filter.invoke:nth=5,kind=oom", seed=7)
+            rec0 = _cval("nns_fault_recovered_total", **labels)
+            evict0 = _cval("nns_mem_pressure_events_total", rung="evict")
+            cpu0 = _cval("nns_mem_pressure_events_total", rung="cpu")
+            pipe, outs = _run_video_pipe(self._desc(), policy="degrade")
+            assert fi.injected("filter.invoke") == 1
+            _assert_streams_equal(base, outs)
+            assert _cval("nns_fault_recovered_total", **labels) == rec0 + 1
+            # the first rung (evict) absorbed it: cpu never reached
+            assert _cval("nns_mem_pressure_events_total",
+                         rung="evict") > evict0
+            assert _cval("nns_mem_pressure_events_total",
+                         rung="cpu") == cpu0
+            assert pipe.get("f")._props.get("accelerator") != "cpu"
+        finally:
+            unregister_jax_model("mem_l")
+
+    def test_oom_without_budget_still_recovers(self):
+        # the ladder must not require the accountant: with no budget the
+        # evict rung is a no-op and the pool/shed rungs do the work
+        _register_ballast_model("mem_l", 2.5)
+        labels = dict(pipeline="pipeline", element="f")
+        try:
+            _, base = _run_video_pipe(self._desc())
+            fi = faults.activate("filter.invoke:nth=5,kind=oom", seed=7)
+            rec0 = _cval("nns_fault_recovered_total", **labels)
+            pipe, outs = _run_video_pipe(self._desc(), policy="degrade")
+            assert fi.injected("filter.invoke") == 1
+            _assert_streams_equal(base, outs)
+            assert _cval("nns_fault_recovered_total", **labels) > rec0
+            assert pipe.get("f")._props.get("accelerator") != "cpu"
+        finally:
+            unregister_jax_model("mem_l")
+
+
+class TestRepeatedDegradeCycles:
+    """Two faults in one run (satellite): each must reopen the backend
+    exactly once, and neither may leak a dispatch window entry or leave
+    the element on the cpu fallback."""
+
+    N = 120
+
+    def _desc(self):
+        return (f"videotestsrc pattern=ball num-buffers={self.N} "
+                "width=8 height=8 ! tensor_converter ! "
+                "queue name=q max-size-buffers=8 ! "
+                "tensor_filter framework=jax model=mem_r name=f ! "
+                "queue materialize-host=true ! tensor_sink name=out")
+
+    def test_two_faults_one_run_no_double_reopen_no_window_leak(self):
+        _register_ballast_model("mem_r", 4.0)
+        labels = dict(pipeline="pipeline", element="f")
+        try:
+            _, base = _run_video_pipe(self._desc())
+
+            fi = faults.activate("filter.invoke:every=50,kind=raise",
+                                 seed=5)
+            opens0 = _cval("nns_tensor_filter_opens_total", **labels)
+            deg0 = _cval("nns_fault_degraded_total", **labels)
+            rec0 = _cval("nns_fault_recovered_total", **labels)
+            pipe, outs = _run_video_pipe(self._desc(), policy="degrade")
+            fired = fi.injected("filter.invoke")
+            assert fired == 2, fired
+            _assert_streams_equal(base, outs)
+
+            el = pipe.get("f")
+            opens = _cval("nns_tensor_filter_opens_total",
+                          **labels) - opens0
+            # initial open + one reload per fault — a double-reopen per
+            # cycle would show up as 5
+            assert opens == 3, opens
+            assert _cval("nns_fault_degraded_total", **labels) == deg0 + 2
+            assert _cval("nns_fault_recovered_total", **labels) == rec0 + 2
+            assert el._props.get("accelerator") != "cpu"
+            assert len(el._window) == 0, "leaked dispatch window entries"
+        finally:
+            unregister_jax_model("mem_r")
