@@ -150,6 +150,27 @@ WARMUP_DRAIN = int(os.environ.get("BENCH_WARMUP_DRAIN", "4"))
 #: pipeline runs the exact pre-scheduler path.
 SLO_BUDGET_MS = float(os.environ.get("BENCH_SLO_BUDGET_MS", "0") or 0)
 
+#: perf gates (the determinism item): the JSON grows a `gates` field
+#: judging fps_median, spread_mad, and saturation p99 against these
+#: thresholds. spread_mad defaults ON (warm spread under 0.15 of the
+#: median); the other two arm via env / the SLO budget.
+#: BENCH_ENFORCE_GATES=1 turns a failing gate into a nonzero exit.
+GATE_FPS_MEDIAN_MIN = float(
+    os.environ.get("BENCH_GATE_FPS_MEDIAN_MIN", "0") or 0)
+GATE_SPREAD_MAD_MAX = float(
+    os.environ.get("BENCH_GATE_SPREAD_MAD_MAX", "0.15") or 0)
+GATE_SAT_P99_MS_MAX = float(
+    os.environ.get("BENCH_GATE_SAT_P99_MS_MAX", "0")
+    or (2.0 * SLO_BUDGET_MS if SLO_BUDGET_MS > 0 else 0))
+ENFORCE_GATES = os.environ.get(
+    "BENCH_ENFORCE_GATES", "").strip().lower() in ("1", "true", "yes", "on")
+
+#: last measured run's flight-recorder harvest (obs/flight.py): the
+#: always-on attribution/SLO snapshot, captured before the pipeline
+#: object is discarded so the JSON can name the dominant-variance stage
+#: without a traced run
+_LAST_FLIGHT: dict = {}
+
 
 def _device_fence() -> None:
     """Block until ALL previously dispatched device work retired.
@@ -577,6 +598,10 @@ def measure_pipeline(batch: int = BATCH) -> dict:
     eos_t = getattr(frame_t, "eos_t", None)
     span = (((eos_t if eos_t is not None else frame_t[-1]) - frame_t[0])
             if len(frame_t) >= 2 else 0.0)
+    fr = getattr(pipe, "_flight", None)
+    if fr is not None:
+        _LAST_FLIGHT["attribution"] = fr.attribution()
+        _LAST_FLIGHT["slo"] = fr.slo_snapshot()
     served_admitted = int(sink.admitted_latencies.count)
     offered = sched["stamped"] + sched["rejected"]
     return dict(fps=_steady_fps(frame_t, frames_per_buffer=batch),
@@ -1444,7 +1469,48 @@ def main():
         "baseline_fps": baseline,
         "platform": _platform(),
     }
+    # flight recorder (obs/flight.py): the always-on attribution from
+    # the last UNtraced measured run — unlike trace_dominant_stage it
+    # costs no dedicated run and reflects the gated repeats themselves
+    fa = _LAST_FLIGHT.get("attribution")
+    result["flight_dominant_stage"] = (fa or {}).get("dominant_stage")
+    result["flight_dominant_share"] = (fa or {}).get("dominant_share")
+    result["gates"] = gates = _perf_gates(
+        fps_median=fps_median, spread_mad=spread_mad,
+        sat_p99_ms=stats["latency_p99_ms"])
     print(json.dumps(result))
+    if ENFORCE_GATES and not gates["ok"]:
+        sys.exit(1)
+
+
+def _perf_gates(fps_median, spread_mad, sat_p99_ms) -> dict:
+    """Judge the run against the determinism gates: the headline median
+    AND the two tail statistics (warm spread as MAD/median, saturation
+    p99 of the admitted population). A threshold of 0/None means that
+    gate is unarmed and passes."""
+    gates = {
+        "fps_median": {
+            "value": round(fps_median, 2),
+            "min": GATE_FPS_MEDIAN_MIN or None,
+            "ok": (not GATE_FPS_MEDIAN_MIN
+                   or fps_median >= GATE_FPS_MEDIAN_MIN),
+        },
+        "spread_mad": {
+            "value": spread_mad,
+            "max": GATE_SPREAD_MAD_MAX or None,
+            "ok": (not GATE_SPREAD_MAD_MAX
+                   or spread_mad <= GATE_SPREAD_MAD_MAX),
+        },
+        "latency_sat_p99_ms": {
+            "value": sat_p99_ms,
+            "max": GATE_SAT_P99_MS_MAX or None,
+            "ok": (not GATE_SAT_P99_MS_MAX or sat_p99_ms is None
+                   or sat_p99_ms <= GATE_SAT_P99_MS_MAX),
+        },
+    }
+    gates["ok"] = all(g["ok"] for g in gates.values()
+                      if isinstance(g, dict))
+    return gates
 
 
 def _resident_ratio():

@@ -70,6 +70,16 @@ These rules encode invariants this codebase has already been burned by
   that ``nns_mem_used_bytes`` never sees, so the pressure ladder and
   residency eviction math (``tensors/memory.py``) run against an
   undercount exactly when HBM is the scarce resource.
+- NNS114: an unbounded container fed from an obs hot-path recording
+  function (``span``/``mark``/``observe``/``record*``/``note*``/
+  ``add`` — see ``_OBS_RECORD_FUNCS``) in the ``obs`` package: a
+  ``deque()`` built without ``maxlen``, or ``self.x.append(...)``
+  where ``__init__`` bound ``self.x`` to a bare ``[]``/``list()``/
+  unbounded ``deque()``. The always-on telemetry layer (flight
+  recorder, timeline rings, quantile estimators) records on EVERY
+  frame for the life of the process — one unbounded append there is a
+  slow memory leak in the exact component that must never cost
+  anything. Bounded-by-construction exceptions take a pragma.
 
 Findings are suppressed per-line with::
 
@@ -156,6 +166,21 @@ _SANCTIONED_FUNCS = {"to_host"}
 #: (residency-unit registration)
 _MEM_SANCTIONED_FUNCS = {"to_device", "upload_many", "open"}
 
+#: obs hot-path recording function names (NNS114): the per-frame /
+#: per-event entry points of the always-on telemetry layer — anything
+#: they grow must be bounded
+_OBS_RECORD_FUNCS = {"span", "mark", "observe", "add", "inc",
+                     "async_begin", "async_end"}
+#: recording-function name prefixes (record_completion, note_retry,
+#: observe_invoke, _observe_locked, _complete, ...)
+_OBS_RECORD_PREFIXES = ("record", "_record", "note", "_note",
+                        "observe", "_observe", "_complete")
+
+
+def _is_obs_record_func(name: str) -> bool:
+    return name in _OBS_RECORD_FUNCS or \
+        name.startswith(_OBS_RECORD_PREFIXES)
+
 
 def _parse_pragmas(text: str) -> Tuple[Dict[int, Set[str]], List[int]]:
     """Per-line suppressed codes, plus lines with a reasonless pragma."""
@@ -200,6 +225,8 @@ class _FileLinter(ast.NodeVisitor):
         self._timeout_discipline: Dict[int, bool] = {}  # id(fnode) → bool
         self._wall_lines: Set[int] = set()
         self._collect_wall_bindings(tree)
+        #: NNS114 applies only inside the obs package
+        self._in_obs = "obs" in Path(rel).parts
 
     # -- helpers -------------------------------------------------------------
     def emit(self, code: str, node: ast.AST, message: str,
@@ -261,6 +288,7 @@ class _FileLinter(ast.NodeVisitor):
         self._rule_nns110(node, dotted)
         self._rule_nns112(node, dotted)
         self._rule_nns113(node, dotted)
+        self._rule_nns114_deque(node, dotted)
         self.generic_visit(node)
 
     def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
@@ -270,6 +298,7 @@ class _FileLinter(ast.NodeVisitor):
 
     def visit_ClassDef(self, node: ast.ClassDef) -> None:
         self._rule_nns109(node)
+        self._rule_nns114_append(node)
         self.generic_visit(node)
 
     # -- rules ---------------------------------------------------------------
@@ -527,6 +556,101 @@ class _FileLinter(ast.NodeVisitor):
             hint="route the upload through TensorBuffer.to_device/"
                  "upload_many, register the bytes with tensors/memory.py "
                  "(residency unit or note_h2d), or justify with a pragma")
+
+    def _rule_nns114_deque(self, node: ast.Call, dotted: str) -> None:
+        if not self._in_obs:
+            return
+        if not any(_is_obs_record_func(f) for f in self._func_stack):
+            return
+        if dotted not in ("deque", "collections.deque"):
+            return
+        # deque(iterable, maxlen) — the 2nd positional IS the bound
+        if len(node.args) >= 2 or \
+                any(kw.arg == "maxlen" for kw in node.keywords):
+            return
+        self.emit(
+            "NNS114", node,
+            "deque() without maxlen built in an obs hot-path recording "
+            "function — always-on telemetry records on every frame for "
+            "the process lifetime, so an unbounded container here is a "
+            "slow leak",
+            hint="pass maxlen=<ring capacity>, or justify a "
+                 "bounded-by-construction container with a pragma")
+
+    def _rule_nns114_append(self, node: ast.ClassDef) -> None:
+        """Flag ``self.x.append/extend(...)`` inside a recording method
+        when the class's ``__init__`` bound ``self.x`` to an unbounded
+        list or deque."""
+        if not self._in_obs:
+            return
+        unbounded = self._unbounded_init_attrs(node)
+        if not unbounded:
+            return
+        growers = {"append", "appendleft", "extend", "extendleft",
+                   "insert"}
+        for stmt in node.body:
+            if not isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if not _is_obs_record_func(stmt.name):
+                continue
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call) and \
+                        isinstance(sub.func, ast.Attribute) and \
+                        sub.func.attr in growers and \
+                        isinstance(sub.func.value, ast.Attribute) and \
+                        isinstance(sub.func.value.value, ast.Name) and \
+                        sub.func.value.value.id == "self" and \
+                        sub.func.value.attr in unbounded:
+                    attr = sub.func.value.attr
+                    self.emit(
+                        "NNS114", sub,
+                        f"{node.name}.{stmt.name}() grows self.{attr}, "
+                        f"which __init__ binds unbounded — an obs "
+                        f"recording path runs on every frame for the "
+                        f"process lifetime, so this container is a slow "
+                        f"leak",
+                        hint=f"bind self.{attr} to deque(maxlen=...) (or "
+                             f"prune at a cap), or justify a bounded-by-"
+                             f"construction container with a pragma")
+
+    @staticmethod
+    def _unbounded_init_attrs(node: ast.ClassDef) -> Set[str]:
+        """Attrs that ``__init__`` binds to ``[]``, ``list()``, or a
+        ``deque`` without maxlen."""
+        out: Set[str] = set()
+        for stmt in node.body:
+            if not (isinstance(stmt, ast.FunctionDef)
+                    and stmt.name == "__init__"):
+                continue
+            for sub in ast.walk(stmt):
+                if not isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = sub.targets if isinstance(sub, ast.Assign) \
+                    else [sub.target]
+                value = sub.value
+                if value is None:
+                    continue
+                is_unbounded = False
+                if isinstance(value, ast.List) and not value.elts:
+                    is_unbounded = True
+                elif isinstance(value, ast.Call):
+                    ctor = _dotted(value.func)
+                    if ctor in ("list",) and not value.args:
+                        is_unbounded = True
+                    elif ctor in ("deque", "collections.deque") and \
+                            len(value.args) < 2 and \
+                            not any(kw.arg == "maxlen"
+                                    for kw in value.keywords):
+                        is_unbounded = True
+                if not is_unbounded:
+                    continue
+                for t in targets:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        out.add(t.attr)
+        return out
 
     def _enclosing_has_timeout_discipline(self) -> bool:
         """True when the innermost enclosing function visibly bounds its

@@ -73,6 +73,10 @@ CODE_TABLE: Dict[str, str] = {
               "tracked entry points (bytes land in device memory that "
               "nns_mem_used_bytes never sees, so the pressure ladder "
               "runs on an undercount)",
+    "NNS114": "unbounded list.append/deque() without maxlen in an obs "
+              "hot-path recording function (always-on telemetry records "
+              "on every frame for the process lifetime — an unbounded "
+              "container there is a slow leak)",
     "NNS199": "nns-lint pragma without a justification",
 }
 

@@ -256,6 +256,16 @@ def main(argv=None) -> int:
                          "prints the per-stage latency breakdown. "
                          "NNSTPU_TRACE=FILE does the same without the "
                          "flag (see docs/profiling.md, Frame timelines)")
+    ap.add_argument("--flight-dir", metavar="DIR", default=None,
+                    help="write rate-limited flight-recorder dumps "
+                         "(full span detail around tail-latency "
+                         "offenders, deadline breaches, faults, and "
+                         "watchdog trips) as timestamped JSON files "
+                         "under DIR; the always-on recorder itself "
+                         "needs no flag — NNSTPU_FLIGHT=DIR does the "
+                         "same, NNSTPU_FLIGHT=0 disables recording "
+                         "entirely (see docs/profiling.md, Flight "
+                         "recorder)")
     ap.add_argument("--slo-budget-ms", type=float, default=None,
                     metavar="MS",
                     help="pipeline-wide SLO latency budget: activates "
@@ -342,6 +352,8 @@ def main(argv=None) -> int:
         pipe.error_policy = args.error_policy
     if args.watchdog_s is not None:
         pipe.watchdog_s = max(0.0, args.watchdog_s)
+    if args.flight_dir is not None:
+        pipe.flight_dir = args.flight_dir
 
     if args.verbose:
         for el in pipe.elements:
@@ -473,6 +485,32 @@ def _print_stats(pipe) -> None:
               f"{mem['prefetches']} prefetches, "
               f"{mem['resident_units']} resident unit(s), "
               f"{mem['pressure_events']} pressure event(s)")
+    slo = full.get("slo")
+    if slo:
+        e2e = slo["stages"].get("e2e")
+        if e2e:
+            print(f"-- flight recorder: {slo['completed']} frames, "
+                  f"e2e p50 {e2e['p50_ms']:.2f}ms / "
+                  f"p99 {e2e['p99_ms']:.2f}ms (streaming)")
+        burn = slo.get("burn")
+        if burn:
+            print(f"-- slo burn: fast {burn['fast']:.2f}x / "
+                  f"slow {burn['slow']:.2f}x of error budget "
+                  f"(budget {burn['budget_ms']:.0f}ms"
+                  f"{', OVERLOADED' if burn['overloaded'] else ''})")
+        dumps = slo.get("dumps")
+        if dumps and (dumps["written"] or dumps["suppressed"]):
+            print(f"-- flight dumps: {dumps['written']} written / "
+                  f"{dumps['suppressed']} rate-limited"
+                  + (f", last: {dumps['paths'][-1]}"
+                     if dumps["paths"] else ""))
+    attr = full.get("attribution")
+    if attr and attr.get("dominant_stage"):
+        print(f"-- variance attribution: e2e spread (MAD) "
+              f"{attr['e2e_mad_ms']:.2f}ms, dominated by "
+              f"{attr['dominant_stage']} "
+              f"({attr['dominant_share']:.0%} of the spread)"
+              + (f", hints {attr['hints']}" if attr["hints"] else ""))
 
 
 if __name__ == "__main__":

@@ -153,6 +153,7 @@ class TensorSink(Element):
         tl = _timeline.ACTIVE
         t_sink0 = time.monotonic() if tl is not None else 0.0
         e2e_s: Optional[float] = None
+        e2e_adm_s: Optional[float] = None
         # end-to-end frame latency: source create() → here (payload is
         # host-materialized above). Under micro-batching meta carries one
         # capture stamp per constituent frame, so each frame's latency
@@ -197,6 +198,10 @@ class TensorSink(Element):
                 for t in adm_list:
                     self.admitted_latencies.append(now - t)
                 adm = adm_list[0]
+                if tl is not None:
+                    # admitted e2e rides alongside: the SLO burn windows
+                    # judge deadline breaches from admission, not capture
+                    e2e_adm_s = now - adm
                 sched = getattr(self.pipeline, "_slo_scheduler", None)
                 if sched is not None:
                     # completion feed: drives the drain-rate estimate
@@ -215,8 +220,10 @@ class TensorSink(Element):
             seq = buf.meta.get(_timeline.TRACE_SEQ_META)
             if seq is not None:
                 if e2e_s is not None:
+                    extra = {"e2e_adm_s": e2e_adm_s} \
+                        if e2e_adm_s is not None else {}
                     tl.span("sink", seq, t_sink0, time.monotonic(),
-                            track=self.name, e2e_s=e2e_s)
+                            track=self.name, e2e_s=e2e_s, **extra)
                 else:
                     tl.span("sink", seq, t_sink0, time.monotonic(),
                             track=self.name)

@@ -43,3 +43,11 @@ from nnstreamer_tpu.obs.timeline import (  # noqa: F401
     trace_enabled,
     tracing,
 )
+from nnstreamer_tpu.obs.quantiles import (  # noqa: F401
+    BurnRateWindow,
+    P2Quantile,
+)
+from nnstreamer_tpu.obs.flight import (  # noqa: F401
+    FlightRecorder,
+    flight_enabled,
+)

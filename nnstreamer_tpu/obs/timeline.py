@@ -71,6 +71,7 @@ import json
 import os
 import threading
 import time
+import weakref
 from collections import deque
 from contextlib import contextmanager
 from typing import Any, Dict, List, Optional, Tuple
@@ -192,6 +193,19 @@ def jax_correlation(logdir: str):
                 pass
 
 
+class _RingAnchor:
+    """Weakref-able token parked in a recording thread's thread-local
+    dict; its finalizer retires the thread's ring (see ``_ring``)."""
+
+    __slots__ = ("__weakref__",)
+
+
+def _retire_ring(tl_ref: "weakref.ref", entry: Tuple[str, deque]) -> None:
+    tl = tl_ref()
+    if tl is not None:
+        tl._retire(entry)
+
+
 class Timeline:
     """Low-overhead frame-ledger recorder (see module docstring)."""
 
@@ -202,11 +216,16 @@ class Timeline:
         self._env_owned = False
         self._seq = itertools.count()  # next() is GIL-atomic
         self._local = threading.local()
-        #: [(thread_name, ring)] — registry of every thread's ring;
+        #: [(thread_name, ring)] — registry of every LIVE thread's ring;
         #: appended once per recording thread under the lock, drained
-        #: at export
+        #: at export, removed when the thread dies (see ``_ring``)
         self._rings: List[Tuple[str, deque]] = []
         self._rings_lock = threading.Lock()
+        #: records salvaged from dead threads' rings — supervised lane
+        #: restarts spin up fresh worker threads per crash cycle, so
+        #: without retirement ``_rings`` grows one entry per restart
+        #: forever; bounded like any single ring
+        self._retired: deque = deque(maxlen=self.capacity)
         #: dispatch-window inflight slots: ("b"/"e", name, id, t, track)
         self._async: deque = deque(maxlen=4 * self.capacity)
 
@@ -218,10 +237,32 @@ class Timeline:
         r = getattr(self._local, "ring", None)
         if r is None:
             r = deque(maxlen=self.capacity)
-            self._local.ring = r
+            entry = (threading.current_thread().name, r)
             with self._rings_lock:
-                self._rings.append((threading.current_thread().name, r))
+                self._rings.append(entry)
+            # Unregister at thread death: the anchor lives only in this
+            # thread's thread-local dict, so CPython drops it when the
+            # thread exits and the finalizer moves the ring's records
+            # into the bounded ``_retired`` store. Pipeline.stop() joins
+            # workers before export, so post-join exports still see
+            # every span; what this prevents is ``_rings`` growing one
+            # dead entry per supervised lane restart.
+            anchor = _RingAnchor()
+            weakref.finalize(anchor, _retire_ring, weakref.ref(self),
+                             entry)
+            self._local.ring = r
+            self._local.anchor = anchor
         return r
+
+    def _retire(self, entry: Tuple[str, deque]) -> None:
+        name, ring = entry
+        with self._rings_lock:
+            try:
+                self._rings.remove(entry)
+            except ValueError:
+                return  # clear()/re-entry already handled it
+            for rec in ring:
+                self._retired.append((name,) + rec)
 
     def span(self, kind: str, seq: Optional[int], t0: float, t1: float,
              track: Optional[str] = None, **args) -> None:
@@ -253,6 +294,7 @@ class Timeline:
         so a re-used timeline exports a fresh window)."""
         with self._rings_lock:
             rings = list(self._rings)
+            self._retired.clear()
         for _, r in rings:
             r.clear()
         self._async.clear()
@@ -264,7 +306,8 @@ class Timeline:
         time-ordered."""
         with self._rings_lock:
             rings = list(self._rings)
-        out: List[tuple] = []
+            retired = list(self._retired)
+        out: List[tuple] = list(retired)
         for tname, ring in rings:
             for rec in list(ring):
                 out.append((tname,) + rec)

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import time as _time
 import warnings
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
@@ -321,6 +322,12 @@ class FusedRegion(Element):
         if self._qos_throttled():
             return None  # downstream-rate QoS drop (tensor_filter.c:426)
         fi = _faults.ACTIVE
+        # the device span starts HERE, before the chaos hook: an injected
+        # filter.invoke stall models a slow backend invoke, and the flight
+        # recorder's variance attribution must see that time in the
+        # "device" stage (the span ends before _window.admit so a full
+        # window's fence shows up as fence_wait, not double-counted here)
+        t_dev0 = _time.monotonic()
         if fi is not None:
             # chaos hook — the same `filter.invoke` site the unfused
             # filter checks (its chain doesn't run while fused), BEFORE
@@ -382,6 +389,12 @@ class FusedRegion(Element):
             log.warning("%s: fused program failed (%s); falling back to "
                         "member chain", self.name, e)
             return self._fallback(buf)
+        tl = _timeline.ACTIVE
+        if tl is not None:
+            seq = buf.meta.get(_timeline.TRACE_SEQ_META)
+            if seq is not None:
+                tl.span("device", seq, t_dev0, _time.monotonic(),
+                        track=self.name)
         # bounded async dispatch: register the outstanding batch (fences
         # the OLDEST only when more than `inflight` are in flight); the
         # pooled host staging arrays this dispatch consumed recycle at

@@ -873,6 +873,11 @@ class Pipeline:
         #: start()); NNSTPU_WATCHDOG_S overrides when unset
         self.watchdog_s = float(watchdog_s or 0.0)
         self._watchdog = None
+        #: tail-event dump directory for the flight recorder
+        #: (obs/flight.py); None defers to NNSTPU_FLIGHT. The recorder
+        #: itself is always on unless NNSTPU_FLIGHT=0.
+        self.flight_dir: Optional[str] = None
+        self._flight = None
         # export per-element latency/throughput gauges at scrape time
         # (weakref-bound: a collected pipeline unregisters itself)
         register_pipeline_collector(self)
@@ -949,6 +954,12 @@ class Pipeline:
             out["scheduler"] = self._slo_scheduler.snapshot()
         if _memory.ACTIVE is not None:
             out["memory"] = _memory.ACTIVE.snapshot()
+        if self._flight is not None:
+            # always-on flight recorder (obs/flight.py): streaming
+            # stage/e2e quantiles + burn rates, and the continuous
+            # variance-attribution report
+            out["slo"] = self._flight.slo_snapshot()
+            out["attribution"] = self._flight.attribution()
         return out
 
     # -- state ----------------------------------------------------------------
@@ -984,6 +995,16 @@ class Pipeline:
             from nnstreamer_tpu.serving.scheduler import ensure_scheduler
 
             ensure_scheduler(self)
+        # always-on flight recorder (obs/flight.py): installed after the
+        # scheduler (so the SLO budget is known) and only when no
+        # explicit/env timeline already owns the ledger slot. The
+        # recorder rides the existing span sites; NNSTPU_FLIGHT=0 keeps
+        # ACTIVE None and the off path exactly as before.
+        from nnstreamer_tpu.obs import flight as _flight
+
+        fr = _flight.maybe_install(self)
+        if fr is not None:
+            self._flight = fr
         for el in others:
             el.start()
         # region fusion after backends opened, before any buffer flows
@@ -1076,6 +1097,13 @@ class Pipeline:
 
         release_all_pools()
         self.state = State.NULL
+        # retire the flight recorder before the env-owned export check:
+        # a pending tail dump near EOS flushes here, and the recorder
+        # object stays on self._flight for the post-EOS footer / bench
+        if self._flight is not None:
+            from nnstreamer_tpu.obs import flight as _flight
+
+            _flight.retire(self._flight)
         # an env-owned timeline (NNSTPU_TRACE=<path>) exports its ledger
         # once the run is over; explicitly installed timelines are the
         # caller's to export

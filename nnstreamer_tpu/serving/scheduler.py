@@ -173,9 +173,13 @@ class FeedbackController:
         vals = sorted(self._lat)
         return vals[min(len(vals) - 1, int(0.99 * (len(vals) - 1)))]
 
-    def maybe_step(self, now: float) -> bool:
+    def maybe_step(self, now: float, overload: bool = False) -> bool:
         """One AIMD step if the interval elapsed and enough completions
-        accumulated. Returns True when the knobs changed."""
+        accumulated. Returns True when the knobs changed. ``overload``
+        forces the multiplicative-decrease branch regardless of the p99
+        reading — the flight recorder's SLO burn-rate windows raise it
+        while both alerting windows burn hot, which fires on a breach
+        *pattern* before the windowed p99 has fully absorbed it."""
         with self._lock:
             if now - self._last_step_t < self.interval_s:
                 return False
@@ -186,7 +190,7 @@ class FeedbackController:
             self.last_p99_s = p99
             self.steps += 1
             cap0, inf0 = self.batch_cap, self.inflight
-            if p99 > self.p99_factor * self.budget_s:
+            if overload or p99 > self.p99_factor * self.budget_s:
                 self.batch_cap = max(1, self.batch_cap // 2)
                 self.inflight = max(1, self.inflight - 1)
             elif p99 <= self.budget_s:
@@ -456,7 +460,9 @@ class SloScheduler:
         event-driven chance to step."""
         self.estimator.observe_completion(now, frames)
         self.controller.record_completion(latency_s)
-        if self.controller.maybe_step(now):
+        fr = getattr(self.pipeline, "_flight", None)
+        overload = fr is not None and fr.burn_overload(now)
+        if self.controller.maybe_step(now, overload=overload):
             self._apply_knobs()
 
     # -- knob application -----------------------------------------------------
@@ -487,8 +493,18 @@ class SloScheduler:
                 + self._m["shed_late"].value)
         p99 = self.controller.last_p99_s or 0.0
         cur = self._current_lanes()
-        self._lanes_hint = cur + 1 if (shed > 0 and p99 <= self.budget_s) \
-            else cur
+        hint = cur + 1 if (shed > 0 and p99 <= self.budget_s) else cur
+        # the flight recorder's attribution engine is the second vote:
+        # ingest/reorder-dominated e2e spread means the host side is the
+        # variance source, and one more lane is the advisory fix even
+        # without capacity sheds on record
+        fr = getattr(pipe, "_flight", None)
+        if fr is not None:
+            hints = fr.attribution().get("hints", {})
+            delta = int(hints.get("lanes_hint_delta", 0) or 0)
+            if delta > 0:
+                hint = max(hint, cur + delta)
+        self._lanes_hint = hint
 
     # -- reporting ------------------------------------------------------------
     def snapshot(self) -> Dict[str, Any]:

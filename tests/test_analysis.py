@@ -185,7 +185,8 @@ class TestVerifierGraph:
         # any diagnostic the verifier can emit has a CODE_TABLE row
         # (docs/linting.md renders from the same table)
         assert {"NNS001", "NNS005", "NNS011", "NNS101", "NNS109",
-                "NNS110", "NNS111", "NNS112", "NNS199"} <= set(CODE_TABLE)
+                "NNS110", "NNS111", "NNS112", "NNS114",
+                "NNS199"} <= set(CODE_TABLE)
 
 
 class TestParsePositionalErrors:
@@ -488,6 +489,49 @@ class TestAstLint:
                "-- error response goes out in-band\n"
                "        respond(e)\n")
         assert by_code(lint_source(src, "x.py"), "NNS111") == []
+
+    def test_nns114_unbounded_deque_in_obs_record_func(self):
+        src = ("import collections\n"
+               "def observe(self, x):\n"
+               "    q = collections.deque()\n"
+               "    q.append(x)\n")
+        assert "NNS114" in codes(
+            lint_source(src, "nnstreamer_tpu/obs/q.py"))
+        # same source outside obs/ is out of scope for this rule
+        assert by_code(
+            lint_source(src, "nnstreamer_tpu/pipeline/q.py"),
+            "NNS114") == []
+
+    def test_nns114_bounded_deque_ok(self):
+        src = ("from collections import deque\n"
+               "def record_frame(self, x):\n"
+               "    self._ring = deque(maxlen=64)\n")
+        assert by_code(
+            lint_source(src, "nnstreamer_tpu/obs/q.py"), "NNS114") == []
+
+    def test_nns114_append_to_unbounded_init_attr(self):
+        src = ("class Rec:\n"
+               "    def __init__(self):\n"
+               "        self.frames = []\n"
+               "        self.ring = __import__('collections')\n"
+               "    def observe(self, seq):\n"
+               "        self.frames.append(seq)\n"
+               "    def configure(self, opts):\n"
+               "        self.frames.append(opts)\n")
+        # only the recording function is a hot path; configure() is
+        # setup-time and stays out of scope
+        assert len(by_code(
+            lint_source(src, "nnstreamer_tpu/obs/rec.py"),
+            "NNS114")) == 1
+
+    def test_nns114_pragma_suppressible(self):
+        src = ("from collections import deque\n"
+               "def observe(self, x):\n"
+               "    q = deque()  # nns-lint: disable=NNS114 -- drained "
+               "and discarded before return\n"
+               "    q.append(x)\n")
+        assert by_code(
+            lint_source(src, "nnstreamer_tpu/obs/q.py"), "NNS114") == []
 
     def test_pragma_suppresses_with_reason(self):
         src = ("import time\n"

@@ -1,0 +1,401 @@
+"""Flight recorder (obs/flight.py) + streaming quantiles
+(obs/quantiles.py) — the always-on tail-telemetry contract:
+
+- P² streaming quantiles track p50/p99 of seeded uniform / lognormal /
+  bimodal distributions within tolerance of the exact order statistics,
+  with NO sample storage, and stay correct under concurrent feeding;
+- the recorder detects tail events (e2e > k× rolling median), defers
+  the dump until the post-offender window completes, writes ONE
+  rate-limited JSON dump containing the offending frame's spans, and
+  suppresses the next trigger inside the interval;
+- SLO burn-rate windows (fast + slow) read breach fractions over their
+  trailing windows, raise the scheduler's overload signal, and post a
+  rate-limited bus warning;
+- the attribution engine names the dominant-variance stage and turns it
+  into advisory hints the FeedbackController folds into lanes_hint;
+- NNSTPU_FLIGHT=0 is a true kill switch (no recorder, no stamps), and
+  the always-on default changes no output byte;
+- the streaming gauges export through the registry in BOTH Prometheus
+  text and the JSON snapshot.
+"""
+
+import glob
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import parse_launch
+from nnstreamer_tpu.obs import get_registry
+from nnstreamer_tpu.obs import flight as _flight
+from nnstreamer_tpu.obs import timeline as _timeline
+from nnstreamer_tpu.obs.flight import FlightRecorder
+from nnstreamer_tpu.obs.quantiles import BurnRateWindow, P2Quantile
+from nnstreamer_tpu.pipeline.pipeline import Pipeline
+
+GOLDEN = ("videotestsrc pattern=ball num-buffers=24 width=16 height=16 ! "
+          "tensor_converter ! queue ! tensor_sink name=sink")
+
+
+class TestP2Quantile:
+    @pytest.mark.parametrize("p,tol", [(0.5, 0.05), (0.99, 0.08)])
+    def test_uniform(self, rng, p, tol):
+        data = rng.uniform(0.0, 1.0, 4000)
+        q = P2Quantile(p)
+        for x in data:
+            q.observe(x)
+        exact = float(np.percentile(data, p * 100))
+        assert abs(q.quantile() - exact) <= tol * max(exact, 0.1)
+
+    @pytest.mark.parametrize("p,tol", [(0.5, 0.05), (0.99, 0.10)])
+    def test_lognormal(self, rng, p, tol):
+        data = rng.lognormal(0.0, 0.5, 4000)
+        q = P2Quantile(p)
+        for x in data:
+            q.observe(x)
+        exact = float(np.percentile(data, p * 100))
+        assert abs(q.quantile() - exact) <= tol * exact
+
+    def test_bimodal(self, rng):
+        # two well-separated modes (fast path vs stall): p50 must land
+        # in the fast mode, p99 in the slow one — the separation the
+        # tail detector depends on
+        fast = rng.normal(0.010, 0.001, 3600)
+        slow = rng.normal(0.500, 0.020, 400)
+        data = rng.permutation(np.concatenate([fast, slow]))
+        p50, p99 = P2Quantile(0.5), P2Quantile(0.99)
+        for x in data:
+            p50.observe(x)
+            p99.observe(x)
+        assert abs(p50.quantile()
+                   - float(np.percentile(data, 50))) <= 0.005
+        assert abs(p99.quantile()
+                   - float(np.percentile(data, 99))) <= 0.08
+
+    def test_small_counts_are_exact(self):
+        q = P2Quantile(0.5)
+        assert q.quantile() is None
+        for x in (5.0, 1.0, 3.0):
+            q.observe(x)
+        assert q.quantile() == 3.0  # exact order statistic while n<=5
+
+    def test_concurrent_observers_merge(self, rng):
+        """Feeding one estimator from several threads must neither lose
+        observations nor corrupt the marker invariants."""
+        data = rng.uniform(0.0, 1.0, 4000)
+        q = P2Quantile(0.5)
+        chunks = np.array_split(data, 8)
+
+        def feed(chunk):
+            for x in chunk:
+                q.observe(x)
+
+        threads = [threading.Thread(target=feed, args=(c,), daemon=True)
+                   for c in chunks]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert q.count == len(data)
+        exact = float(np.percentile(data, 50))
+        assert abs(q.quantile() - exact) <= 0.05
+
+
+class TestBurnRateWindow:
+    def test_rate_is_breach_fraction_over_budget(self):
+        b = BurnRateWindow(window_s=10.0, error_budget=0.1)
+        for i in range(100):
+            b.add(i * 0.05, breached=(i % 2 == 0))
+        # 50% breached / 10% budget = 5x burn
+        assert b.rate(5.0) == pytest.approx(5.0, abs=0.5)
+
+    def test_old_events_evict(self):
+        b = BurnRateWindow(window_s=1.0, error_budget=0.5)
+        b.add(0.0, True)
+        b.add(0.1, True)
+        assert b.rate(0.5) == pytest.approx(2.0)
+        assert b.rate(10.0) == 0.0
+        assert b.sample_count(10.0) == 0
+
+    def test_cap_eviction_keeps_count_honest(self):
+        b = BurnRateWindow(window_s=1e9, error_budget=1.0, cap=10)
+        for i in range(50):
+            b.add(float(i), breached=True)
+        assert b.sample_count(50.0) == 10
+        assert b.rate(50.0) == pytest.approx(1.0)
+
+
+def _feed_frame(fr, seq, e2e_s, device_s=None, t0=None):
+    """Synthetic frame: one device span + the sink completion span."""
+    t = float(seq) if t0 is None else t0
+    d = device_s if device_s is not None else e2e_s / 2
+    fr.span("device", seq, t, t + d)
+    fr.span("sink", seq, t + d, t + e2e_s, e2e_s=e2e_s)
+
+
+class TestTailDump:
+    def test_tail_event_dumps_window_once(self, tmp_path):
+        fr = FlightRecorder(dump_dir=str(tmp_path), min_samples=5,
+                            window_frames=2, min_interval_s=3600.0,
+                            tail_k=4.0)
+        for seq in range(10):
+            _feed_frame(fr, seq, 0.002)
+        _feed_frame(fr, 10, 0.500)          # the offender: 250x median
+        assert fr.last_trigger["kind"] == "tail"
+        assert fr.last_trigger["seq"] == 10
+        assert not list(tmp_path.glob("*.json")), \
+            "dump must wait for the post-offender window"
+        _feed_frame(fr, 11, 0.002)
+        _feed_frame(fr, 12, 0.002)          # seq 12 >= 10+2: flush
+        files = list(tmp_path.glob("*.json"))
+        assert len(files) == 1
+        doc = json.loads(files[0].read_text())
+        assert doc["trigger"]["kind"] == "tail"
+        assert doc["trigger"]["seq"] == 10
+        # the dump's window contains the offending frame's full spans
+        offender = [s for s in doc["spans"] if s["seq"] == 10]
+        assert {s["kind"] for s in offender} >= {"device", "sink"}
+        assert doc["window"]["seq_lo"] == 8
+        assert doc["window"]["seq_hi"] == 12
+        assert "10" in doc["frames_ms"]
+        # a second offender inside the rate-limit interval is counted
+        # but produces no second file
+        _feed_frame(fr, 13, 0.500)
+        _feed_frame(fr, 14, 0.002)
+        _feed_frame(fr, 15, 0.002)
+        _feed_frame(fr, 16, 0.002)
+        assert len(list(tmp_path.glob("*.json"))) == 1
+        assert fr.suppressed_dumps == 1
+
+    def test_fault_mark_triggers_and_watchdog_flushes_immediately(
+            self, tmp_path):
+        fr = FlightRecorder(dump_dir=str(tmp_path), min_samples=5,
+                            window_frames=4, min_interval_s=3600.0)
+        for seq in range(6):
+            _feed_frame(fr, seq, 0.002)
+        fr.mark("watchdog_trip", None, track="faults", idle_s=1.5)
+        # watchdog may mean no more completions ever arrive: the dump
+        # must not wait for the post-window
+        files = list(tmp_path.glob("*.json"))
+        assert len(files) == 1
+        doc = json.loads(files[0].read_text())
+        assert doc["trigger"]["kind"] == "watchdog"
+        assert doc["trigger"]["detail"]["mark"] == "watchdog_trip"
+
+    def test_deadline_breach_triggers(self, tmp_path):
+        fr = FlightRecorder(dump_dir=str(tmp_path), min_samples=5,
+                            window_frames=1, min_interval_s=3600.0,
+                            slo_budget_s=0.010)
+        _feed_frame(fr, 0, 0.050)
+        assert fr.last_trigger["kind"] == "deadline"
+        assert fr.trigger_counts["deadline"] == 1
+
+    def test_no_dump_dir_counts_but_writes_nothing(self, tmp_path):
+        fr = FlightRecorder(dump_dir=None, min_samples=5,
+                            window_frames=1, min_interval_s=0.0)
+        for seq in range(8):
+            _feed_frame(fr, seq, 0.002)
+        _feed_frame(fr, 8, 0.500)
+        _feed_frame(fr, 9, 0.002)
+        _feed_frame(fr, 10, 0.002)
+        assert fr.trigger_counts["tail"] >= 1
+        assert fr.dump_count == 0
+
+    def test_retire_flushes_pending(self, tmp_path):
+        fr = FlightRecorder(dump_dir=str(tmp_path), min_samples=5,
+                            window_frames=50, min_interval_s=3600.0)
+        for seq in range(10):
+            _feed_frame(fr, seq, 0.002)
+        _feed_frame(fr, 10, 0.500)  # offender right before EOS
+        assert not list(tmp_path.glob("*.json"))
+        _timeline.ACTIVE = fr
+        _flight.retire(fr)
+        assert _timeline.ACTIVE is None
+        assert len(list(tmp_path.glob("*.json"))) == 1
+
+
+class TestBurnAndAttribution:
+    def test_burn_overload_and_bus_warning(self):
+        pipe = Pipeline(name="flight-burn-unit")
+        fr = FlightRecorder(slo_budget_s=0.010, min_samples=5,
+                            pipeline=pipe)
+        for seq in range(20):
+            _feed_frame(fr, seq, 0.050, t0=seq * 0.1)  # all breach
+        now = 19 * 0.1 + 0.05
+        fast, slow = fr.burn_rates(now)
+        assert fast > 2.0 and slow > 2.0
+        assert fr.burn_overload(now)
+        kinds = []
+        while True:
+            msg = pipe.pop_message(timeout=0)
+            if msg is None:
+                break
+            kinds.append(msg.kind)
+        assert "warning" in kinds
+
+    def test_attribution_names_dominant_stage_and_hints(self):
+        fr = FlightRecorder(min_samples=5)
+        # ingest owns the spread: half the frames pay a 50 ms ingest
+        # stall, everything else is constant
+        for seq in range(20):
+            t = float(seq)
+            ing = 0.050 if seq % 2 else 0.001
+            fr.span("ingest", seq, t, t + ing)
+            fr.span("device", seq, t + ing, t + ing + 0.002)
+            fr.span("sink", seq, t + ing + 0.002, t + ing + 0.003,
+                    e2e_s=ing + 0.003)
+        attr = fr.attribution()
+        assert attr["dominant_stage"] == "ingest"
+        assert attr["dominant_share"] > 0
+        assert attr["hints"] == {"lanes_hint_delta": 1}
+
+    def test_attribution_pressure_hints(self):
+        fr = FlightRecorder(min_samples=5)
+        for seq in range(20):
+            t = float(seq)
+            fw = 0.040 if seq % 2 else 0.001
+            fr.span("fence_wait", seq, t, t + fw)
+            fr.span("sink", seq, t + fw, t + fw + 0.001,
+                    e2e_s=fw + 0.001)
+        assert fr.attribution()["hints"] == {"inflight_pressure": True}
+
+    def test_slo_snapshot_has_stage_quantiles(self):
+        fr = FlightRecorder(min_samples=5)
+        for seq in range(32):
+            _feed_frame(fr, seq, 0.004, device_s=0.002)
+        slo = fr.slo_snapshot()
+        assert slo["completed"] == 32
+        assert slo["stages"]["e2e"]["p50_ms"] == pytest.approx(4.0,
+                                                               rel=0.2)
+        assert slo["stages"]["device"]["p50_ms"] == pytest.approx(
+            2.0, rel=0.2)
+        assert slo["stages"]["device"]["count"] == 32
+
+
+class _FakeFlight:
+    def __init__(self, hints=None, overload=False):
+        self._hints = hints or {}
+        self._overload = overload
+
+    def attribution(self):
+        return {"hints": dict(self._hints)}
+
+    def burn_overload(self, now=None):
+        return self._overload
+
+
+class TestSchedulerIntegration:
+    def test_overload_forces_multiplicative_decrease(self):
+        from nnstreamer_tpu.serving.scheduler import FeedbackController
+
+        c = FeedbackController(budget_s=1.0, interval_s=0.0,
+                               batch_cap=8, inflight=4)
+        for _ in range(16):
+            c.record_completion(0.01)  # p99 well under budget
+        # healthy p99 would normally additive-increase; the burn-rate
+        # overload must force the decrease branch instead
+        assert c.maybe_step(now=100.0, overload=True)
+        assert c.batch_cap == 4
+        assert c.inflight == 3
+
+    def test_attribution_hint_raises_lanes_hint(self):
+        from nnstreamer_tpu.serving.scheduler import SloScheduler
+
+        pipe = Pipeline(name="flight-hint-unit")
+        sched = SloScheduler(budget_ms=100.0, pipeline=pipe,
+                             name="flight-hint-unit")
+        pipe._flight = _FakeFlight()
+        sched._apply_knobs()
+        base = sched._lanes_hint
+        pipe._flight = _FakeFlight(hints={"lanes_hint_delta": 1})
+        sched._apply_knobs()
+        assert sched._lanes_hint == base + 1
+
+
+class TestPipelineWiring:
+    def test_kill_switch_disables_recorder(self, monkeypatch):
+        monkeypatch.setenv("NNSTPU_FLIGHT", "0")
+        assert not _flight.flight_enabled()
+        pipe = parse_launch(GOLDEN)
+        msg = pipe.run(timeout=120)
+        assert msg is not None and msg.kind == "eos"
+        assert pipe._flight is None
+        assert "slo" not in pipe.metrics_snapshot()
+
+    def test_always_on_recorder_fills_snapshot_and_keeps_bytes(
+            self, monkeypatch):
+        monkeypatch.delenv("NNSTPU_FLIGHT", raising=False)
+        pipe_on = parse_launch(GOLDEN)
+        assert pipe_on.run(timeout=120).kind == "eos"
+        assert pipe_on._flight is not None
+        assert _timeline.ACTIVE is None, "retired at stop"
+        snap = pipe_on.metrics_snapshot()
+        assert snap["slo"]["completed"] == 24
+        assert "e2e" in snap["slo"]["stages"]
+        assert "attribution" in snap
+        monkeypatch.setenv("NNSTPU_FLIGHT", "0")
+        pipe_off = parse_launch(GOLDEN)
+        assert pipe_off.run(timeout=120).kind == "eos"
+        on = [b.tensors[0].tobytes()
+              for b in pipe_on.get("sink").buffers]
+        off = [b.tensors[0].tobytes()
+               for b in pipe_off.get("sink").buffers]
+        assert on == off, "always-on recorder changed output bytes"
+
+    def test_explicit_timeline_wins_over_flight(self, monkeypatch):
+        monkeypatch.delenv("NNSTPU_FLIGHT", raising=False)
+        tl = _timeline.activate()
+        try:
+            pipe = parse_launch(GOLDEN)
+            assert pipe.run(timeout=120).kind == "eos"
+            assert pipe._flight is None
+            assert _timeline.ACTIVE is tl
+        finally:
+            _timeline.deactivate()
+
+    def test_env_dump_dir_produces_dump_on_stall(self, tmp_path,
+                                                 monkeypatch):
+        """The acceptance path: NNSTPU_FLIGHT=<dir> + an injected stall
+        ⇒ exactly one dump whose window contains the offender."""
+        from nnstreamer_tpu.pipeline import faults
+
+        monkeypatch.setenv("NNSTPU_FLIGHT", str(tmp_path))
+        monkeypatch.setenv("NNSTPU_FLIGHT_MIN_SAMPLES", "6")
+        faults.activate("queue.push:nth=16,kind=stall,ms=250", seed=3)
+        try:
+            pipe = parse_launch(GOLDEN)
+            assert pipe.run(timeout=120).kind == "eos"
+        finally:
+            faults.deactivate()
+        files = glob.glob(str(tmp_path / "*.json"))
+        assert len(files) == 1, files
+        doc = json.loads(open(files[0]).read())
+        assert doc["trigger"]["kind"] in ("fault", "tail")
+        seqs = {s["seq"] for s in doc["spans"] if s["seq"] is not None}
+        assert doc["trigger"]["seq"] is None or \
+            doc["trigger"]["seq"] in seqs
+
+
+class TestGaugeExport:
+    def test_stage_and_burn_gauges_export_text_and_json(self):
+        fr = FlightRecorder(slo_budget_s=0.010, min_samples=5,
+                            pipeline=None)
+        fr.pipeline_name = "flight-gauge-unit"
+        for seq in range(16):
+            _feed_frame(fr, seq, 0.004)
+        fr.register_gauges()
+        reg = get_registry()
+        text = reg.render_prometheus()
+        assert 'nns_stage_p50_ms{' in text
+        assert 'nns_stage_p99_ms{' in text
+        assert 'nns_slo_burn_rate{' in text
+        line = [ln for ln in text.splitlines()
+                if ln.startswith("nns_stage_p50_ms")
+                and 'stage="e2e"' in ln
+                and 'pipeline="flight-gauge-unit"' in ln]
+        assert line and float(line[0].rsplit(None, 1)[1]) > 0
+        snap = reg.snapshot()
+        blob = json.dumps(snap)
+        assert "nns_stage_p50_ms" in blob
+        assert "nns_slo_burn_rate" in blob
