@@ -1262,16 +1262,18 @@ def _probe_accelerator(timeout_s: float = None) -> bool:
 
 def _enable_compile_cache():
     """Persistent XLA compilation cache: the flagship model's ~30s TPU
-    compile happens once per machine, not once per bench run."""
+    compile happens once per machine, not once per bench run. Routed
+    through the serving-continuity layer (pipeline/continuity.py) so
+    the bench shares the serving cache and its hit/miss counters
+    (nns_compile_cache_hits/misses_total) feed the report footer."""
     try:
-        import jax
+        from nnstreamer_tpu.pipeline.continuity import enable_compile_cache
 
         cache_dir = os.environ.get(
             "NNSTPU_COMPILE_CACHE",
             os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          ".jax_cache"))
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        enable_compile_cache(cache_dir)
     except Exception as e:  # noqa: BLE001 — cache is an optimization only
         print(f"bench: compile cache unavailable ({e})", file=sys.stderr)
 

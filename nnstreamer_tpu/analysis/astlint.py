@@ -65,8 +65,9 @@ These rules encode invariants this codebase has already been burned by
   send-side discipline used by ``query/mqtt.py``).
 - NNS113: a direct ``jax.device_put`` outside the HBM budget
   accountant's tracked entry points (``TensorBuffer.to_device`` /
-  ``upload_many``, the backend ``open()`` weight load — see
-  ``_MEM_SANCTIONED_FUNCS``): bytes it moves land in device memory
+  ``upload_many``, the backend ``open()`` weight load and
+  ``install_weights()`` swap — see ``_MEM_SANCTIONED_FUNCS``): bytes
+  it moves land in device memory
   that ``nns_mem_used_bytes`` never sees, so the pressure ladder and
   residency eviction math (``tensors/memory.py``) run against an
   undercount exactly when HBM is the scarce resource.
@@ -80,6 +81,15 @@ These rules encode invariants this codebase has already been burned by
   frame for the life of the process — one unbounded append there is a
   slow memory leak in the exact component that must never cost
   anything. Bounded-by-construction exceptions take a pragma.
+- NNS115: a checkpointable class whose save/load key sets drift. For a
+  class defining a ``snapshot()``/``restore()`` or
+  ``checkpoint_state()``/``restore_state()`` pair (the serving-
+  continuity protocol, ``pipeline/continuity.py``), the string-literal
+  keys the save method writes must equal the keys the load method
+  reads: a key saved but never restored is dead state that silently
+  stops round-tripping, a key restored but never saved reads as absent
+  on every real checkpoint. Classes whose schema is dynamic (no
+  literal keys on one side, e.g. ``TensorRepo``) are skipped.
 
 Findings are suppressed per-line with::
 
@@ -162,9 +172,10 @@ _SANCTIONED_FUNCS = {"to_host"}
 #: the HBM budget accountant's tracked entry points (NNS113): the only
 #: functions allowed to call jax.device_put directly, because they are
 #: where the moved bytes register against tensors/memory.py — to_device/
-#: upload_many (frame transfers) and the backend open() weight load
-#: (residency-unit registration)
-_MEM_SANCTIONED_FUNCS = {"to_device", "upload_many", "open"}
+#: upload_many (frame transfers), the backend open() weight load and
+#: install_weights() swap (residency-unit registration)
+_MEM_SANCTIONED_FUNCS = {"to_device", "upload_many", "open",
+                         "install_weights"}
 
 #: obs hot-path recording function names (NNS114): the per-frame /
 #: per-event entry points of the always-on telemetry layer — anything
@@ -175,6 +186,13 @@ _OBS_RECORD_FUNCS = {"span", "mark", "observe", "add", "inc",
 #: observe_invoke, _observe_locked, _complete, ...)
 _OBS_RECORD_PREFIXES = ("record", "_record", "note", "_note",
                         "observe", "_observe", "_complete")
+
+
+#: checkpoint save/load method-name pairs (NNS115): the serving-
+#: continuity protocol's state round-trip — reporting-only snapshots
+#: (no matching load method) are not checked
+_CKPT_PAIRS = (("snapshot", "restore"),
+               ("checkpoint_state", "restore_state"))
 
 
 def _is_obs_record_func(name: str) -> bool:
@@ -299,6 +317,7 @@ class _FileLinter(ast.NodeVisitor):
     def visit_ClassDef(self, node: ast.ClassDef) -> None:
         self._rule_nns109(node)
         self._rule_nns114_append(node)
+        self._rule_nns115(node)
         self.generic_visit(node)
 
     # -- rules ---------------------------------------------------------------
@@ -613,6 +632,93 @@ class _FileLinter(ast.NodeVisitor):
                         hint=f"bind self.{attr} to deque(maxlen=...) (or "
                              f"prune at a cap), or justify a bounded-by-"
                              f"construction container with a pragma")
+
+    def _rule_nns115(self, node: ast.ClassDef) -> None:
+        """Key drift between a checkpoint save/load pair: the literal
+        keys the save method writes vs the keys the load method reads.
+        Either side having NO literal keys means a dynamic schema —
+        no evidence of drift, so no finding."""
+        methods = {stmt.name: stmt for stmt in node.body
+                   if isinstance(stmt, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))}
+        for save_name, load_name in _CKPT_PAIRS:
+            save = methods.get(save_name)
+            load = methods.get(load_name)
+            if save is None or load is None:
+                continue
+            written = self._ckpt_keys_written(save)
+            read = self._ckpt_keys_read(load)
+            if not written or not read:
+                continue
+            drift = []
+            missing = sorted(written - read)
+            extra = sorted(read - written)
+            if missing:
+                drift.append("saved but never restored: "
+                             + ", ".join(repr(k) for k in missing))
+            if extra:
+                drift.append("restored but never saved: "
+                             + ", ".join(repr(k) for k in extra))
+            if not drift:
+                continue
+            self.emit(
+                "NNS115", save,
+                f"{node.name}.{save_name}()/{load_name}() checkpoint "
+                f"key sets drift — " + "; ".join(drift),
+                hint="make the save-side literal keys and the load-side "
+                     "reads symmetric (a saved key the load never reads "
+                     "is dead state; a read key the save never writes is "
+                     "always absent), or justify an intentional "
+                     "asymmetry with a pragma")
+
+    @staticmethod
+    def _ckpt_keys_written(func: ast.AST) -> Set[str]:
+        """String-literal keys the save method writes: dict-literal
+        keys, ``d["k"] = ...`` subscript stores, and ``dict(k=...)``
+        keywords."""
+        out: Set[str] = set()
+        for sub in ast.walk(func):
+            if isinstance(sub, ast.Dict):
+                for k in sub.keys:
+                    if isinstance(k, ast.Constant) and \
+                            isinstance(k.value, str):
+                        out.add(k.value)
+            elif isinstance(sub, ast.Assign):
+                for t in sub.targets:
+                    if isinstance(t, ast.Subscript) and \
+                            isinstance(t.slice, ast.Constant) and \
+                            isinstance(t.slice.value, str):
+                        out.add(t.slice.value)
+            elif isinstance(sub, ast.Call) and \
+                    _dotted(sub.func) == "dict":
+                for kw in sub.keywords:
+                    if kw.arg:
+                        out.add(kw.arg)
+        return out
+
+    @staticmethod
+    def _ckpt_keys_read(func: ast.AST) -> Set[str]:
+        """String-literal keys the load method reads: ``state["k"]``
+        subscript loads and ``.get("k")`` / ``.pop("k")`` calls."""
+        out: Set[str] = set()
+        stored: Set[int] = set()
+        for sub in ast.walk(func):
+            if isinstance(sub, ast.Assign):
+                for t in sub.targets:
+                    if isinstance(t, ast.Subscript):
+                        stored.add(id(t))
+        for sub in ast.walk(func):
+            if isinstance(sub, ast.Subscript) and id(sub) not in stored \
+                    and isinstance(sub.slice, ast.Constant) and \
+                    isinstance(sub.slice.value, str):
+                out.add(sub.slice.value)
+            elif isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr in ("get", "pop") and sub.args and \
+                    isinstance(sub.args[0], ast.Constant) and \
+                    isinstance(sub.args[0].value, str):
+                out.add(sub.args[0].value)
+        return out
 
     @staticmethod
     def _unbounded_init_attrs(node: ast.ClassDef) -> Set[str]:

@@ -77,6 +77,11 @@ CODE_TABLE: Dict[str, str] = {
               "hot-path recording function (always-on telemetry records "
               "on every frame for the process lifetime — an unbounded "
               "container there is a slow leak)",
+    "NNS115": "checkpoint save/load key-set drift: a snapshot/restore or "
+              "checkpoint_state/restore_state pair whose literal state "
+              "keys disagree (a saved key the load never reads is dead "
+              "state; a read key the save never writes is absent on "
+              "every real restore)",
     "NNS199": "nns-lint pragma without a justification",
 }
 

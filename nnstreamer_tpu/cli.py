@@ -266,6 +266,22 @@ def main(argv=None) -> int:
                          "same, NNSTPU_FLIGHT=0 disables recording "
                          "entirely (see docs/profiling.md, Flight "
                          "recorder)")
+    ap.add_argument("--checkpoint-dir", metavar="DIR", default=None,
+                    help="arm serving continuity: restore durable "
+                         "serving state (repo slots, scheduler "
+                         "estimates, residency LRU order, latency "
+                         "quantiles) from DIR at start when a "
+                         "checkpoint exists, and write one at stop; "
+                         "also arms the persistent XLA compile cache "
+                         "under DIR/xla-cache so a second boot "
+                         "performs zero serving-path compilations. "
+                         "NNSTPU_CHECKPOINT=DIR does the same; unset "
+                         "runs the byte-identical no-op path (see "
+                         "docs/robustness.md, Serving continuity)")
+    ap.add_argument("--compile-cache", metavar="DIR", default=None,
+                    help="arm only the persistent XLA compile cache at "
+                         "DIR (no checkpoint/restore); "
+                         "NNSTPU_COMPILE_CACHE=DIR does the same")
     ap.add_argument("--slo-budget-ms", type=float, default=None,
                     metavar="MS",
                     help="pipeline-wide SLO latency budget: activates "
@@ -354,6 +370,12 @@ def main(argv=None) -> int:
         pipe.watchdog_s = max(0.0, args.watchdog_s)
     if args.flight_dir is not None:
         pipe.flight_dir = args.flight_dir
+    if args.checkpoint_dir is not None:
+        pipe.checkpoint_dir = args.checkpoint_dir
+    if args.compile_cache is not None:
+        from nnstreamer_tpu.pipeline.continuity import enable_compile_cache
+
+        enable_compile_cache(args.compile_cache)
 
     if args.verbose:
         for el in pipe.elements:

@@ -429,8 +429,19 @@ class TensorFilter(Element):
                 self.fw.handle_event("reload_model", event.data)
                 self._obs_invoke()["reloads"].inc()
                 self.log.info("model reloaded")
+                # a filter folded into a whole-graph program keeps
+                # serving the stale compiled weights until its region
+                # re-pulls stages: invalidate here exactly as the
+                # app-facing reload_model() path does (the re-trace is
+                # counted in nns_fuse_retraces_total at trace time)
+                self._invalidate_region()
             return  # consumed
         super().sink_event(pad, event)
+
+    def _invalidate_region(self) -> None:
+        region = getattr(self, "_fused_region", None)
+        if region is not None:
+            region.invalidate()
 
     def reload_model(self, model: Optional[str] = None) -> None:
         """App-facing hot reload (reference RELOAD_MODEL event)."""
@@ -440,6 +451,4 @@ class TensorFilter(Element):
         if self.fw is not None:
             self.fw.handle_event("reload_model", data)
             self._obs_invoke()["reloads"].inc()
-        region = getattr(self, "_fused_region", None)
-        if region is not None:
-            region.invalidate()
+        self._invalidate_region()

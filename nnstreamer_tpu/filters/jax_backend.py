@@ -275,6 +275,47 @@ class JaxFilter(FilterFramework):
             f".msgpack file, not a {'/'.join(ARTIFACT_EXTS)} artifact)"
         )
 
+    def install_weights(self, params: Any, epoch: int = 0) -> Dict[str, Any]:
+        """In-place params swap for ``Pipeline.swap_model`` (serving
+        continuity): the model *function* is unchanged, so the fused
+        region's trace key is unchanged and the swap is a consts swap —
+        no XLA recompile, no ``_fn_token`` bump.
+
+        Under an HBM budget the new params register as a NEW residency
+        unit keyed by the swap epoch and the old epoch's unit retires in
+        the same step — without the retire every swap would leak
+        ``nns_mem_used_bytes`` until process exit."""
+        import jax
+
+        if self._fn is None:
+            raise RuntimeError("jax: install_weights before open()")
+        tgt = self._sharding.replicated() if self._sharding else self._device
+        acct = _memory.ACTIVE
+        out: Dict[str, Any] = {"residency": None, "retired": None}
+        if acct is not None:
+            host_params = params
+
+            def _load(hp, _tgt=tgt):
+                return jax.device_put(hp, _tgt)
+
+            old = self._resident
+            new_key = f"jax:{id(self)}:e{int(epoch)}"
+            self._resident = acct.residency.register(
+                key=new_key, host_value=host_params,
+                nbytes=_memory.pytree_nbytes(host_params),
+                loader=_load,
+                label=f"{self.props.model}@e{int(epoch)}")
+            self._params = host_params
+            if old is not None:
+                acct.residency.unregister(old.key)
+                out["retired"] = old.key
+            out["residency"] = new_key
+            self._resident.value()  # load now, under the budget
+        else:
+            self._params = jax.device_put(params, tgt)
+        self._jitted = None  # the pytree structure may have changed
+        return out
+
     def close(self) -> None:
         if self._resident is not None:
             acct = _memory.ACTIVE

@@ -454,6 +454,41 @@ class FlightRecorder(_timeline.Timeline):
         })
         return base
 
+    # -- serving continuity ---------------------------------------------------
+    def checkpoint_state(self) -> Dict[str, Any]:
+        """Durable recorder state for ``Pipeline.checkpoint()``: the P²
+        marker sets (so stage/e2e quantile gauges resume warm), the
+        completed-frame attribution ring, and the completion count.
+        Burn-rate windows are NOT included — their events are anchored
+        to this process's monotonic clock and a restored breach history
+        would fire stale overload signals in the new process."""
+        with self._fl_lock:
+            vectors = list(self._vectors)
+            completed = self._completed
+            rolling_med = self._rolling_med
+        return {
+            "quantiles": {name: {w: q.snapshot() for w, q in qs.items()}
+                          for name, qs in self._q.items()},
+            "vectors": vectors,
+            "completed": completed,
+            "rolling_med": rolling_med,
+        }
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        for name, pair in (state.get("quantiles") or {}).items():
+            qs = self._q.get(name)
+            if qs is None:
+                continue
+            for w, qstate in pair.items():
+                q = qs.get(w)
+                if q is not None:
+                    q.restore(qstate)
+        med = state.get("rolling_med")
+        with self._fl_lock:
+            self._vectors.extend(state.get("vectors") or ())
+            self._completed = int(state.get("completed", 0))
+            self._rolling_med = float(med) if med is not None else None
+
     # -- gauges ---------------------------------------------------------------
     def register_gauges(self) -> None:
         """Export the streaming quantiles and burn rates through the

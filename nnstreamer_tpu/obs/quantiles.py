@@ -140,6 +140,26 @@ class P2Quantile:
                 return self._heights[idx]
             return self._heights[2]
 
+    # -- serving continuity --------------------------------------------------
+    def snapshot(self) -> dict:
+        """The complete serializable marker state — restoring it into a
+        fresh instance of the same ``p`` resumes the estimate exactly
+        where the previous process left it (warm-up included)."""
+        with self._lock:
+            return {
+                "count": self._count,
+                "heights": list(self._heights),
+                "pos": list(self._pos),
+                "want": list(self._want),
+            }
+
+    def restore(self, state: dict) -> None:
+        with self._lock:
+            self._count = int(state["count"])
+            self._heights = [float(v) for v in state["heights"]]
+            self._pos = [float(v) for v in state["pos"]]
+            self._want = [float(v) for v in state["want"]]
+
 
 class BurnRateWindow:
     """Sliding-window SLO burn rate over completion events.

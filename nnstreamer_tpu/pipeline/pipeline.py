@@ -878,6 +878,11 @@ class Pipeline:
         #: itself is always on unless NNSTPU_FLIGHT=0.
         self.flight_dir: Optional[str] = None
         self._flight = None
+        #: serving-continuity checkpoint directory
+        #: (pipeline/continuity.py); None defers to NNSTPU_CHECKPOINT.
+        #: Unset ⇒ the continuity layer never runs (exact kill switch).
+        self.checkpoint_dir: Optional[str] = None
+        self._continuity_restored = False
         # export per-element latency/throughput gauges at scrape time
         # (weakref-bound: a collected pipeline unregisters itself)
         register_pipeline_collector(self)
@@ -962,6 +967,35 @@ class Pipeline:
             out["attribution"] = self._flight.attribution()
         return out
 
+    # -- serving continuity (pipeline/continuity.py) ---------------------------
+    def swap_model(self, filter_name: str, model: Optional[str] = None,
+                   weights: Any = None) -> Dict[str, Any]:
+        """Zero-downtime versioned model swap on a running pipeline:
+        drain the owning dispatch window (the cutover fence), install
+        the new model/weights under a bumped epoch, invalidate the
+        owning fused region exactly once. No frames are dropped and
+        output is byte-identical up to the cutover seq."""
+        from nnstreamer_tpu.pipeline import continuity as _continuity
+
+        return _continuity.swap_model(self, filter_name, model=model,
+                                      weights=weights)
+
+    def checkpoint(self, directory: Optional[str] = None) -> str:
+        """Serialize the durable serving state (repo slots, scheduler
+        EWMAs/knobs, residency LRU order, flight-recorder quantiles,
+        query-server dedup windows) into ``directory`` — defaults to
+        ``checkpoint_dir`` / ``NNSTPU_CHECKPOINT``."""
+        from nnstreamer_tpu.pipeline import continuity as _continuity
+
+        return _continuity.checkpoint(self, directory)
+
+    def restore(self, directory: Optional[str] = None) -> Dict[str, Any]:
+        """Re-arm the warm serving state from a checkpoint written by
+        :meth:`checkpoint` (typically in a previous process)."""
+        from nnstreamer_tpu.pipeline import continuity as _continuity
+
+        return _continuity.restore(self, directory)
+
     # -- state ----------------------------------------------------------------
     def start(self) -> "Pipeline":
         """NULL→PLAYING: start all elements (non-sources first so queues and
@@ -982,6 +1016,12 @@ class Pipeline:
         # NNSTPU_HBM_BUDGET unset leaves memory.ACTIVE None and no
         # accounting hook anywhere ever fires
         _memory.maybe_activate_env()
+        # persistent compile cache (pipeline/continuity.py): must arm
+        # before any backend open() can jit — NNSTPU_COMPILE_CACHE (or
+        # an armed checkpoint dir) unset leaves this at two env reads
+        from nnstreamer_tpu.pipeline import continuity as _continuity
+
+        _continuity.maybe_enable_compile_cache_env(self)
         sources = [e for e in self.elements if isinstance(e, SourceElement)]
         others = [e for e in self.elements if not isinstance(e, SourceElement)]
         # SLO scheduler before any element starts: admission-point
@@ -1025,6 +1065,10 @@ class Pipeline:
             self._lane_execs = splice_lanes(self, effective_lanes(self.lanes))
         for ex in self._lane_execs:
             ex.start()
+        # serving-continuity restore (pipeline/continuity.py): after the
+        # scheduler / flight recorder / residency units exist, before the
+        # first frame flows — so the warm state is in place for frame 0
+        _continuity.maybe_restore_env(self)
         for el in sources:
             el.start()
         self.state = State.PLAYING
@@ -1097,6 +1141,12 @@ class Pipeline:
 
         release_all_pools()
         self.state = State.NULL
+        # serving-continuity checkpoint (pipeline/continuity.py): every
+        # element is stopped and every dispatch window drained, so the
+        # serialized state is consistent. Unarmed ⇒ one env read.
+        from nnstreamer_tpu.pipeline import continuity as _continuity
+
+        _continuity.maybe_checkpoint_on_stop(self)
         # retire the flight recorder before the env-owned export check:
         # a pending tail dump near EOS flushes here, and the recorder
         # object stays on self._flight for the post-EOS footer / bench

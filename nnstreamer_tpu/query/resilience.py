@@ -330,6 +330,26 @@ class DedupWindow:
         with self._lock:
             return len(self._entries)
 
+    # -- serving continuity --------------------------------------------------
+    def snapshot(self) -> dict:
+        """Checkpointable window state: resolved replies only. PENDING
+        entries are dropped — after a restart the in-flight invocation
+        is gone, and the client's resend must re-invoke, which dedup
+        handles exactly as a first send."""
+        with self._lock:
+            entries = [(rid, reply) for rid, reply in self._entries.items()
+                       if reply is not PENDING]
+        return {"size": self.size, "entries": entries}
+
+    def restore(self, state: dict) -> None:
+        with self._lock:
+            self.size = max(self.size, int(state.get("size", self.size)))
+            for rid, reply in state.get("entries", ()):
+                self._entries[rid] = reply
+                self._entries.move_to_end(rid)
+            while len(self._entries) > self.size:
+                self._entries.popitem(last=False)
+
 
 @dataclasses.dataclass
 class PendingEntry:

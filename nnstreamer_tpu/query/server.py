@@ -15,7 +15,7 @@ import struct
 import sys
 import threading
 import time
-from typing import Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 from nnstreamer_tpu.log import get_logger
 from nnstreamer_tpu.query import protocol as P
@@ -391,6 +391,36 @@ class QueryServer:
         """Scheduler-shed hook (``resilience.note_remote_shed``): the
         remote SLO scheduler dropped this frame before dispatch."""
         self._expire_req(instance, req_id)
+
+    # -- serving continuity --------------------------------------------------
+    def checkpoint_state(self) -> Dict[str, Any]:
+        """Durable resilient-protocol state for a rolling restart: the
+        per-instance dedup windows (resolved replies only — they are
+        plain command/bytes tuples) plus the chaos-test witness
+        counters. Connection maps are NOT included: sockets die with
+        the process, and each client's reconnect HELLO re-binds its
+        instance to the new connection, landing resends in its restored
+        window."""
+        with self._clients_lock:
+            windows = dict(self._dedup)
+        return {
+            "dedup": {inst: w.snapshot() for inst, w in windows.items()},
+            "dedup_hits": self.dedup_hits,
+            "remote_expired": self.remote_expired,
+        }
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        for inst, wstate in (state.get("dedup") or {}).items():
+            with self._clients_lock:
+                w = self._dedup.get(inst)
+                if w is None:
+                    # default-sized window: restore() below adopts the
+                    # saved size, keeping this method's key reads
+                    # symmetric with checkpoint_state (NNS115)
+                    w = self._dedup[inst] = _res.DedupWindow()
+            w.restore(wstate)
+        self.dedup_hits += int(state.get("dedup_hits", 0))
+        self.remote_expired += int(state.get("remote_expired", 0))
 
     # -- reference-wire reconstruction --------------------------------------
     def _refwire_buf(self, client_id: int, info: dict,

@@ -132,6 +132,21 @@ class ServiceRateEstimator:
         s = self.service_time_s()
         return (1.0 / s) if s > 0 else 0.0
 
+    # -- serving continuity ---------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """Checkpointable state: the two EWMAs only.
+        ``_last_completion_t`` is a monotonic-clock anchor — meaningless
+        in another process, it re-anchors on the first completion."""
+        with self._lock:
+            return {"invoke_s": self._invoke_s, "drain_s": self._drain_s}
+
+    def restore(self, state: Dict[str, Any]) -> None:
+        with self._lock:
+            inv = state.get("invoke_s")
+            drn = state.get("drain_s")
+            self._invoke_s = float(inv) if inv is not None else None
+            self._drain_s = float(drn) if drn is not None else None
+
 
 class FeedbackController:
     """Event-driven AIMD over ``batch_cap`` and ``inflight``.
@@ -199,6 +214,32 @@ class FeedbackController:
             # between budget and p99_factor*budget: hold — the dead band
             # keeps the knobs from oscillating around the target
             return (self.batch_cap, self.inflight) != (cap0, inf0)
+
+    # -- serving continuity ---------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """Checkpointable state: AIMD knobs + the completion window.
+        ``_last_step_t`` stays 0 — it is a monotonic-clock anchor, and
+        restoring it would block the first post-restore step."""
+        with self._lock:
+            return {
+                "batch_cap": self.batch_cap,
+                "inflight": self.inflight,
+                "steps": self.steps,
+                "last_p99_s": self.last_p99_s,
+                "latencies": list(self._lat),
+            }
+
+    def restore(self, state: Dict[str, Any]) -> None:
+        with self._lock:
+            self.batch_cap = max(1, int(state.get("batch_cap",
+                                                  self.batch_cap)))
+            self.inflight = max(1, int(state.get("inflight",
+                                                 self.inflight)))
+            self.steps = int(state.get("steps", 0))
+            p99 = state.get("last_p99_s")
+            self.last_p99_s = float(p99) if p99 is not None else None
+            self._lat.clear()
+            self._lat.extend(state.get("latencies", ()))
 
 
 class SloScheduler:
@@ -528,6 +569,38 @@ class SloScheduler:
     def shed_total(self) -> int:
         return int(self._m["shed_late"].value
                    + self._m["shed_capacity"].value)
+
+    # -- serving continuity ---------------------------------------------------
+    # (checkpoint_state/restore_state, distinct from the reporting
+    # snapshot() above — NNS115 checks the pair's key symmetry)
+    def checkpoint_state(self) -> Dict[str, Any]:
+        """The durable serving state a restarted process would otherwise
+        re-learn from cold: the service-rate EWMAs and the controller's
+        AIMD knobs/window, plus the advisory knob outputs. Counters stay
+        in the metrics registry — they are observability, not state."""
+        return {
+            "estimator": self.estimator.snapshot(),
+            "controller": self.controller.snapshot(),
+            "lanes_hint": self._lanes_hint,
+            "mem_hold": self._mem_hold,
+        }
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        est = state.get("estimator")
+        if est:
+            self.estimator.restore(est)
+        ctl = state.get("controller")
+        if ctl:
+            self.controller.restore(ctl)
+        self._mem_hold = int(state.get("mem_hold", 0))
+        # push the restored inflight target onto the elements now —
+        # otherwise the warm knobs only take effect after the first
+        # post-restore controller step (this recomputes the lanes hint
+        # from the fresh process's zeroed shed counters, so the saved
+        # hint is applied after and the larger recommendation wins)
+        self._apply_knobs()
+        self._lanes_hint = max(self._lanes_hint,
+                               int(state.get("lanes_hint", 0)))
 
 
 def ensure_scheduler(pipeline) -> Optional[SloScheduler]:

@@ -244,6 +244,22 @@ def is_device_array(x) -> bool:
     return isinstance(x, jax.Array)
 
 
+def _host_owned(t) -> np.ndarray:
+    """D2H that OWNS its bytes. ``np.asarray`` on a CPU jax array can be
+    a zero-copy view into the XLA buffer; once that buffer is released
+    (dispatch-window fence) and its memory reused, the view silently
+    reads the NEXT tenant's bytes. Donating fused programs make this
+    real: a persistent-cache-deserialized executable keeps its
+    input-output aliasing (the in-process compile drops it for host
+    inputs), so warm-boot outputs live in donated slabs with exactly
+    that lifetime. Real accelerators already return owning arrays here,
+    so the copy triggers only where the aliasing hazard exists."""
+    v = np.asarray(t)
+    if v.base is not None or not v.flags.owndata:
+        v = np.array(v)  # defensive copy: detach from the XLA buffer
+    return v
+
+
 @dataclasses.dataclass
 class TensorBuffer:
     """One frame of a tensor stream.
@@ -328,7 +344,7 @@ class TensorBuffer:
             if isinstance(t, np.ndarray):
                 out.append(t)
             else:
-                out.append(np.asarray(t))
+                out.append(_host_owned(t))
                 moved += _device_nbytes(t)
         if moved:
             _fault_check("transfer.d2h", self.meta)
@@ -470,7 +486,7 @@ class DeviceBuffer(TensorBuffer):
                 if isinstance(t, np.ndarray):
                     host.append(t)
                 else:
-                    host.append(np.asarray(t))
+                    host.append(_host_owned(t))
                     moved += _device_nbytes(t)
             if moved:
                 _fault_check("transfer.d2h", self.meta)

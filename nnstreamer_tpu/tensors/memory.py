@@ -246,6 +246,33 @@ class ResidencyManager:
         return {"units": units,
                 "resident": sum(1 for u in units if u["resident"])}
 
+    # -- serving continuity --------------------------------------------------
+    # (checkpoint_state/restore_state, distinct from the reporting
+    # snapshot() above — NNS115 checks the pair's key symmetry)
+    def checkpoint_state(self) -> Dict[str, Any]:
+        """Durable state for ``Pipeline.checkpoint()``: the LRU order,
+        coldest-first, by LABEL. Unit keys embed ``id()``s and are not
+        stable across processes; labels (the model identity) are."""
+        with self._lock:
+            return {"lru": [u.label for u in self._units.values()]}
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        """Re-impose a saved LRU order onto the units the new process
+        registered: each saved label's first matching unit moves to the
+        warm end in saved order, so the first pressure event evicts the
+        same victims the old process would have. Units with no saved
+        label (new models) end up coldest — they have no history to
+        claim warmth from."""
+        order = state.get("lru") or []
+        with self._lock:
+            by_label: Dict[str, list] = {}
+            for key, u in self._units.items():
+                by_label.setdefault(u.label, []).append(key)
+            for label in order:
+                keys = by_label.get(label)
+                if keys:
+                    self._units.move_to_end(keys.pop(0))
+
 
 class HbmBudget:
     """Process-wide device-memory budget: tracked entry points register

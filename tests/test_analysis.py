@@ -185,7 +185,7 @@ class TestVerifierGraph:
         # any diagnostic the verifier can emit has a CODE_TABLE row
         # (docs/linting.md renders from the same table)
         assert {"NNS001", "NNS005", "NNS011", "NNS101", "NNS109",
-                "NNS110", "NNS111", "NNS112", "NNS114",
+                "NNS110", "NNS111", "NNS112", "NNS114", "NNS115",
                 "NNS199"} <= set(CODE_TABLE)
 
 
@@ -532,6 +532,55 @@ class TestAstLint:
                "    q.append(x)\n")
         assert by_code(
             lint_source(src, "nnstreamer_tpu/obs/q.py"), "NNS114") == []
+
+    def test_nns115_key_drift_both_directions(self):
+        src = ("class C:\n"
+               "    def snapshot(self):\n"
+               "        return {'a': 1, 'b': 2}\n"
+               "    def restore(self, state):\n"
+               "        self.a = state['a']\n"
+               "        self.c = state.get('c', 0)\n")
+        errs = by_code(lint_source(src, "x.py"), "NNS115")
+        assert len(errs) == 1
+        assert "'b'" in errs[0].message and "'c'" in errs[0].message
+
+    def test_nns115_symmetric_pair_ok(self):
+        src = ("class C:\n"
+               "    def checkpoint_state(self):\n"
+               "        out = {'a': 1}\n"
+               "        out['b'] = 2\n"
+               "        return out\n"
+               "    def restore_state(self, state):\n"
+               "        self.a = state.pop('a')\n"
+               "        self.b = state.get('b', 0)\n")
+        assert by_code(lint_source(src, "x.py"), "NNS115") == []
+
+    def test_nns115_dynamic_schema_skipped(self):
+        # TensorRepo-style: save side has no literal keys, so there
+        # is no evidence of drift
+        src = ("class Repo:\n"
+               "    def snapshot(self):\n"
+               "        return {k: v.data for k, v in self.s.items()}\n"
+               "    def restore(self, state):\n"
+               "        self.magic = state['magic']\n")
+        assert by_code(lint_source(src, "x.py"), "NNS115") == []
+
+    def test_nns115_save_only_class_not_checked(self):
+        # reporting-only snapshot() with no restore() is not a
+        # checkpoint pair
+        src = ("class Gauge:\n"
+               "    def snapshot(self):\n"
+               "        return {'value': self.v}\n")
+        assert by_code(lint_source(src, "x.py"), "NNS115") == []
+
+    def test_nns115_pragma_suppressible(self):
+        src = ("class C:\n"
+               "    def snapshot(self):  # nns-lint: disable=NNS115 -- "
+               "legacy key kept for old readers\n"
+               "        return {'a': 1, 'legacy': 0}\n"
+               "    def restore(self, state):\n"
+               "        self.a = state['a']\n")
+        assert by_code(lint_source(src, "x.py"), "NNS115") == []
 
     def test_pragma_suppresses_with_reason(self):
         src = ("import time\n"
