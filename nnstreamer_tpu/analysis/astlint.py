@@ -90,6 +90,15 @@ These rules encode invariants this codebase has already been burned by
   stops round-tripping, a key restored but never saved reads as absent
   on every real checkpoint. Classes whose schema is dynamic (no
   literal keys on one side, e.g. ``TensorRepo``) are skipped.
+- NNS116: a wire-header ``struct.Struct`` whose field count disagrees
+  with a pack/unpack site. For every ``NAME = struct.Struct("<fmt>")``
+  binding in a file, each ``NAME.pack(...)`` must pass exactly as many
+  values as the format has fields, and each tuple-unpacking
+  ``a, b, ... = NAME.unpack[_from](...)`` must bind exactly that many
+  names. The query protocol's framed headers (``_HDR``, ``_EXT_HDR``,
+  ``_EXT2_HDR``, ...) are evolved by editing the format string and its
+  pack/unpack sites in separate places — a count mismatch raises only
+  at runtime, on the first real frame, usually on the peer.
 
 Findings are suppressed per-line with::
 
@@ -200,6 +209,21 @@ def _is_obs_record_func(name: str) -> bool:
         name.startswith(_OBS_RECORD_PREFIXES)
 
 
+def _struct_field_count(fmt: str) -> Optional[int]:
+    """Exact field count of a struct format string, or None when the
+    format itself is invalid (that's the runtime's error to raise, not
+    a lint finding). Computed by the struct module itself — pad bytes,
+    repeat counts, and the s/p single-field rules come out right by
+    construction."""
+    import struct as _struct
+
+    try:
+        st = _struct.Struct(fmt)
+        return len(st.unpack(bytes(st.size)))
+    except _struct.error:
+        return None
+
+
 def _parse_pragmas(text: str) -> Tuple[Dict[int, Set[str]], List[int]]:
     """Per-line suppressed codes, plus lines with a reasonless pragma."""
     suppressed: Dict[int, Set[str]] = {}
@@ -243,6 +267,10 @@ class _FileLinter(ast.NodeVisitor):
         self._timeout_discipline: Dict[int, bool] = {}  # id(fnode) → bool
         self._wall_lines: Set[int] = set()
         self._collect_wall_bindings(tree)
+        #: NNS116: NAME → field count for every ``NAME = struct.Struct(
+        #: "<literal>")`` binding in this file
+        self._struct_fields: Dict[str, int] = {}
+        self._collect_struct_bindings(tree)
         #: NNS114 applies only inside the obs package
         self._in_obs = "obs" in Path(rel).parts
 
@@ -269,6 +297,35 @@ class _FileLinter(ast.NodeVisitor):
                     for sub in ast.walk(node):
                         if hasattr(sub, "lineno"):
                             self._wall_lines.add(sub.lineno)
+
+    def _collect_struct_bindings(self, tree: ast.Module) -> None:
+        """``NAME = struct.Struct("<literal fmt>")`` bindings anywhere in
+        the file (module or class level) — the wire headers NNS116
+        checks pack/unpack sites against. A name bound twice with
+        different formats is ambiguous and dropped."""
+        ambiguous: Set[str] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            value = node.value
+            if not (isinstance(value, ast.Call)
+                    and _dotted(value.func) in ("struct.Struct", "Struct")
+                    and value.args
+                    and isinstance(value.args[0], ast.Constant)
+                    and isinstance(value.args[0].value, str)):
+                continue
+            count = _struct_field_count(value.args[0].value)
+            if count is None:
+                continue
+            for t in node.targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                prior = self._struct_fields.get(t.id)
+                if prior is not None and prior != count:
+                    ambiguous.add(t.id)
+                self._struct_fields[t.id] = count
+        for name in ambiguous:
+            self._struct_fields.pop(name, None)
 
     # -- visitors ------------------------------------------------------------
     def visit_With(self, node: ast.With) -> None:
@@ -307,6 +364,11 @@ class _FileLinter(ast.NodeVisitor):
         self._rule_nns112(node, dotted)
         self._rule_nns113(node, dotted)
         self._rule_nns114_deque(node, dotted)
+        self._rule_nns116_pack(node)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self._rule_nns116_unpack(node)
         self.generic_visit(node)
 
     def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
@@ -632,6 +694,65 @@ class _FileLinter(ast.NodeVisitor):
                         hint=f"bind self.{attr} to deque(maxlen=...) (or "
                              f"prune at a cap), or justify a bounded-by-"
                              f"construction container with a pragma")
+
+    def _rule_nns116_pack(self, node: ast.Call) -> None:
+        """``NAME.pack(...)`` / ``NAME.pack_into(buf, off, ...)`` whose
+        value count disagrees with NAME's format field count."""
+        if not self._struct_fields:
+            return
+        func = node.func
+        if not (isinstance(func, ast.Attribute)
+                and func.attr in ("pack", "pack_into")
+                and isinstance(func.value, ast.Name)):
+            return
+        expected = self._struct_fields.get(func.value.id)
+        if expected is None:
+            return
+        if any(isinstance(a, ast.Starred) for a in node.args) \
+                or node.keywords:
+            return  # dynamic arity: no evidence of a mismatch
+        args = node.args[2:] if func.attr == "pack_into" else node.args
+        if len(args) == expected:
+            return
+        self.emit(
+            "NNS116", node,
+            f"{func.value.id}.{func.attr}() passes {len(args)} value(s) "
+            f"but the format declares {expected} field(s) — this wire "
+            f"header raises struct.error on the first real frame",
+            hint="the format string and its pack/unpack sites evolved "
+                 "apart; update whichever side is stale (every site "
+                 "must agree with the struct.Struct field count)")
+
+    def _rule_nns116_unpack(self, node: ast.Assign) -> None:
+        """``a, b, ... = NAME.unpack[_from](...)`` whose tuple arity
+        disagrees with NAME's format field count. A non-tuple target
+        (``vals = ...``) or a starred element is dynamic — skipped."""
+        if not self._struct_fields:
+            return
+        value = node.value
+        if not (isinstance(value, ast.Call)
+                and isinstance(value.func, ast.Attribute)
+                and value.func.attr in ("unpack", "unpack_from")
+                and isinstance(value.func.value, ast.Name)):
+            return
+        expected = self._struct_fields.get(value.func.value.id)
+        if expected is None or len(node.targets) != 1:
+            return
+        target = node.targets[0]
+        if not isinstance(target, ast.Tuple) or \
+                any(isinstance(e, ast.Starred) for e in target.elts):
+            return
+        if len(target.elts) == expected:
+            return
+        self.emit(
+            "NNS116", node,
+            f"unpacking {value.func.value.id}.{value.func.attr}() into "
+            f"{len(target.elts)} name(s) but the format declares "
+            f"{expected} field(s) — this wire header raises ValueError "
+            f"on the first real frame",
+            hint="the format string and its pack/unpack sites evolved "
+                 "apart; update whichever side is stale (every site "
+                 "must agree with the struct.Struct field count)")
 
     def _rule_nns115(self, node: ast.ClassDef) -> None:
         """Key drift between a checkpoint save/load pair: the literal

@@ -82,6 +82,11 @@ CODE_TABLE: Dict[str, str] = {
               "keys disagree (a saved key the load never reads is dead "
               "state; a read key the save never writes is absent on "
               "every real restore)",
+    "NNS116": "wire-header struct format vs pack/unpack site field-count "
+              "disagreement (a NAME.pack(...) passing the wrong number "
+              "of values, or a tuple-unpack binding the wrong number of "
+              "names, raises only at runtime — on the first real frame, "
+              "usually on the peer)",
     "NNS199": "nns-lint pragma without a justification",
 }
 

@@ -222,6 +222,12 @@ def main(argv=None) -> int:
                          "http://0.0.0.0:PORT/metrics (JSON at "
                          "/metrics.json) while the pipeline runs; "
                          "0 picks a free port (printed at startup)")
+    ap.add_argument("--fleet", default=None, metavar="ENDPOINTS",
+                    help="federate replica metrics: comma list of "
+                         "host:port /metrics.json endpoints (or "
+                         "op=NAME[,broker=HOST[:PORT]] for broker "
+                         "discovery); merged fleet view served at "
+                         "/fleet/metrics on the --metrics-port server")
     ap.add_argument("--export", nargs=2, metavar=("MODEL", "OUT"),
                     help="export a model (.py with get_model() / "
                          ".msgpack) as a compiled StableHLO artifact "
@@ -394,9 +400,26 @@ def main(argv=None) -> int:
     if args.metrics_port is not None:
         from nnstreamer_tpu.obs import MetricsServer
 
-        metrics_srv = MetricsServer(port=args.metrics_port).start()
+        federation = None
+        if args.fleet:
+            federation = _parse_fleet(args.fleet)
+
+        def _extra_sections(p=pipe):
+            # slo/attribution/quantiles parity between the in-process
+            # metrics_snapshot() and the scraped /metrics.json — what
+            # fleet federation consumes from each replica
+            snap = p.metrics_snapshot()
+            return {k: snap[k] for k in ("slo", "attribution", "quantiles")
+                    if k in snap}
+
+        metrics_srv = MetricsServer(port=args.metrics_port,
+                                    snapshot_fn=_extra_sections,
+                                    federation=federation).start()
         print(f"Serving metrics on "
               f"http://0.0.0.0:{metrics_srv.port}/metrics")
+        if federation is not None:
+            print(f"Serving fleet federation on "
+                  f"http://0.0.0.0:{metrics_srv.port}/fleet/metrics")
 
     print(f"Setting pipeline to PLAYING ({len(pipe.elements)} elements)...")
     try:
@@ -462,6 +485,37 @@ def _print_trace_breakdown(tl) -> None:
               f"{vr['e2e_mad_ms']:.2f}ms, dominated by "
               f"{vr['dominant_stage']} "
               f"({vr['dominant_share']:.0%} of the spread)")
+
+
+def _parse_fleet(spec: str):
+    """``--fleet`` argument → FederatedMetrics: either a comma list of
+    ``host:port`` scrape endpoints, or ``op=NAME[,broker=HOST[:PORT]]``
+    for broker discovery of replicas advertising a metrics_port."""
+    from nnstreamer_tpu.obs.distributed import FederatedMetrics
+
+    parts = [p.strip() for p in spec.split(",") if p.strip()]
+    if any(p.startswith("op=") for p in parts):
+        operation = broker_host = None
+        broker_port = 1883
+        for p in parts:
+            k, _, v = p.partition("=")
+            if k == "op":
+                operation = v
+            elif k == "broker":
+                h, _, pp = v.partition(":")
+                broker_host = h
+                if pp:
+                    broker_port = int(pp)
+        fed = FederatedMetrics(operation=operation,
+                               broker_host=broker_host or "127.0.0.1",
+                               broker_port=broker_port)
+        fed.discover()
+        return fed
+    endpoints = []
+    for p in parts:
+        host, _, port = p.rpartition(":")
+        endpoints.append((host or "127.0.0.1", int(port)))
+    return FederatedMetrics(endpoints=endpoints)
 
 
 def _print_stats(pipe) -> None:

@@ -235,9 +235,24 @@ class TensorPubSubSrc(SourceElement, _PubSubBase):
             buf = TensorBuffer(tensors, dts=msg["dts"],
                                duration=msg["duration"],
                                meta={"caps_str": msg["caps_str"]})
+            self._stamp_trace(buf, msg["sent_time_epoch"])
             return buf, msg["base_time_epoch"], msg["pts"]
-        base_epoch, _sent, pts, payload = parse_buffer_envelope(body)
-        return P.unpack_buffer(payload), base_epoch, pts
+        base_epoch, sent, pts, payload = parse_buffer_envelope(body)
+        buf = P.unpack_buffer(payload)
+        self._stamp_trace(buf, sent)
+        return buf, base_epoch, pts
+
+    @staticmethod
+    def _stamp_trace(buf: TensorBuffer, sent_epoch_ns) -> None:
+        """Both wire headers already carry a sender send-stamp (the
+        reference's ``sent_time`` field / the NPE2 envelope): surface it
+        as distributed-trace meta so the receiving pipeline's ledger can
+        attribute the hop — no wire change, works against reference
+        mqttsink peers."""
+        from nnstreamer_tpu.obs import distributed as _dist
+
+        if _dist.enabled() and sent_epoch_ns:
+            buf.meta[_dist.SENT_WALL_META] = float(sent_epoch_ns) / 1e9
 
     def create(self):
         n = int(self.get_property("num_buffers"))

@@ -18,6 +18,7 @@ import time
 import uuid
 from typing import List, Optional, Tuple
 
+from nnstreamer_tpu.obs import distributed as _dist
 from nnstreamer_tpu.obs import timeline as _timeline
 from nnstreamer_tpu.pipeline import faults as _faults
 from nnstreamer_tpu.pipeline.element import (
@@ -122,6 +123,9 @@ class TensorQueryClient(Element):
         self._r_breakers: dict = {}  # (host, port) → CircuitBreaker
         self._r_stats = _res.EndpointStats()
         self._r_endpoint: Optional[Tuple[str, int]] = None
+        #: this connection granted the dt1 distributed-trace feature in
+        #: its HELLO echo — only then do we speak TRANSFER_EX2
+        self._r_dt1 = False
 
     def set_property(self, key: str, value) -> None:
         if key.replace("-", "_") in ("frames_dropped", "frames_expired"):
@@ -370,7 +374,18 @@ class TensorQueryClient(Element):
         recomputed from the entry's deadline at every send, so a resend
         carries the budget that is actually left."""
         now = time.monotonic()
-        payload = P.pack_ext(entry.req_id, entry.slack_s(now), entry.body)
+        if self._r_dt1:
+            trace_id = entry.meta.get(_timeline.TRACE_SEQ_META)
+            entry.sent_wall = _dist.wall_now()
+            cmd = P.Cmd.TRANSFER_EX2
+            payload = P.pack_ext2(
+                entry.req_id, entry.slack_s(now),
+                int(trace_id) if trace_id is not None else entry.req_id,
+                entry.sent_wall, b"", entry.body)
+        else:
+            cmd = P.Cmd.TRANSFER_EX
+            payload = P.pack_ext(entry.req_id, entry.slack_s(now),
+                                 entry.body)
         fi = _faults.ACTIVE
         if fi is not None:
             act = fi.action("query.send",
@@ -386,15 +401,17 @@ class TensorQueryClient(Element):
                 # bytes, forgets the dedup entry, and kicks us — the
                 # resend after reconnect re-invokes exactly once
                 payload = payload[:max(1, len(payload) // 2)]
-        P.send_msg(self._sock, P.Cmd.TRANSFER_EX, payload)
+        P.send_msg(self._sock, cmd, payload)
         entry.sent_t = now
 
     def _r_hello(self) -> None:
         window = max(1, int(self.get_property("max_in_flight")))
+        self._r_dt1 = False
         P.send_msg(self._sock, P.Cmd.HELLO,
-                   f"{self._r_instance}:{max(64, window * 8)}".encode())
+                   f"{self._r_instance}:{max(64, window * 8)}"
+                   f"{_dist.hello_offer()}".encode())
         try:
-            cmd, _payload = P.recv_msg(self._sock)
+            cmd, payload = P.recv_msg(self._sock)
         except socket.timeout:
             raise P.QueryProtocolError(
                 "server did not acknowledge HELLO — reliable mode needs "
@@ -404,6 +421,7 @@ class TensorQueryClient(Element):
             raise P.QueryProtocolError(
                 f"bad HELLO reply {cmd} — reliable mode needs a "
                 f"tensor_query_serversrc started with reliable=true")
+        self._r_dt1 = _dist.hello_accepts(payload)
 
     def _r_resend_pending(self) -> None:
         """Resend the undelivered suffix in order after a reconnect.
@@ -567,6 +585,23 @@ class TensorQueryClient(Element):
                     continue  # dedup replay of an already-delivered result
                 if entry.sent_t:
                     self._r_stats.observe(time.monotonic() - entry.sent_t)
+                done.append((P.unpack_buffer(body), entry))
+                failures = 0
+            elif cmd is P.Cmd.RESULT_EX2:
+                req_id, _slack, _tid, _stamp, blob, body = \
+                    P.unpack_ext2(payload)
+                entry = self._r_pop_pending(req_id)
+                if entry is None:
+                    continue  # dedup replay of an already-delivered result
+                now = time.monotonic()
+                if entry.sent_t:
+                    self._r_stats.observe(now - entry.sent_t)
+                    # splice the remote span vector into this frame's
+                    # ledger, anchored inside our own RTT window
+                    _dist.splice_remote(
+                        tl, entry.meta.get(_timeline.TRACE_SEQ_META),
+                        entry.sent_t, now, entry.sent_wall,
+                        _dist.unpack_span_blob(blob))
                 done.append((P.unpack_buffer(body), entry))
                 failures = 0
             elif cmd is P.Cmd.EXPIRED:
@@ -748,6 +783,10 @@ class TensorQueryServerSrc(SourceElement):
         # pure-Python transport (the native epoll core only speaks the
         # classic commands); leave false for byte-identical classic wire
         "reliable": False,
+        # where this replica's MetricsServer /metrics.json lives —
+        # advertised through the broker so fleet federation
+        # (obs/distributed.py) can discover its scrape targets
+        "metrics_port": 0,
     }
 
     _SERVERS = {}
@@ -781,6 +820,7 @@ class TensorQueryServerSrc(SourceElement):
                 str(operation),
                 self.get_property("advertise_host"),
                 self.server.port,
+                metrics_port=int(self.get_property("metrics_port") or 0),
             )
             self._advertiser.publish()
 

@@ -203,6 +203,21 @@ class FlightRecorder(_timeline.Timeline):
         for s in sorted(self._frames)[:drop]:
             del self._frames[s]
 
+    def frame_stages(self, seq: int) -> Dict[str, float]:
+        """One frame's accumulated stage durations — O(1) from the
+        in-flight accumulator (overrides the Timeline's ring scan); a
+        frame that already completed is found in the attribution ring.
+        This is the span-vector source a query server reads at result
+        egress (obs/distributed)."""
+        with self._fl_lock:
+            d = self._frames.get(seq)
+            if d is not None:
+                return dict(d)
+            for s, vec in reversed(self._vectors):
+                if s == seq:
+                    return {k: v for k, v in vec.items() if k != "e2e"}
+        return {}
+
     # -- completion -----------------------------------------------------------
     def _complete(self, seq: int, e2e_s: float,
                   e2e_adm_s: Optional[float], t: float) -> None:
@@ -406,6 +421,14 @@ class FlightRecorder(_timeline.Timeline):
                 "triggers": dict(self.trigger_counts),
             }
         return out
+
+    def quantile_states(self) -> Dict[str, Dict[str, dict]]:
+        """Serializable P² marker states per stage — what a replica's
+        ``/metrics.json`` exposes so a FederatedMetrics aggregator can
+        marker-merge fleet quantiles without ever shipping samples."""
+        return {name: {w: q.snapshot() for w, q in qs.items()}
+                for name, qs in self._q.items()
+                if qs["p50"].count > 0}
 
     def attribution(self) -> Dict[str, Any]:
         """Continuous variance attribution over the completed-frame

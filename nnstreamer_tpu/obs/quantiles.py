@@ -161,6 +161,98 @@ class P2Quantile:
             self._want = [float(v) for v in state["want"]]
 
 
+def _snapshot_cdf_points(snap: dict) -> Optional[tuple]:
+    """Reduce one P² snapshot to piecewise-linear CDF support points
+    ``(count, heights, cum_probs)``; ``None`` for an empty snapshot.
+
+    Warm-up snapshots (count ≤ 5) hold exact sorted samples, so each
+    sample sits at its mid-rank. Converged snapshots hold five markers
+    whose ``pos`` entries are the marker's cumulative sample rank, so
+    marker *i* approximates the ``(pos[i] - 0.5) / count`` quantile.
+    """
+    n = int(snap.get("count", 0))
+    if n <= 0:
+        return None
+    heights = [float(v) for v in snap.get("heights", [])]
+    if not heights:
+        return None
+    pos = [float(v) for v in snap.get("pos", [])]
+    if n <= 5 or len(heights) < 5 or len(pos) < 5:
+        heights = sorted(heights)
+        probs = [(i + 0.5) / len(heights) for i in range(len(heights))]
+        return n, heights, probs
+    pairs = sorted(zip(heights, pos))
+    hs: List[float] = []
+    ps: List[float] = []
+    run = 0.0
+    for h, q in pairs:
+        prob = min(1.0, max(0.0, (q - 0.5) / n))
+        run = max(run, prob)  # CDF must be nondecreasing in both axes
+        hs.append(h)
+        ps.append(run)
+    return n, hs, ps
+
+
+def _cdf_eval(heights: List[float], probs: List[float], x: float) -> float:
+    if x < heights[0]:
+        return 0.0
+    if x >= heights[-1]:
+        return 1.0
+    for i in range(len(heights) - 1):
+        h0, h1 = heights[i], heights[i + 1]
+        if h0 <= x <= h1:
+            if h1 <= h0:
+                return probs[i + 1]
+            t = (x - h0) / (h1 - h0)
+            return probs[i] + t * (probs[i + 1] - probs[i])
+    return probs[-1]
+
+
+def merge_p2_snapshots(snapshots: List[dict], p: float) -> Optional[float]:
+    """Merge serialized :meth:`P2Quantile.snapshot` states from N
+    independent processes into one fleet-level quantile estimate.
+
+    Each snapshot's five markers define a piecewise-linear CDF through
+    the marker heights at their cumulative ranks; the merged estimate
+    inverts the count-weighted mixture of those CDFs at ``p``. This is
+    the "marker merge" the federation layer uses: replicas ship marker
+    state (40 bytes of floats), never raw samples, and the aggregate
+    stays within P²-class accuracy of the pooled-sample exact quantile.
+    Returns ``None`` when every snapshot is empty.
+    """
+    if not 0.0 < p < 1.0:
+        raise ValueError(f"quantile p must be in (0, 1), got {p}")
+    parts = []
+    total = 0
+    for snap in snapshots:
+        pts = _snapshot_cdf_points(snap)
+        if pts is None:
+            continue
+        parts.append(pts)
+        total += pts[0]
+    if total == 0:
+        return None
+
+    def mixture(x: float) -> float:
+        acc = 0.0
+        for n, hs, ps in parts:
+            acc += n * _cdf_eval(hs, ps, x)
+        return acc / total
+
+    lo = min(hs[0] for _, hs, _ in parts)
+    hi = max(hs[-1] for _, hs, _ in parts)
+    if hi <= lo:
+        return lo
+    # the mixture CDF is monotone: invert by bisection on the value axis
+    for _ in range(64):
+        mid = 0.5 * (lo + hi)
+        if mixture(mid) < p:
+            lo = mid
+        else:
+            hi = mid
+    return 0.5 * (lo + hi)
+
+
 class BurnRateWindow:
     """Sliding-window SLO burn rate over completion events.
 

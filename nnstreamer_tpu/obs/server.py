@@ -30,6 +30,14 @@ PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 class _Handler(BaseHTTPRequestHandler):
     registry: MetricsRegistry  # set on the subclass by MetricsServer
+    #: optional extra-sections provider (``slo`` / ``attribution`` /
+    #: ``quantiles`` from the pipeline's flight recorder) merged into
+    #: the /metrics.json snapshot — the in-process
+    #: ``metrics_snapshot()`` parity the footer readers asked for, and
+    #: what fleet federation scrapes
+    snapshot_fn = None
+    #: optional FederatedMetrics serving the /fleet/* routes
+    federation = None
 
     def do_GET(self):  # noqa: N802 — http.server API
         path = self.path.split("?", 1)[0]
@@ -37,7 +45,25 @@ class _Handler(BaseHTTPRequestHandler):
             body = self.registry.render_prometheus().encode()
             ctype = PROMETHEUS_CONTENT_TYPE
         elif path == "/metrics.json":
-            body = json.dumps(self.registry.snapshot()).encode()
+            snap = self.registry.snapshot()
+            fn = type(self).snapshot_fn
+            if fn is not None:
+                try:
+                    extra = fn() or {}
+                except Exception as e:  # noqa: BLE001 — a scrape must
+                    # never 500 because the pipeline is mid-transition
+                    log.debug("metrics snapshot sections failed: %s", e)
+                    extra = {}
+                for key in ("slo", "attribution", "quantiles"):
+                    if key in extra:
+                        snap[key] = extra[key]
+            body = json.dumps(snap).encode()
+            ctype = "application/json"
+        elif path == "/fleet/metrics" and self.federation is not None:
+            body = self.federation.render_prometheus().encode()
+            ctype = PROMETHEUS_CONTENT_TYPE
+        elif path == "/fleet/metrics.json" and self.federation is not None:
+            body = json.dumps(self.federation.collect()).encode()
             ctype = "application/json"
         elif path == "/healthz":
             body, ctype = b"ok\n", "text/plain"
@@ -59,10 +85,16 @@ class MetricsServer:
     (resolved into :attr:`port` after :meth:`start`)."""
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
-                 host: str = "0.0.0.0", port: int = 0):
+                 host: str = "0.0.0.0", port: int = 0,
+                 snapshot_fn=None, federation=None):
         self.registry = registry or get_registry()
         self.host = host
         self.port = int(port)
+        #: callable returning extra /metrics.json sections
+        #: (slo/attribution/quantiles) — see _Handler.snapshot_fn
+        self.snapshot_fn = snapshot_fn
+        #: FederatedMetrics aggregator backing /fleet/metrics[.json]
+        self.federation = federation
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -70,7 +102,10 @@ class MetricsServer:
         if self._httpd is not None:
             return self
         handler = type("BoundHandler", (_Handler,),
-                       {"registry": self.registry})
+                       {"registry": self.registry,
+                        "snapshot_fn": staticmethod(self.snapshot_fn)
+                        if self.snapshot_fn is not None else None,
+                        "federation": self.federation})
         self._httpd = ThreadingHTTPServer((self.host, self.port), handler)
         self._httpd.daemon_threads = True
         self.port = self._httpd.server_address[1]

@@ -83,9 +83,24 @@ TRACE_SEQ_META = "trace_seq"
 
 #: span kinds that tile a frame's critical path — the stage_breakdown /
 #: reconciliation set, in pipeline order
-STAGES: Tuple[str, ...] = ("ingest", "lane_reorder", "queue_wait",
-                           "sched_hold", "fence_wait", "device", "d2h",
-                           "decode", "sink")
+LOCAL_STAGES: Tuple[str, ...] = ("ingest", "lane_reorder", "queue_wait",
+                                 "sched_hold", "fence_wait", "device",
+                                 "d2h", "decode", "sink")
+
+#: distributed-hop stages spliced into the CLIENT ledger by
+#: elements/query.py when cross-hop tracing is armed (obs/distributed):
+#: outbound wire time, the remote pipeline's queue/device residency, the
+#: remote remainder (decode/sink/unattributed), and inbound wire time.
+#: All five are anchored inside the client's observed RTT window — raw
+#: remote clocks are never compared against local ones — and stay
+#: zero-valued (absent) on single-process pipelines, so every consumer
+#: keyed off STAGES (flight quantiles, gauges, MAD attribution,
+#: breakdowns) names remote stages without further wiring.
+DIST_STAGES: Tuple[str, ...] = ("hop_send", "remote_queue",
+                                "remote_device", "remote_other",
+                                "hop_recv")
+
+STAGES: Tuple[str, ...] = LOCAL_STAGES + DIST_STAGES
 
 _ENV = "NNSTPU_TRACE"
 
@@ -331,6 +346,17 @@ class Timeline:
             del frames[s]
         return frames
 
+    def frame_stages(self, seq: int) -> Dict[str, float]:
+        """Stage durations (seconds) for ONE frame — the scan-based
+        span-vector source a query server uses for remote egress when
+        no flight recorder (with its O(1) per-frame accumulator) is
+        installed."""
+        out: Dict[str, float] = {}
+        for _, kind, s, t0, t1, _, _ in self._snapshot():
+            if s == seq and t1 is not None:
+                out[kind] = out.get(kind, 0.0) + (t1 - t0)
+        return out
+
     def stage_breakdown(self, skip_frames: int = 0) -> Dict[str, Any]:
         """Mean per-frame seconds spent in each canonical stage, over
         frames that completed (have a sink e2e record). ``covered_ms``
@@ -398,56 +424,81 @@ class Timeline:
     def to_chrome(self) -> Dict[str, Any]:
         """Chrome trace-event JSON (Perfetto-loadable): named thread
         tracks, ``X`` slices with frame-seq args, flow events following
-        each frame across tracks, async inflight-slot spans."""
-        recs = self._snapshot()
-        tids: Dict[str, int] = {}
+        each frame across tracks, async inflight-slot spans.
 
-        def _tid(track: str) -> int:
-            t = tids.get(track)
+        Spans carrying an ``endpoint`` arg (the spliced remote-hop
+        stages from obs/distributed) render under their own *process*
+        track — pid 1 stays the local process, each distinct endpoint
+        gets the next pid — and the per-frame flow chain crosses those
+        process boundaries, so a distributed timeline loads as one
+        flame graph instead of colliding tids."""
+        recs = self._snapshot()
+        pids: Dict[str, int] = {"": 1}
+        tids: Dict[Tuple[int, str], int] = {}
+        tid_next: Dict[int, int] = {}
+
+        def _pid(endpoint: Optional[str]) -> int:
+            key = str(endpoint) if endpoint else ""
+            p = pids.get(key)
+            if p is None:
+                p = pids[key] = len(pids) + 1
+            return p
+
+        def _tid(pid: int, track: str) -> int:
+            t = tids.get((pid, track))
             if t is None:
-                t = tids[track] = len(tids) + 1
+                t = tid_next.get(pid, 0) + 1
+                tid_next[pid] = t
+                tids[(pid, track)] = t
             return t
 
         events: List[dict] = []
-        flows: Dict[int, List[Tuple[float, int]]] = {}
+        flows: Dict[int, List[Tuple[float, int, int]]] = {}
         for thread, kind, seq, t0, t1, track, args in recs:
             track = track or thread
             a: Dict[str, Any] = {"seq": seq}
             if args:
                 a.update(args)
-            tid = _tid(track)
+            pid = _pid(a.get("endpoint"))
+            tid = _tid(pid, track)
             if t1 is None:
                 events.append({"name": kind, "cat": "timeline",
                                "ph": "i", "s": "t", "ts": self._us(t0),
-                               "pid": 1, "tid": tid, "args": a})
+                               "pid": pid, "tid": tid, "args": a})
             else:
                 events.append({"name": kind, "cat": "timeline",
                                "ph": "X", "ts": self._us(t0),
                                "dur": max(round((t1 - t0) * 1e6, 3), 0.0),
-                               "pid": 1, "tid": tid, "args": a})
+                               "pid": pid, "tid": tid, "args": a})
                 if seq is not None:
-                    flows.setdefault(seq, []).append((t0, tid))
-        # flow events: one arrow chain per frame across its tracks — the
-        # "follow this frame" affordance in Perfetto
+                    flows.setdefault(seq, []).append((t0, pid, tid))
+        # flow events: one arrow chain per frame across its tracks (and,
+        # for hop spans, across endpoint processes) — the "follow this
+        # frame" affordance in Perfetto
         for seq, hops in flows.items():
             if len(hops) < 2:
                 continue
             hops.sort()
-            for i, (t0, tid) in enumerate(hops):
+            for i, (t0, pid, tid) in enumerate(hops):
                 ph = "s" if i == 0 else ("f" if i == len(hops) - 1 else "t")
                 ev = {"name": "frame", "cat": "frame", "ph": ph,
-                      "id": seq, "ts": self._us(t0), "pid": 1, "tid": tid}
+                      "id": seq, "ts": self._us(t0), "pid": pid,
+                      "tid": tid}
                 if ph == "f":
                     ev["bp"] = "e"
                 events.append(ev)
         for ph, name, aid, t, track in list(self._async):
             events.append({"name": name, "cat": "inflight", "ph": ph,
                            "id": aid, "ts": self._us(t), "pid": 1,
-                           "tid": _tid(track)})
-        meta: List[dict] = [{"name": "process_name", "ph": "M", "pid": 1,
-                             "args": {"name": "nnstreamer_tpu"}}]
-        for track, tid in sorted(tids.items(), key=lambda kv: kv[1]):
-            meta.append({"name": "thread_name", "ph": "M", "pid": 1,
+                           "tid": _tid(1, track)})
+        meta: List[dict] = []
+        for endpoint, pid in sorted(pids.items(), key=lambda kv: kv[1]):
+            meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                         "args": {"name": "nnstreamer_tpu" if pid == 1
+                                  else f"endpoint {endpoint}"}})
+        for (pid, track), tid in sorted(tids.items(),
+                                        key=lambda kv: (kv[0][0], kv[1])):
+            meta.append({"name": "thread_name", "ph": "M", "pid": pid,
                          "tid": tid, "args": {"name": track}})
         return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
 

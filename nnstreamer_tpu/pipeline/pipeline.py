@@ -965,6 +965,9 @@ class Pipeline:
             # variance-attribution report
             out["slo"] = self._flight.slo_snapshot()
             out["attribution"] = self._flight.attribution()
+            # raw P² marker states per stage — what fleet federation
+            # marker-merges into fleet-level quantiles (obs/distributed)
+            out["quantiles"] = self._flight.quantile_states()
         return out
 
     # -- serving continuity (pipeline/continuity.py) ---------------------------

@@ -51,11 +51,15 @@ class ServerAdvertiser:
     operation (reference tensor_query_hybrid_publish)."""
 
     def __init__(self, broker_host: str, broker_port: int, operation: str,
-                 host: str, port: int):
+                 host: str, port: int, metrics_port: Optional[int] = None):
         self.client = make_broker_client(broker_host, broker_port)
         self.topic = f"{TOPIC_PREFIX}{operation}/{host}:{port}"
         wall_ts = time.time()  # advertised epoch timestamp, read by peers
         self.endpoint = {"host": host, "port": port, "ts": wall_ts}
+        if metrics_port:
+            # fleet federation (obs/distributed.py) scrapes replicas that
+            # advertise where their /metrics.json lives
+            self.endpoint["metrics_port"] = int(metrics_port)
 
     def publish(self) -> None:
         self.client.publish(self.topic,
@@ -82,6 +86,8 @@ class ServerDiscovery:
         self.client = make_broker_client(broker_host, broker_port)
         #: key → (host, port, advertised epoch ts; 0.0 = no ts in ad)
         self._servers: Dict[str, Tuple[str, int, float]] = {}
+        #: key → full advertised payload (extra fields like metrics_port)
+        self._meta: Dict[str, dict] = {}
         self._lock = threading.Lock()
         self._seen = threading.Event()
         self.client.subscribe(f"{TOPIC_PREFIX}{operation}/#", self._on_msg)
@@ -91,11 +97,13 @@ class ServerDiscovery:
         with self._lock:
             if not body:
                 self._servers.pop(key, None)  # tombstone
+                self._meta.pop(key, None)
             else:
                 try:
                     info = json.loads(body.decode())
                     self._servers[key] = (info["host"], int(info["port"]),
                                           float(info.get("ts", 0.0)))
+                    self._meta[key] = info
                 except (ValueError, KeyError) as e:
                     log.warning("bad discovery payload on %s: %s", topic, e)
                     return
@@ -116,6 +124,7 @@ class ServerDiscovery:
                 log.info("discovery: dropping stale ad %s (%.1fs old)",
                          key, wall_now - ts)
                 self._servers.pop(key)
+                self._meta.pop(key, None)
                 continue
             out.append((h, p))
         return out
@@ -143,6 +152,19 @@ class ServerDiscovery:
             time.sleep(settle)  # collect the rest of the retained burst
         with self._lock:
             return self._live_locked()
+
+    def metrics_endpoints(self) -> List[Tuple[str, int]]:
+        """``(host, metrics_port)`` for every live server whose ad
+        carries a ``metrics_port`` — the fleet-federation scrape list
+        (see :class:`~nnstreamer_tpu.obs.distributed.FederatedMetrics`)."""
+        with self._lock:
+            out = []
+            for key in list(self._servers):
+                info = self._meta.get(key) or {}
+                mp = info.get("metrics_port")
+                if mp:
+                    out.append((str(info.get("host", "")), int(mp)))
+            return out
 
     def close(self) -> None:
         self.client.close()

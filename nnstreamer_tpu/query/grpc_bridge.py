@@ -115,9 +115,25 @@ class TensorServiceServer:
             "RecvTensors-queue buffers displaced by backpressure", idl=idl)
 
         def send_tensors(request_iterator, context):
-            # client→server stream; requests arrive already decoded
+            # client→server stream; requests arrive already decoded.
+            # Cross-hop trace context rides the gRPC invocation metadata
+            # (the codecs carry no meta dict) — stamp it onto every
+            # buffer so the receiving pipeline's ledger sees the hop.
+            trace_md = {k: v for k, v in (context.invocation_metadata()
+                                          or ())
+                        if k in ("nns-trace-id", "nns-sent-wall")}
             for buf in request_iterator:
                 self._m_recv.inc()
+                if trace_md:
+                    from nnstreamer_tpu.obs import distributed as _dist
+
+                    try:
+                        buf.meta[_dist.TRACE_ID_META] = \
+                            int(trace_md.get("nns-trace-id", 0))
+                        buf.meta[_dist.SENT_WALL_META] = \
+                            float(trace_md.get("nns-sent-wall", 0.0))
+                    except (TypeError, ValueError):
+                        pass
                 if self.on_recv is not None:
                     try:
                         self.on_recv(buf)
@@ -221,9 +237,21 @@ class TensorServiceClient:
 
     def send_stream(self, buffers: Iterator[TensorBuffer],
                     timeout: Optional[float] = None) -> None:
-        """Stream buffers to the server (blocks until the server acks)."""
+        """Stream buffers to the server (blocks until the server acks).
+        When distributed tracing is armed the stream carries trace
+        context as invocation metadata (per stream — the codecs have no
+        per-frame meta channel)."""
         self._fault_hook()
-        self._send_rpc(iter(buffers), timeout=timeout)
+        metadata = None
+        from nnstreamer_tpu.obs import distributed as _dist
+
+        if _dist.enabled():
+            ctx = _dist.attach_trace_meta({})
+            metadata = (
+                ("nns-trace-id", str(ctx[_dist.TRACE_ID_META])),
+                ("nns-sent-wall", repr(ctx[_dist.SENT_WALL_META])),
+            )
+        self._send_rpc(iter(buffers), timeout=timeout, metadata=metadata)
 
     def recv_stream(self, timeout: Optional[float] = None
                     ) -> Iterator[TensorBuffer]:

@@ -51,11 +51,28 @@ class Cmd(enum.IntEnum):
     TRANSFER_EX = 10  # ext header (req_id, slack_s) + classic buffer
     RESULT_EX = 11    # ext header (req_id, -1) + classic buffer
     EXPIRED = 12      # ext header only: deadline missed, frame shed
+    # -- distributed-trace extension (obs/distributed.py) — only spoken
+    # after BOTH sides advertised the "dt1" feature in the HELLO
+    # exchange, so a pre-16 peer (or NNSTPU_DIST_TRACE=0) keeps every
+    # wire byte identical to the resilient protocol above
+    TRANSFER_EX2 = 13  # ext2 header + trace blob + classic buffer
+    RESULT_EX2 = 14    # ext2 header + remote span blob + classic buffer
 
 
 #: extended-command header: u64 request id + f64 deadline slack in
 #: seconds (negative = no deadline; 0.0 = already expired at send time)
 _EXT_HDR = struct.Struct("<Qd")
+
+#: distributed-trace header: the _EXT_HDR pair plus a u64 trace/frame id
+#: (the client Timeline's frame seq, globally qualified by instance) and
+#: a f64 wall-clock stamp (epoch seconds: client send time on
+#: TRANSFER_EX2, remote receive time on RESULT_EX2). Wall stamps are
+#: *advisory* — the splice only ever uses them to split wire time inside
+#: the client's observed RTT window, never as absolute anchors.
+_EXT2_HDR = struct.Struct("<QdQd")
+
+#: length prefix for the variable trace blob that follows _EXT2_HDR
+_BLOB_LEN = struct.Struct("<I")
 
 
 def pack_ext(req_id: int, slack_s: float, body: bytes = b"") -> bytes:
@@ -67,6 +84,26 @@ def unpack_ext(payload: bytes) -> Tuple[int, float, bytes]:
         raise QueryProtocolError("short extended header")
     req_id, slack_s = _EXT_HDR.unpack_from(payload)
     return req_id, slack_s, payload[_EXT_HDR.size:]
+
+
+def pack_ext2(req_id: int, slack_s: float, trace_id: int, stamp: float,
+              blob: bytes = b"", body: bytes = b"") -> bytes:
+    return (_EXT2_HDR.pack(req_id, slack_s, trace_id, stamp)
+            + _BLOB_LEN.pack(len(blob)) + blob + body)
+
+
+def unpack_ext2(payload: bytes
+                ) -> Tuple[int, float, int, float, bytes, bytes]:
+    if len(payload) < _EXT2_HDR.size + _BLOB_LEN.size:
+        raise QueryProtocolError("short extended-trace header")
+    req_id, slack_s, trace_id, stamp = _EXT2_HDR.unpack_from(payload)
+    off = _EXT2_HDR.size
+    (blob_len,) = _BLOB_LEN.unpack_from(payload, off)
+    off += _BLOB_LEN.size
+    if len(payload) < off + blob_len:
+        raise QueryProtocolError("short trace blob")
+    blob = payload[off:off + blob_len]
+    return req_id, slack_s, trace_id, stamp, blob, payload[off + blob_len:]
 
 
 class QueryProtocolError(RuntimeError):

@@ -364,6 +364,7 @@ class PendingEntry:
     body: bytes
     deadline_t: Optional[float] = None  # monotonic; None = no deadline
     sent_t: float = 0.0
+    sent_wall: float = 0.0  # advisory wall stamp for dist-trace splits
 
     def slack_s(self, now: float) -> float:
         """Wire slack for this send: negative = no deadline; 0.0 = the

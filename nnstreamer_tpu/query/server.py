@@ -74,6 +74,10 @@ class QueryServer:
         self._dedup: Dict[str, _res.DedupWindow] = {}
         self._instances: Dict[str, int] = {}      # instance → live client id
         self._conn_instance: Dict[int, str] = {}  # client id → instance
+        #: instances that negotiated the dt1 distributed-trace feature
+        #: in their HELLO (obs/distributed) — only these ever see EX2
+        self._dt1_instances: set = set()
+        self._endpoint_name: Optional[str] = None
         #: chaos-test witnesses: duplicate requests absorbed / frames
         #: expired remotely (mirrors of the nns_net_* counters)
         self.dedup_hits = 0
@@ -256,6 +260,10 @@ class QueryServer:
                     if not self._handle_transfer_ex(client_id, conn,
                                                     payload):
                         break
+                elif cmd is P.Cmd.TRANSFER_EX2:
+                    if not self._handle_transfer_ex(client_id, conn,
+                                                    payload, ext2=True):
+                        break
                 elif cmd is P.Cmd.PING:
                     P.send_msg(conn, P.Cmd.PING)
                 elif cmd is P.Cmd.BYE:
@@ -283,29 +291,52 @@ class QueryServer:
         """HELLO announces the client's stable instance identity and its
         dedup-window size; the reply acknowledges extended-protocol
         support (a classic server would silently ignore the command, so
-        the client treats a missing echo as 'speak classic')."""
-        instance, _, win = payload.decode().partition(":")
+        the client treats a missing echo as 'speak classic'). A trailing
+        feature token list (``instance:window:dt1``) negotiates the
+        distributed-trace extension: the echo grants only what this
+        server also speaks, so a mixed-version fleet degrades per
+        connection instead of breaking."""
+        from nnstreamer_tpu.obs import distributed as _dist
+
+        instance, _, rest = payload.decode().partition(":")
+        win, _, feats = rest.partition(":")
         try:
             window = max(1, int(win)) if win else 64
         except ValueError:
             window = 64
+        dt1 = _dist.FEATURE in _dist.parse_features(feats) \
+            and _dist.enabled()
         with self._clients_lock:
             self._conn_instance[client_id] = instance
             self._instances[instance] = client_id
             if instance not in self._dedup:
                 self._dedup[instance] = _res.DedupWindow(size=window)
-        P.send_msg(conn, P.Cmd.HELLO, b"ok")
-        log.info("client %d is resilient instance %s (dedup window %d)",
-                 client_id, instance[:12], window)
+            if dt1:
+                self._dt1_instances.add(instance)
+            else:
+                self._dt1_instances.discard(instance)
+        P.send_msg(conn, P.Cmd.HELLO,
+                   b"ok:" + _dist.FEATURE.encode() if dt1 else b"ok")
+        log.info("client %d is resilient instance %s (dedup window %d%s)",
+                 client_id, instance[:12], window,
+                 ", dist-trace" if dt1 else "")
 
     def _handle_transfer_ex(self, client_id: int, conn: socket.socket,
-                            payload: bytes) -> bool:
+                            payload: bytes, ext2: bool = False) -> bool:
         """One extended transfer: dedup first (a resend of a resolved
         request replays the cached reply, a still-pending one is
-        dropped), then the deadline gate, then normal ingress. Returns
-        False to disconnect the client (bad frame)."""
+        dropped), then the deadline gate, then normal ingress. With
+        ``ext2`` the header also carries distributed-trace context
+        (trace id + client send stamp) that rides the buffer meta to
+        result egress. Returns False to disconnect the client (bad
+        frame)."""
+        trace_id = 0
         try:
-            req_id, slack_s, body = P.unpack_ext(payload)
+            if ext2:
+                req_id, slack_s, trace_id, _sent_wall, _blob, body = \
+                    P.unpack_ext2(payload)
+            else:
+                req_id, slack_s, body = P.unpack_ext(payload)
         except P.QueryProtocolError as e:
             self._m_errors.inc()
             log.warning("bad extended frame from client %d (%s); "
@@ -353,6 +384,18 @@ class QueryServer:
         buf.meta["query_client_id"] = client_id
         buf.meta["net_req_id"] = req_id
         buf.meta["net_instance"] = instance
+        if ext2:
+            from nnstreamer_tpu.obs import distributed as _dist
+
+            # remote trace segment opens here: the ingress stamp is the
+            # anchor result egress measures remote_total against, and
+            # the wall stamp is the advisory send/recv split hint the
+            # client clamps inside its own RTT window
+            buf.meta["dist_trace"] = {
+                "trace_id": trace_id,
+                "recv_t": now,
+                "recv_wall": _dist.wall_now(),
+            }
         if slack_s > 0.0:
             # propagated deadline: stamp the remaining budget so the SLO
             # scheduler's admission test (serving/scheduler.py decide())
@@ -494,6 +537,14 @@ class QueryServer:
             log.warning("send to client %d failed: %s", client_id, e)
             return False
 
+    def _endpoint(self) -> str:
+        """Stable human-readable name for this server in remote spans."""
+        if self._endpoint_name is None:
+            host = self.host if self.host not in ("", "0.0.0.0") \
+                else socket.gethostname()
+            self._endpoint_name = f"{host}:{self.port}"
+        return self._endpoint_name
+
     def _send_result_ex(self, client_id: int, buf: TensorBuffer,
                         req_id: int) -> bool:
         """Resilient result: cache the reply in the instance's dedup
@@ -501,13 +552,34 @@ class QueryServer:
         the instance's CURRENT connection — which, after a flap, is a
         different client id than the one the request arrived on."""
         instance = buf.meta.get("net_instance")
-        reply = (P.Cmd.RESULT_EX, P.pack_ext(req_id, -1.0,
-                                             P.pack_buffer(buf)))
         with self._clients_lock:
             dedup = self._dedup.get(instance) if instance else None
             cid = self._instances.get(instance, client_id) \
                 if instance else client_id
             conn = self._clients.get(cid)
+            dt1 = instance in self._dt1_instances if instance else False
+        dist = buf.meta.get("dist_trace")
+        if dt1 and isinstance(dist, dict):
+            # close the remote trace segment: piggyback this frame's
+            # span vector (durations only — skew-safe) on the result
+            from nnstreamer_tpu.obs import distributed as _dist
+            from nnstreamer_tpu.obs import timeline as _tl
+
+            now = time.monotonic()
+            total = max(now - float(dist.get("recv_t", now)), 0.0)
+            stages = _dist.collect_frame_stages(
+                buf.meta.get(_tl.TRACE_SEQ_META))
+            blob = _dist.pack_span_blob(
+                stages, total, float(dist.get("recv_wall", 0.0)),
+                _dist.wall_now(), self._endpoint())
+            reply = (P.Cmd.RESULT_EX2,
+                     P.pack_ext2(req_id, -1.0,
+                                 int(dist.get("trace_id", 0)),
+                                 float(dist.get("recv_wall", 0.0)),
+                                 blob, P.pack_buffer(buf)))
+        else:
+            reply = (P.Cmd.RESULT_EX, P.pack_ext(req_id, -1.0,
+                                                 P.pack_buffer(buf)))
         if dedup is not None:
             dedup.resolve(req_id, reply)
         if conn is None:

@@ -582,6 +582,84 @@ class TestAstLint:
                "        self.a = state['a']\n")
         assert by_code(lint_source(src, "x.py"), "NNS115") == []
 
+    def test_nns116_pack_arity_mismatch(self):
+        src = ("import struct\n"
+               "_HDR = struct.Struct('<IIQ')\n"
+               "def f(a, b):\n"
+               "    return _HDR.pack(a, b)\n")
+        errs = by_code(lint_source(src, "x.py"), "NNS116")
+        assert len(errs) == 1
+        assert "2 value(s)" in errs[0].message
+        assert "3 field(s)" in errs[0].message
+
+    def test_nns116_unpack_arity_mismatch(self):
+        src = ("import struct\n"
+               "_EXT = struct.Struct('<QdQd')\n"
+               "def f(payload):\n"
+               "    req_id, slack = _EXT.unpack_from(payload)\n"
+               "    return req_id, slack\n")
+        errs = by_code(lint_source(src, "x.py"), "NNS116")
+        assert len(errs) == 1
+        assert "4 field(s)" in errs[0].message
+
+    def test_nns116_matching_sites_ok(self):
+        # pad bytes count zero fields, 's' is one field, repeat counts
+        # expand — the struct module itself is the arbiter
+        src = ("import struct\n"
+               "_H = struct.Struct('<I4x2H8s')\n"
+               "def f(a, b, c, d, blob):\n"
+               "    w = _H.pack(a, b, c, d)\n"
+               "    p, q, r, s = _H.unpack(w)\n"
+               "    vals = _H.unpack(w)\n"
+               "    return p, q, r, s, vals, blob\n")
+        assert by_code(lint_source(src, "x.py"), "NNS116") == []
+
+    def test_nns116_dynamic_arity_skipped(self):
+        src = ("import struct\n"
+               "_H = struct.Struct('<II')\n"
+               "def f(args, blob):\n"
+               "    a = _H.pack(*args)\n"
+               "    first, *rest = _H.unpack(blob)\n"
+               "    return a, first, rest\n")
+        assert by_code(lint_source(src, "x.py"), "NNS116") == []
+
+    def test_nns116_pack_into_offsets_excluded(self):
+        src = ("import struct\n"
+               "_H = struct.Struct('<II')\n"
+               "def f(buf, a, b):\n"
+               "    _H.pack_into(buf, 0, a, b)\n"
+               "    _H.pack_into(buf, 0, a)\n")
+        errs = by_code(lint_source(src, "x.py"), "NNS116")
+        assert len(errs) == 1 and errs[0].loc.line == 5
+
+    def test_nns116_rebound_name_ambiguous_skipped(self):
+        src = ("import struct\n"
+               "_H = struct.Struct('<II')\n"
+               "_H = struct.Struct('<IIQ')\n"
+               "def f(a, b):\n"
+               "    return _H.pack(a, b)\n")
+        assert by_code(lint_source(src, "x.py"), "NNS116") == []
+
+    def test_nns116_pragma_suppressible(self):
+        src = ("import struct\n"
+               "_H = struct.Struct('<II')\n"
+               "def f(a):\n"
+               "    return _H.pack(a)  # nns-lint: disable=NNS116 -- "
+               "second field appended by caller\n")
+        assert by_code(lint_source(src, "x.py"), "NNS116") == []
+
+    def test_nns116_protocol_headers_clean(self):
+        # the real wire headers this rule exists for must lint clean
+        from pathlib import Path
+
+        from nnstreamer_tpu.analysis.astlint import lint_file
+        root = Path(__file__).resolve().parent.parent
+        for mod in ("query/protocol.py", "query/refwire.py",
+                    "query/mqtt.py"):
+            diags = [d for d in lint_file(root / "nnstreamer_tpu" / mod)
+                     if d.code == "NNS116"]
+            assert diags == [], diags
+
     def test_pragma_suppresses_with_reason(self):
         src = ("import time\n"
                "d = time.time()  # nns-lint: disable=NNS101 -- epoch "

@@ -1,0 +1,460 @@
+"""Distributed observability plane (obs/distributed.py): EX2 wire
+framing, dt1 HELLO negotiation + kill switch, skew-anchored remote-span
+splicing, fleet metrics federation, and the cross-process Perfetto
+export."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.obs import distributed as dist
+from nnstreamer_tpu.obs import timeline as TL
+from nnstreamer_tpu.obs.flight import FlightRecorder
+from nnstreamer_tpu.obs.quantiles import P2Quantile
+from nnstreamer_tpu.obs.registry import MetricsRegistry
+from nnstreamer_tpu.obs.server import MetricsServer
+from nnstreamer_tpu.query import protocol as P
+from nnstreamer_tpu.registry import ELEMENT, get_subplugin
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
+
+
+# ---------------------------------------------------------------------------
+# wire framing
+# ---------------------------------------------------------------------------
+class TestExt2Framing:
+    def test_roundtrip(self):
+        blob = b'{"v":1,"total":0.01}'
+        body = b"classic-buffer-bytes"
+        payload = P.pack_ext2(7, 1.5, 0xDEADBEEF, 1234.5, blob, body)
+        req_id, slack, tid, stamp, got_blob, rest = P.unpack_ext2(payload)
+        assert (req_id, slack, tid, stamp) == (7, 1.5, 0xDEADBEEF, 1234.5)
+        assert got_blob == blob and rest == body
+
+    def test_empty_blob(self):
+        payload = P.pack_ext2(1, -1.0, 0, 0.0, b"", b"body")
+        _, _, _, _, blob, rest = P.unpack_ext2(payload)
+        assert blob == b"" and rest == b"body"
+
+    def test_short_header_raises(self):
+        with pytest.raises(P.QueryProtocolError):
+            P.unpack_ext2(b"\x00" * 8)
+
+    def test_truncated_blob_raises(self):
+        payload = P.pack_ext2(1, -1.0, 0, 0.0, b"x" * 64, b"")
+        with pytest.raises(P.QueryProtocolError):
+            P.unpack_ext2(payload[:-40])
+
+    def test_new_commands_do_not_disturb_classic_ids(self):
+        # the classic command ids are a wire contract with pre-16 peers
+        assert P.Cmd.TRANSFER_EX2 == 13
+        assert P.Cmd.RESULT_EX2 == 14
+
+    def test_span_blob_roundtrip(self):
+        blob = dist.pack_span_blob({"device": 0.004, "queue_wait": 0.001},
+                                   0.006, 100.5, 100.506, "edge-1:3000")
+        doc = dist.unpack_span_blob(blob)
+        assert doc["total"] == 0.006
+        assert doc["stages"]["device"] == 0.004
+        assert doc["endpoint"] == "edge-1:3000"
+
+    def test_span_blob_garbage_is_empty(self):
+        assert dist.unpack_span_blob(b"") == {}
+        assert dist.unpack_span_blob(b"\xff\xfe not json") == {}
+        assert dist.unpack_span_blob(b"[1,2]") == {}
+
+
+# ---------------------------------------------------------------------------
+# feature negotiation + kill switch
+# ---------------------------------------------------------------------------
+class TestNegotiation:
+    def test_default_on(self, monkeypatch):
+        monkeypatch.delenv("NNSTPU_DIST_TRACE", raising=False)
+        assert dist.enabled()
+        assert dist.hello_offer() == ":dt1"
+
+    @pytest.mark.parametrize("v", ["0", "false", "no", "off", "False"])
+    def test_kill_switch(self, monkeypatch, v):
+        monkeypatch.setenv("NNSTPU_DIST_TRACE", v)
+        assert not dist.enabled()
+        assert dist.hello_offer() == ""
+
+    def test_parse_features_skips_window_digits(self):
+        assert dist.parse_features("64:dt1") == frozenset({"dt1"})
+        assert dist.parse_features("512") == frozenset()
+        assert "dt1" in dist.parse_features("dt1:zz9")
+
+    def test_hello_accepts(self):
+        assert dist.hello_accepts(b"ok:dt1")
+        assert not dist.hello_accepts(b"ok")
+        assert not dist.hello_accepts(b"\xff\xfe")
+
+
+# ---------------------------------------------------------------------------
+# the splice (skew-anchoring rule)
+# ---------------------------------------------------------------------------
+class TestSpliceRemote:
+    def _splice(self, span, sent_t=10.0, recv_t=10.1, sent_wall=None):
+        tl = TL.Timeline()
+        dist.splice_remote(tl, 42, sent_t, recv_t,
+                           sent_wall if sent_wall is not None else 0.0,
+                           span)
+        return tl.frame_stages(42)
+
+    def test_stages_tile_the_rtt_window_exactly(self):
+        got = self._splice({"total": 0.06, "endpoint": "s",
+                            "stages": {"device": 0.04,
+                                       "queue_wait": 0.01}})
+        assert set(got) == set(TL.DIST_STAGES)
+        assert sum(got.values()) == pytest.approx(0.1, abs=1e-9)
+        assert got["remote_device"] == pytest.approx(0.04)
+        assert got["remote_queue"] == pytest.approx(0.01)
+        assert got["remote_other"] == pytest.approx(0.01)
+
+    def test_wall_split_used_when_inside_window(self):
+        # remote clock ~in sync: recv_wall - sent_wall = 30ms of the
+        # 40ms wire time goes to hop_send
+        got = self._splice({"total": 0.06, "recv_wall": 1000.030},
+                           sent_wall=1000.0)
+        assert got["hop_send"] == pytest.approx(0.030)
+        assert got["hop_recv"] == pytest.approx(0.010)
+
+    def test_skewed_wall_falls_back_to_symmetric(self):
+        # remote clock 3 minutes off: the forward delta lands outside
+        # the wire window, so raw clocks are never trusted
+        got = self._splice({"total": 0.06, "recv_wall": 1180.0},
+                           sent_wall=1000.0)
+        assert got["hop_send"] == pytest.approx(got["hop_recv"])
+
+    def test_overreported_remote_total_clamped_to_rtt(self):
+        # remote claims more time than the whole RTT: a clock artifact;
+        # the splice never exceeds the client's own window
+        got = self._splice({"total": 5.0, "endpoint": "s",
+                            "stages": {"device": 4.0}})
+        assert sum(got.values()) == pytest.approx(0.1, abs=1e-9)
+
+    def test_overreported_stages_scaled_into_total(self):
+        got = self._splice({"total": 0.05,
+                            "stages": {"device": 0.08,
+                                       "queue_wait": 0.02}})
+        assert got["remote_device"] == pytest.approx(0.04)
+        assert got["remote_queue"] == pytest.approx(0.01)
+        assert got["remote_other"] == pytest.approx(0.0, abs=1e-9)
+
+    def test_degenerate_windows_are_noops(self):
+        tl = TL.Timeline()
+        dist.splice_remote(tl, 1, 10.0, 10.0, 0.0, {"total": 1.0})
+        dist.splice_remote(tl, None, 10.0, 11.0, 0.0, {"total": 1.0})
+        dist.splice_remote(None, 1, 10.0, 11.0, 0.0, {"total": 1.0})
+        assert tl.frame_stages(1) == {}
+
+    def test_flight_recorder_accumulates_dist_stages(self):
+        # DIST_STAGES are members of STAGES, so the flight recorder's
+        # quantiles/attribution track them with zero extra wiring
+        fr = FlightRecorder()
+        dist.splice_remote(fr, 42, 10.0, 10.1, 0.0,
+                           {"total": 0.06, "stages": {"device": 0.05}})
+        assert fr.frame_stages(42)["remote_device"] == pytest.approx(0.05)
+        fr.span("sink", 42, 10.1, 10.101, track="io", e2e_s=0.101)
+        assert fr._q["remote_device"]["p50"].count == 1
+
+
+# ---------------------------------------------------------------------------
+# loopback: EX2 end-to-end
+# ---------------------------------------------------------------------------
+def _echo_server(delay_s=0.0):
+    Src = get_subplugin(ELEMENT, "tensor_query_serversrc")
+    src = Src(port=0, reliable=True)
+    src.start()
+    server = src.server
+    stop = threading.Event()
+
+    def worker():
+        while not stop.is_set():
+            try:
+                buf = server.incoming.get(timeout=0.2)
+            except Exception:
+                continue
+            if buf is None:
+                continue
+            if delay_s:
+                time.sleep(delay_s)
+            out = TensorBuffer([t * 2 for t in buf.to_host().tensors],
+                               pts=buf.pts)
+            out.meta.update(buf.meta)
+            server.send_result(buf.meta["query_client_id"], out)
+
+    threading.Thread(target=worker, daemon=True).start()
+    return src, stop
+
+
+class TestLoopbackTrace:
+    def _run(self, n=6, delay_s=0.0, **client_props):
+        src, stop = _echo_server(delay_s=delay_s)
+        Client = get_subplugin(ELEMENT, "tensor_query_client")
+        cl = Client(port=src.port, reliable=True, timeout=5.0,
+                    **client_props)
+        outs = []
+        cl.srcpad.push = lambda b: outs.append(b)
+        try:
+            for i in range(n):
+                buf = TensorBuffer([np.full((4,), i, np.float32)], pts=i)
+                buf.meta[TL.TRACE_SEQ_META] = 1000 + i
+                cl.chain(cl.sinkpad, buf)
+            cl.handle_eos()
+        finally:
+            stop.set()
+            srv = src.server
+            cl.stop()
+            src.stop()
+        return outs, srv
+
+    def test_dist_stages_reconcile_with_rtt(self):
+        fr = FlightRecorder()
+        old = TL.ACTIVE
+        TL.ACTIVE = fr
+        try:
+            outs, _ = self._run(n=6, delay_s=0.01)
+        finally:
+            TL.ACTIVE = old
+        assert len(outs) == 6
+        got = fr.frame_stages(1003)
+        remote = sum(v for k, v in got.items() if k.startswith("remote_"))
+        wire = got.get("hop_send", 0.0) + got.get("hop_recv", 0.0)
+        # the spliced stages tile the observed RTT: the 10ms remote
+        # delay must be attributed remotely, not to the wire
+        assert remote >= 0.008
+        assert remote + wire > 0.009
+
+    def test_kill_switch_speaks_classic_ex(self, monkeypatch):
+        monkeypatch.setenv("NNSTPU_DIST_TRACE", "0")
+        sent_cmds = []
+        real_send = P.send_msg
+
+        def spy(sock, cmd, payload=b""):
+            sent_cmds.append((cmd, payload))
+            return real_send(sock, cmd, payload)
+
+        monkeypatch.setattr(P, "send_msg", spy)
+        outs, srv = self._run(n=3)
+        assert len(outs) == 3
+        transfers = [(c, p) for c, p in sent_cmds
+                     if c in (P.Cmd.TRANSFER_EX, P.Cmd.TRANSFER_EX2)]
+        assert transfers and all(c is P.Cmd.TRANSFER_EX
+                                 for c, _ in transfers)
+        hello = [p for c, p in sent_cmds if c is P.Cmd.HELLO]
+        # byte-level: the HELLO payload carries no feature suffix
+        assert hello and b"dt1" not in hello[0]
+        assert not srv._dt1_instances
+
+    def test_armed_speaks_ex2(self, monkeypatch):
+        monkeypatch.delenv("NNSTPU_DIST_TRACE", raising=False)
+        sent_cmds = []
+        real_send = P.send_msg
+
+        def spy(sock, cmd, payload=b""):
+            sent_cmds.append(cmd)
+            return real_send(sock, cmd, payload)
+
+        monkeypatch.setattr(P, "send_msg", spy)
+        outs, _ = self._run(n=3)
+        assert len(outs) == 3
+        assert P.Cmd.TRANSFER_EX2 in sent_cmds
+        assert P.Cmd.TRANSFER_EX not in sent_cmds
+
+
+# ---------------------------------------------------------------------------
+# fleet metrics federation
+# ---------------------------------------------------------------------------
+def _replica(counter_v, gauge_v, samples, burn=None):
+    """A real /metrics.json endpoint: registry + quantile states."""
+    reg = MetricsRegistry()
+    reg.counter("nns_query_requests_total", "req", wire="nnstpu")\
+        .inc(counter_v)
+    reg.gauge("nns_queue_depth", "depth").set(gauge_v)
+    q50, q99 = P2Quantile(0.5), P2Quantile(0.99)
+    for x in samples:
+        q50.observe(float(x))
+        q99.observe(float(x))
+
+    def extra():
+        out = {"quantiles": {"e2e": {"p50": q50.snapshot(),
+                                     "p99": q99.snapshot()}}}
+        if burn:
+            out["slo"] = {"burn": burn}
+        return out
+
+    return MetricsServer(registry=reg, host="127.0.0.1", port=0,
+                         snapshot_fn=extra).start()
+
+
+class TestFederation:
+    def test_merge_rules(self, rng):
+        a_samples = rng.uniform(0.010, 0.030, 500)
+        b_samples = rng.uniform(0.020, 0.040, 500)
+        a = _replica(100, 3.0, a_samples,
+                     burn={"fast": 0.5, "slow": 0.1})
+        b = _replica(250, 7.0, b_samples)
+        try:
+            fed = dist.FederatedMetrics(
+                endpoints=[("127.0.0.1", a.port), ("127.0.0.1", b.port)])
+            view = fed.collect()
+        finally:
+            a.stop()
+            b.stop()
+        # counters sum across replicas per series
+        reqs = [c for c in view["counters"]
+                if c["name"] == "nns_query_requests_total"]
+        assert len(reqs) == 1 and reqs[0]["value"] == 350.0
+        # gauges stay per-instance (averaging a gauge lies)
+        depths = {g["labels"]["instance"]: g["value"]
+                  for g in view["gauges"]
+                  if g["name"] == "nns_queue_depth"}
+        assert sorted(depths.values()) == [3.0, 7.0]
+        # P2 marker-merge tracks the pooled distribution, not either
+        # replica's own quantiles
+        pooled = np.concatenate([a_samples, b_samples])
+        q = view["quantiles"]["e2e"]
+        assert q["count"] == 1000
+        assert abs(q["p50_ms"] - np.percentile(pooled, 50) * 1e3) <= 4.0
+        assert abs(q["p99_ms"] - np.percentile(pooled, 99) * 1e3) <= 5.0
+        # burn windows stay per endpoint
+        assert [b_ for b_ in view["burn"].values()] == \
+            [{"fast": 0.5, "slow": 0.1}]
+        assert all(st["ok"] for st in view["endpoints"].values())
+
+    def test_down_endpoint_reported_not_fatal(self):
+        a = _replica(5, 1.0, [0.01])
+        try:
+            fed = dist.FederatedMetrics(
+                endpoints=[("127.0.0.1", a.port), ("127.0.0.1", 1)],
+                timeout=0.5)
+            view = fed.collect()
+        finally:
+            a.stop()
+        ups = view["endpoints"]
+        assert ups[f"127.0.0.1:{a.port}"]["ok"]
+        assert not ups["127.0.0.1:1"]["ok"]
+        text = fed.render_prometheus()
+        assert 'nns_fleet_endpoint_up{instance="127.0.0.1:1"} 0' in text
+
+    def test_prometheus_view(self):
+        a = _replica(5, 1.0, np.full(100, 0.02),
+                     burn={"fast": 2.0, "slow": 1.5})
+        try:
+            fed = dist.FederatedMetrics(
+                endpoints=[("127.0.0.1", a.port)])
+            text = fed.render_prometheus()
+        finally:
+            a.stop()
+        assert "nns_fleet_nns_query_requests_total" in text
+        assert 'nns_fleet_stage_p99_ms{stage="e2e"}' in text
+        assert 'nns_fleet_burn_rate{instance=' in text
+
+    def test_fleet_routes_on_metrics_server(self):
+        a = _replica(5, 1.0, [0.01, 0.02])
+        fed = dist.FederatedMetrics(endpoints=[("127.0.0.1", a.port)])
+        front = MetricsServer(registry=MetricsRegistry(),
+                              host="127.0.0.1", port=0,
+                              federation=fed).start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{front.port}/fleet/metrics.json",
+                    timeout=5) as r:
+                view = json.loads(r.read().decode())
+            assert view["counters"][0]["value"] == 5.0
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{front.port}/fleet/metrics",
+                    timeout=5) as r:
+                assert b"nns_fleet_endpoint_up" in r.read()
+        finally:
+            front.stop()
+            a.stop()
+
+    def test_metrics_json_extra_sections(self):
+        # satellite: /metrics.json exposes the same slo/attribution
+        # sections metrics_snapshot() returns in-process
+        srv = MetricsServer(
+            registry=MetricsRegistry(), host="127.0.0.1", port=0,
+            snapshot_fn=lambda: {"slo": {"stages": {}},
+                                 "attribution": {"frames": 0},
+                                 "ignored": 1}).start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/metrics.json",
+                    timeout=5) as r:
+                snap = json.loads(r.read().decode())
+        finally:
+            srv.stop()
+        assert snap["slo"] == {"stages": {}}
+        assert snap["attribution"] == {"frames": 0}
+        assert "ignored" not in snap
+
+    def test_discovery_metrics_endpoints(self):
+        from nnstreamer_tpu.query.discovery import (
+            ServerAdvertiser,
+            ServerDiscovery,
+        )
+        from nnstreamer_tpu.query.pubsub import Broker
+
+        broker = Broker(port=0).start()
+        try:
+            ad = ServerAdvertiser("127.0.0.1", broker.port, "fleet-op",
+                                  "10.0.0.5", 3000, metrics_port=9090)
+            ad.publish()
+            legacy = ServerAdvertiser("127.0.0.1", broker.port,
+                                      "fleet-op", "10.0.0.6", 3000)
+            legacy.publish()
+            disco = ServerDiscovery("127.0.0.1", broker.port, "fleet-op")
+            try:
+                servers = disco.wait_servers(timeout=5.0)
+                assert len(servers) == 2
+                # only the ad that carries a metrics_port is scrapable
+                assert disco.metrics_endpoints() == [("10.0.0.5", 9090)]
+            finally:
+                disco.close()
+            ad.retract()
+            legacy.retract()
+        finally:
+            broker.stop()
+
+
+# ---------------------------------------------------------------------------
+# Perfetto export: per-endpoint process tracks + cross-process flows
+# ---------------------------------------------------------------------------
+class TestChromeExport:
+    def test_endpoint_spans_get_their_own_pid(self):
+        tl = TL.Timeline()
+        tl.span("device", 1, 10.000, 10.004, track="exec")
+        dist.splice_remote(tl, 1, 10.004, 10.104, 0.0,
+                           {"total": 0.06, "endpoint": "edge-b:3000",
+                            "stages": {"device": 0.05}})
+        doc = tl.to_chrome()
+        events = doc["traceEvents"]
+        procs = {e["args"]["name"]: e["pid"] for e in events
+                 if e.get("name") == "process_name"}
+        assert procs.get("nnstreamer_tpu") == 1
+        assert "endpoint edge-b:3000" in procs
+        remote_pid = procs["endpoint edge-b:3000"]
+        assert remote_pid != 1
+        by_name = {e["name"]: e for e in events if e.get("ph") == "X"}
+        assert by_name["device"]["pid"] == 1
+        assert by_name["remote_device"]["pid"] == remote_pid
+        # the hop spans are the local wire view: they stay on pid 1
+        assert by_name["hop_send"]["pid"] == 1
+
+    def test_flow_chain_crosses_processes(self):
+        tl = TL.Timeline()
+        tl.span("device", 7, 10.000, 10.004, track="exec")
+        dist.splice_remote(tl, 7, 10.004, 10.104, 0.0,
+                           {"total": 0.06, "endpoint": "edge-b:3000",
+                            "stages": {"device": 0.05}})
+        events = tl.to_chrome()["traceEvents"]
+        flow = [e for e in events if e.get("cat") == "frame"
+                and e.get("id") == 7]
+        assert [e["ph"] for e in flow] == \
+            ["s"] + ["t"] * (len(flow) - 2) + ["f"]
+        assert len({e["pid"] for e in flow}) == 2  # crosses the boundary

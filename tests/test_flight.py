@@ -102,6 +102,90 @@ class TestP2Quantile:
         assert abs(q.quantile() - exact) <= 0.05
 
 
+class TestP2MarkerMerge:
+    """Fleet federation merges replica P² marker states (never raw
+    samples) via merge_p2_snapshots — the merged quantile must track
+    the pooled-exact one across distributions (obs/distributed.py)."""
+
+    @staticmethod
+    def _split_observe(data, p, replicas):
+        from nnstreamer_tpu.obs.quantiles import merge_p2_snapshots
+
+        snaps = []
+        for chunk in np.array_split(data, replicas):
+            q = P2Quantile(p)
+            for x in chunk:
+                q.observe(float(x))
+            snaps.append(q.snapshot())
+        return merge_p2_snapshots(snaps, p)
+
+    @pytest.mark.parametrize("p,tol", [(0.5, 0.06), (0.99, 0.12)])
+    def test_uniform(self, rng, p, tol):
+        data = rng.uniform(0.0, 1.0, 4000)
+        merged = self._split_observe(data, p, replicas=4)
+        exact = float(np.percentile(data, p * 100))
+        assert abs(merged - exact) <= tol * max(exact, 0.1)
+
+    @pytest.mark.parametrize("p,tol", [(0.5, 0.06), (0.99, 0.15)])
+    def test_lognormal(self, rng, p, tol):
+        data = rng.lognormal(0.0, 0.5, 4000)
+        merged = self._split_observe(data, p, replicas=4)
+        exact = float(np.percentile(data, p * 100))
+        assert abs(merged - exact) <= tol * exact
+
+    def test_bimodal(self, rng):
+        # a fleet where some replicas are healthy and some stall: the
+        # merged p99 must land in the slow mode even though no single
+        # replica's markers were built from the pooled stream
+        fast = rng.normal(0.010, 0.001, 3600)
+        slow = rng.normal(0.500, 0.020, 400)
+        data = rng.permutation(np.concatenate([fast, slow]))
+        p50 = self._split_observe(data, 0.5, replicas=4)
+        p99 = self._split_observe(data, 0.99, replicas=4)
+        assert abs(p50 - float(np.percentile(data, 50))) <= 0.01
+        assert abs(p99 - float(np.percentile(data, 99))) <= 0.10
+
+    def test_uneven_replica_weights(self, rng):
+        # counts weight the mixture: a replica with 10x the traffic
+        # must dominate the merged estimate
+        from nnstreamer_tpu.obs.quantiles import merge_p2_snapshots
+
+        heavy = rng.normal(0.100, 0.005, 3000)
+        light = rng.normal(0.900, 0.005, 300)
+        snaps = []
+        for chunk in (heavy, light):
+            q = P2Quantile(0.5)
+            for x in chunk:
+                q.observe(float(x))
+            snaps.append(q.snapshot())
+        merged = merge_p2_snapshots(snaps, 0.5)
+        pooled = float(np.percentile(np.concatenate([heavy, light]), 50))
+        assert abs(merged - pooled) <= 0.02
+
+    def test_warmup_snapshots_exact(self):
+        # replicas still in the n<=5 exact-heights phase merge on the
+        # raw order statistics
+        from nnstreamer_tpu.obs.quantiles import merge_p2_snapshots
+
+        snaps = []
+        for chunk in ((1.0, 2.0), (3.0, 4.0)):
+            q = P2Quantile(0.5)
+            for x in chunk:
+                q.observe(x)
+            snaps.append(q.snapshot())
+        merged = merge_p2_snapshots(snaps, 0.5)
+        assert 2.0 <= merged <= 3.0
+
+    def test_empty_and_invalid(self):
+        from nnstreamer_tpu.obs.quantiles import merge_p2_snapshots
+
+        q = P2Quantile(0.5)
+        assert merge_p2_snapshots([], 0.5) is None
+        assert merge_p2_snapshots([q.snapshot()], 0.5) is None
+        with pytest.raises(ValueError):
+            merge_p2_snapshots([], 1.5)
+
+
 class TestBurnRateWindow:
     def test_rate_is_breach_fraction_over_budget(self):
         b = BurnRateWindow(window_s=10.0, error_budget=0.1)
