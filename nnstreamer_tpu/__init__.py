@@ -28,7 +28,13 @@ Layer map (mirrors SURVEY.md §1):
 
 __version__ = "0.1.0"
 
-from nnstreamer_tpu.tensors.types import (  # noqa: F401
+# before everything else: with NNSTPU_LOCKGRAPH set, the lock-order
+# witness must patch the threading factories ahead of every module that
+# creates locks at import time (obs/__init__ arms it as ITS first
+# statement; with the env unset this import changes nothing)
+import nnstreamer_tpu.obs  # noqa: E402,F401
+
+from nnstreamer_tpu.tensors.types import (  # noqa: E402,F401
     TensorType,
     TensorFormat,
     TensorInfo,

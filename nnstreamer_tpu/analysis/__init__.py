@@ -8,7 +8,13 @@ Two halves sharing one diagnostics model:
   constructing any runtime state (codes ``NNS0xx``);
 - the **project AST lint** (:func:`lint_tree`) enforces codebase
   invariants like monotonic-clock usage and no blocking calls under
-  locks (codes ``NNS1xx``).
+  locks (codes ``NNS1xx``);
+- the **whole-program concurrency analysis**
+  (:func:`lint_concurrency`) infers lock-guarded attributes, builds the
+  project-wide lock-ordering graph (:func:`static_lock_graph` — the
+  graph the runtime witness ``obs/lockgraph.py`` cross-checks), and
+  flags check-then-act races and foreign calls under lock (codes
+  ``NNS2xx``).
 
 See ``docs/linting.md`` for the full diagnostic-code table, the JSON
 output schema, and the pragma syntax.
@@ -18,6 +24,12 @@ from nnstreamer_tpu.analysis.astlint import (     # noqa: F401
     lint_file,
     lint_source,
     lint_tree,
+)
+from nnstreamer_tpu.analysis.concurrency import (  # noqa: F401
+    lint_concurrency,
+    lint_concurrency_source,
+    lint_concurrency_sources,
+    static_lock_graph,
 )
 from nnstreamer_tpu.analysis.diagnostics import (  # noqa: F401
     CODE_TABLE,
@@ -44,4 +56,6 @@ __all__ = [
     "summarize",
     "verify_description", "verify_pipeline",
     "lint_file", "lint_source", "lint_tree",
+    "lint_concurrency", "lint_concurrency_source",
+    "lint_concurrency_sources", "static_lock_graph",
 ]

@@ -5,6 +5,7 @@ Usage::
     nns-lint "videotestsrc ! tensor_converter ! tensor_sink"
     nns-lint -f pipeline.txt
     nns-lint --self                       # AST lint the package itself
+    nns-lint --concurrency                # whole-program NNS2xx pass
     nns-lint --scan examples/ docs/       # verify shipped descriptions
     nns-lint --format json "..."          # machine-readable output
 
@@ -39,6 +40,11 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--self", dest="lint_self", action="store_true",
                    help="run the project AST lint over the "
                         "nnstreamer_tpu package")
+    p.add_argument("--concurrency", action="store_true",
+                   help="run the whole-program concurrency analysis "
+                        "(NNS2xx: guarded attributes, lock ordering, "
+                        "check-then-act, foreign calls under lock) over "
+                        "the nnstreamer_tpu package")
     p.add_argument("--scan", nargs="+", metavar="PATH",
                    help="extract and verify pipeline descriptions from "
                         "python/markdown files or directories")
@@ -74,11 +80,11 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     modes = sum((bool(args.description or args.file), args.lint_self,
-                 bool(args.scan)))
+                 args.concurrency, bool(args.scan)))
     if modes == 0:
         parser.print_usage(sys.stderr)
-        print("nns-lint: give a description, -f FILE, --self, or --scan",
-              file=sys.stderr)
+        print("nns-lint: give a description, -f FILE, --self, "
+              "--concurrency, or --scan", file=sys.stderr)
         return 2
     if args.description and args.file:
         print("nns-lint: give either a description or -f, not both",
@@ -104,6 +110,11 @@ def main(argv=None) -> int:
 
         pkg_root = Path(__file__).resolve().parent.parent
         diags.extend(lint_tree(pkg_root))
+    if args.concurrency:
+        from nnstreamer_tpu.analysis.concurrency import lint_concurrency
+
+        pkg_root = Path(__file__).resolve().parent.parent
+        diags.extend(lint_concurrency(pkg_root))
     if args.scan:
         diags.extend(_scan_paths(args.scan))
 

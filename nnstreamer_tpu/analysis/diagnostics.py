@@ -32,7 +32,8 @@ INFO = "info"
 _SEV_ORDER = {ERROR: 0, WARNING: 1, INFO: 2}
 
 #: every diagnostic code with its one-line meaning — the table rendered in
-#: docs/linting.md. NNS0xx: pipeline-graph findings; NNS1xx: AST rules.
+#: docs/linting.md. NNS0xx: pipeline-graph findings; NNS1xx: AST rules;
+#: NNS2xx: whole-program concurrency analysis.
 CODE_TABLE: Dict[str, str] = {
     # -- graph (static pipeline verifier) ------------------------------------
     "NNS001": "unknown element factory",
@@ -88,6 +89,20 @@ CODE_TABLE: Dict[str, str] = {
               "names, raises only at runtime — on the first real frame, "
               "usually on the peer)",
     "NNS199": "nns-lint pragma without a justification",
+    # -- concurrency (whole-program analysis) --------------------------------
+    "NNS201": "access to a lock-guarded attribute outside the lock (the "
+              "class mutates it under its lock everywhere else, so the "
+              "unguarded access races every locked reader/writer)",
+    "NNS202": "lock-order cycle in the project-wide acquisition graph "
+              "(two threads taking the same locks in opposite orders "
+              "deadlock), or a non-reentrant lock re-acquired while held",
+    "NNS203": "check-then-act race: membership test and mutation of a "
+              "lock-guarded container in separate critical sections "
+              "(another thread can interleave between test and act)",
+    "NNS204": "foreign call under lock: a callback/hook/fn-gauge or "
+              "pipeline-bus post invoked while holding a subsystem lock "
+              "(the callee may block or re-enter — the reentrancy-"
+              "deadlock shape)",
 }
 
 

@@ -23,7 +23,14 @@ windows that back the element ``latency``/``throughput`` properties, so
 the exported gauges always agree with the in-band read-outs.
 """
 
-from nnstreamer_tpu.obs.registry import (  # noqa: F401
+# FIRST import, before any sibling that creates module-level locks
+# (registry's process registry, the flight recorder): when
+# NNSTPU_LOCKGRAPH is set the lock factories must already be patched
+# by the time those locks are created, or the witness misses them
+from nnstreamer_tpu.obs import lockgraph  # noqa: F401
+lockgraph.maybe_activate_env()
+
+from nnstreamer_tpu.obs.registry import (  # noqa: E402,F401
     Counter,
     Gauge,
     Histogram,

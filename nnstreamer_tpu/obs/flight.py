@@ -237,7 +237,8 @@ class FlightRecorder(_timeline.Timeline):
             self._q["e2e_admitted"]["p50"].observe(e2e_adm_s)
             self._q["e2e_admitted"]["p99"].observe(e2e_adm_s)
         med = self._q["e2e"]["p50"].quantile()
-        self._rolling_med = med
+        with self._fl_lock:
+            self._rolling_med = med
 
         budget = self.slo_budget_s
         if budget is not None and budget > 0:
@@ -257,7 +258,10 @@ class FlightRecorder(_timeline.Timeline):
                            "median_ms": round(med * 1e3, 3),
                            "k": self.tail_k})
         # a pending dump flushes once the post-offender window completed
-        pending = self._pending
+        # (read under the lock: _trigger — possibly just called above —
+        # installs _pending under it)
+        with self._fl_lock:
+            pending = self._pending
         if pending is not None and pending["seq"] is not None \
                 and seq >= pending["seq"] + self.window_frames:
             self._flush()
@@ -401,8 +405,10 @@ class FlightRecorder(_timeline.Timeline):
                 "p99_ms": round((p99 or 0.0) * 1e3, 4),
                 "count": c,
             }
-        out: Dict[str, Any] = {"stages": stages,
-                               "completed": self._completed}
+        out: Dict[str, Any] = {
+            "stages": stages,
+            "completed": self._completed,  # nns-lint: disable=NNS201 -- monotonic int; an export snapshot at worst reads one frame stale, never torn
+        }
         if self.slo_budget_s is not None:
             fast, slow = self.burn_rates(now)
             out["burn"] = {

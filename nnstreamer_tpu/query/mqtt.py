@@ -397,8 +397,13 @@ class MqttClient:
             tv = struct.pack("ll", int(self._timeout),
                              int(self._timeout % 1 * 1e6))
             sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO, tv)
-        self._pong_at = time.monotonic()
-        self._ping_at = 0.0
+        # under the lock: a reconnect racing ping() (which stamps
+        # _ping_at under the lock) could otherwise leave a stale
+        # _ping_at > _pong_at pair and make the fresh link look
+        # half-open on the pinger's very next staleness check
+        with self._lock:
+            self._pong_at = time.monotonic()
+            self._ping_at = 0.0
         return sock
 
     def _recover(self) -> bool:
@@ -642,7 +647,11 @@ class MqttClient:
                     if qos and pid is not None:
                         with self._lock:
                             self._sock.sendall(puback_packet(pid))  # nns-lint: disable=NNS102,NNS112 -- the lock serializes writes to this socket; SO_SNDTIMEO (set at connect) bounds them
-                    for pattern, cb, _q in list(self._subs):
+                    # copy under the lock (subscribe()/unsubscribe run on
+                    # other threads), dispatch outside it
+                    with self._lock:
+                        subs = list(self._subs)
+                    for pattern, cb, _q in subs:
                         if topic_matches(pattern, topic):
                             try:
                                 cb(topic, payload)
@@ -680,7 +689,10 @@ class MqttClient:
                         log.warning("mqtt: broker rejected resubscription"
                                     " to %r", refilt)
                 elif ptype == PINGRESP:
-                    self._pong_at = time.monotonic()
+                    # under the lock: the pinger compares _pong_at
+                    # against _ping_at as one pair under it
+                    with self._lock:
+                        self._pong_at = time.monotonic()
                 elif ptype == PINGREQ:
                     with self._lock:
                         self._sock.sendall(pingresp_packet())  # nns-lint: disable=NNS102,NNS112 -- the lock serializes writes to this socket; SO_SNDTIMEO (set at connect) bounds them

@@ -339,7 +339,8 @@ class DedupWindow:
         with self._lock:
             entries = [(rid, reply) for rid, reply in self._entries.items()
                        if reply is not PENDING]
-        return {"size": self.size, "entries": entries}
+            # size is read under the same lock restore() resizes under
+            return {"size": self.size, "entries": entries}
 
     def restore(self, state: dict) -> None:
         with self._lock:

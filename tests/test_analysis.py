@@ -770,3 +770,321 @@ class TestPipelineVerify:
         diags = pipe.verify()
         assert "NNS006" in [d.code for d in diags]
         assert any(d.severity == ERROR for d in diags)
+
+
+class TestConcurrencyLint:
+    """NNS2xx whole-program fixtures (concurrency.py)."""
+
+    def _lint(self, src, rel="x.py"):
+        from nnstreamer_tpu.analysis.concurrency import (
+            lint_concurrency_source)
+        return lint_concurrency_source(src, rel)
+
+    # -- NNS201: guarded-attribute inference ------------------------------
+
+    def test_nns201_unguarded_write(self):
+        src = ("import threading\n"
+               "class Counter:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n"
+               "        self._n = 0\n"
+               "    def bump(self):\n"
+               "        with self._lock:\n"
+               "            self._n += 1\n"
+               "    def reset_fast(self):\n"
+               "        self._n = 0\n")
+        diags = by_code(self._lint(src), "NNS201")
+        assert len(diags) == 1
+        assert "_n" in diags[0].message
+
+    def test_nns201_unguarded_read_with_strong_guard_evidence(self):
+        # reads are only flagged under the stricter bar: no unlocked
+        # writes anywhere, >=3 locked accesses, and the read minority
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n"
+               "        self._n = 0\n"
+               "    def a(self):\n"
+               "        with self._lock:\n"
+               "            self._n += 1\n"
+               "    def b(self):\n"
+               "        with self._lock:\n"
+               "            self._n += 1\n"
+               "    def c(self):\n"
+               "        with self._lock:\n"
+               "            return self._n\n"
+               "    def peek(self):\n"
+               "        return self._n\n")
+        assert len(by_code(self._lint(src), "NNS201")) == 1
+
+    def test_nns201_all_guarded_clean(self):
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n"
+               "        self._n = 0\n"
+               "    def bump(self):\n"
+               "        with self._lock:\n"
+               "            self._n += 1\n"
+               "    def read(self):\n"
+               "        with self._lock:\n"
+               "            return self._n\n")
+        assert by_code(self._lint(src), "NNS201") == []
+
+    def test_nns201_locked_suffix_assumed_held(self):
+        # ``*_locked`` naming convention: the method is assumed to run
+        # with the guard held, so its accesses are locked evidence, not
+        # violations
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n"
+               "        self._n = 0\n"
+               "    def bump(self):\n"
+               "        with self._lock:\n"
+               "            self._bump_locked()\n"
+               "    def _bump_locked(self):\n"
+               "        self._n += 1\n")
+        assert by_code(self._lint(src), "NNS201") == []
+
+    def test_nns201_held_on_entry_inference(self):
+        # a private helper whose every call site holds the lock is
+        # inferred lock-held even without the naming convention
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n"
+               "        self._n = 0\n"
+               "    def bump(self):\n"
+               "        with self._lock:\n"
+               "            self._incr()\n"
+               "    def bump2(self):\n"
+               "        with self._lock:\n"
+               "            self._incr()\n"
+               "    def _incr(self):\n"
+               "        self._n += 1\n")
+        assert by_code(self._lint(src), "NNS201") == []
+
+    def test_nns201_lifecycle_methods_exempt(self):
+        # single-owner phases: stop() runs after the worker is joined,
+        # so its unlocked mutation is not a data race
+        src = ("import threading\n"
+               "class Engine:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n"
+               "        self._work = []\n"
+               "    def submit(self, item):\n"
+               "        with self._lock:\n"
+               "            self._work.append(item)\n"
+               "    def stop(self):\n"
+               "        self._work = []\n")
+        assert by_code(self._lint(src), "NNS201") == []
+
+    def test_nns201_sync_safe_attrs_exempt(self):
+        src = ("import threading\n"
+               "import queue\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n"
+               "        self._q = queue.Queue()\n"
+               "        self._ev = threading.Event()\n"
+               "    def put(self, x):\n"
+               "        with self._lock:\n"
+               "            self._q.put(x)\n"
+               "            self._ev.set()\n"
+               "    def drain(self):\n"
+               "        self._ev.wait(0.1)\n"
+               "        return self._q.get(timeout=0.1)\n")
+        assert by_code(self._lint(src), "NNS201") == []
+
+    def test_nns201_condition_counts_as_guard(self):
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self._idle = threading.Condition()\n"
+               "        self._busy = 0\n"
+               "    def enter(self):\n"
+               "        with self._idle:\n"
+               "            self._busy += 1\n"
+               "    def leak(self):\n"
+               "        self._busy -= 1\n")
+        assert len(by_code(self._lint(src), "NNS201")) == 1
+
+    def test_nns201_pragma_suppressible(self):
+        src = ("import threading\n"
+               "class Counter:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n"
+               "        self._n = 0\n"
+               "    def bump(self):\n"
+               "        with self._lock:\n"
+               "            self._n += 1\n"
+               "    def reset_fast(self):\n"
+               "        self._n = 0  # nns-lint: disable=NNS201 -- "
+               "monotonic reset, torn read is benign\n")
+        assert by_code(self._lint(src), "NNS201") == []
+
+    # -- NNS202: lock-ordering graph --------------------------------------
+
+    def test_nns202_two_lock_inversion(self):
+        src = ("import threading\n"
+               "A = threading.Lock()\n"
+               "B = threading.Lock()\n"
+               "def f():\n"
+               "    with A:\n"
+               "        with B:\n"
+               "            pass\n"
+               "def g():\n"
+               "    with B:\n"
+               "        with A:\n"
+               "            pass\n")
+        diags = by_code(self._lint(src), "NNS202")
+        assert diags
+        assert "cycle" in diags[0].message.lower()
+
+    def test_nns202_consistent_order_clean(self):
+        src = ("import threading\n"
+               "A = threading.Lock()\n"
+               "B = threading.Lock()\n"
+               "def f():\n"
+               "    with A:\n"
+               "        with B:\n"
+               "            pass\n"
+               "def g():\n"
+               "    with A:\n"
+               "        with B:\n"
+               "            pass\n")
+        assert by_code(self._lint(src), "NNS202") == []
+
+    def test_nns202_self_nest_plain_lock(self):
+        src = ("import threading\n"
+               "L = threading.Lock()\n"
+               "def f():\n"
+               "    with L:\n"
+               "        with L:\n"
+               "            pass\n")
+        assert by_code(self._lint(src), "NNS202")
+
+    def test_nns202_self_nest_rlock_clean(self):
+        src = ("import threading\n"
+               "L = threading.RLock()\n"
+               "def f():\n"
+               "    with L:\n"
+               "        with L:\n"
+               "            pass\n")
+        assert by_code(self._lint(src), "NNS202") == []
+
+    def test_nns202_cross_file_inversion(self):
+        from nnstreamer_tpu.analysis.concurrency import (
+            lint_concurrency_sources)
+        srcs = {
+            "a.py": ("import threading\n"
+                     "LOCK_A = threading.Lock()\n"
+                     "LOCK_B = threading.Lock()\n"
+                     "def f():\n"
+                     "    with LOCK_A:\n"
+                     "        with LOCK_B:\n"
+                     "            pass\n"),
+            "b.py": ("from a import LOCK_A, LOCK_B\n"
+                     "def g():\n"
+                     "    with LOCK_B:\n"
+                     "        with LOCK_A:\n"
+                     "            pass\n"),
+        }
+        assert by_code(lint_concurrency_sources(srcs), "NNS202")
+
+    # -- NNS203: check-then-act -------------------------------------------
+
+    def test_nns203_check_then_act(self):
+        src = ("import threading\n"
+               "class Cache:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n"
+               "        self._d = {}\n"
+               "    def put(self, k, v):\n"
+               "        with self._lock:\n"
+               "            self._d[k] = v\n"
+               "    def ensure(self, k):\n"
+               "        if k not in self._d:\n"
+               "            self._d[k] = object()\n")
+        diags = self._lint(src)
+        assert by_code(diags, "NNS203")
+        # the unguarded mutation itself is also NNS201 — both fire
+        assert by_code(diags, "NNS201")
+
+    def test_nns203_locked_check_then_act_clean(self):
+        src = ("import threading\n"
+               "class Cache:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n"
+               "        self._d = {}\n"
+               "    def put(self, k, v):\n"
+               "        with self._lock:\n"
+               "            self._d[k] = v\n"
+               "    def ensure(self, k):\n"
+               "        with self._lock:\n"
+               "            if k not in self._d:\n"
+               "                self._d[k] = object()\n")
+        assert by_code(self._lint(src), "NNS203") == []
+
+    # -- NNS204: foreign calls under lock ---------------------------------
+
+    def test_nns204_callback_under_lock(self):
+        src = ("import threading\n"
+               "class Emitter:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n"
+               "        self._callbacks = []\n"
+               "    def add(self, cb):\n"
+               "        with self._lock:\n"
+               "            self._callbacks.append(cb)\n"
+               "    def fire(self, evt):\n"
+               "        with self._lock:\n"
+               "            for cb in list(self._callbacks):\n"
+               "                cb(evt)\n")
+        assert by_code(self._lint(src), "NNS204")
+
+    def test_nns204_copy_then_dispatch_clean(self):
+        src = ("import threading\n"
+               "class Emitter:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n"
+               "        self._callbacks = []\n"
+               "    def add(self, cb):\n"
+               "        with self._lock:\n"
+               "            self._callbacks.append(cb)\n"
+               "    def fire(self, evt):\n"
+               "        with self._lock:\n"
+               "            cbs = list(self._callbacks)\n"
+               "        for cb in cbs:\n"
+               "            cb(evt)\n")
+        assert by_code(self._lint(src), "NNS204") == []
+
+    # -- static graph export + CLI ----------------------------------------
+
+    def test_static_lock_graph_shape(self, tmp_path):
+        (tmp_path / "m.py").write_text(
+            "import threading\n"
+            "A = threading.Lock()\n"
+            "B = threading.Lock()\n"
+            "def f():\n"
+            "    with A:\n"
+            "        with B:\n"
+            "            pass\n")
+        from nnstreamer_tpu.analysis.concurrency import static_lock_graph
+        g = static_lock_graph(tmp_path)
+        assert g["version"] == 1
+        assert len(g["edges"]) == 1
+        assert set(g["edges"][0]) == {"from", "to", "site"}
+        assert len(g["sites"]) == 2
+
+    def test_cli_concurrency_flag(self, capsys):
+        from nnstreamer_tpu.analysis.cli import main
+
+        assert main(["--concurrency"]) == 0
+        capsys.readouterr()  # drain the text-mode output
+        assert main(["--concurrency", "--format", "json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["version"] == 1
+        assert doc["diagnostics"] == []

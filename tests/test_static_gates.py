@@ -97,6 +97,25 @@ def test_self_lint_clean():
     assert not diags, "\n".join(d.render() for d in diags)
 
 
+def test_concurrency_lint_clean():
+    """`nns-lint --concurrency` gate: the whole-program NNS2xx pass
+    (guarded attributes, lock ordering, check-then-act, foreign calls
+    under lock) reports zero unsuppressed findings on the tree, and the
+    static lock-ordering graph it exports is non-trivial (the runtime
+    witness cross-checks against it, so an accidentally-empty graph
+    would turn that check into a no-op)."""
+    from nnstreamer_tpu.analysis.concurrency import (
+        lint_concurrency,
+        static_lock_graph,
+    )
+
+    diags = lint_concurrency(PKG)
+    assert not diags, "\n".join(d.render() for d in diags)
+    graph = static_lock_graph(PKG)
+    assert len(graph["sites"]) >= 20   # the lock census is ~35+ locks
+    assert graph["nodes"]
+
+
 def test_shipped_pipelines_verify():
     """Every pipeline description shipped in examples/ and the
     getting-started doc passes the static verifier with no
