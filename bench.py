@@ -150,6 +150,15 @@ WARMUP_DRAIN = int(os.environ.get("BENCH_WARMUP_DRAIN", "4"))
 #: pipeline runs the exact pre-scheduler path.
 SLO_BUDGET_MS = float(os.environ.get("BENCH_SLO_BUDGET_MS", "0") or 0)
 
+#: mesh-sharded serving plane (parallel/serve.py): BENCH_MESH=dp8 runs
+#: the flagship with `mesh=dp8` on the tensor_filter and the JSON grows
+#: `mesh` / `shard_scaling` (warm median over a single-device reference
+#: run from the same weather window) / `reshard_bytes_per_frame`
+#: (matched-sharding boundaries move zero bytes, so this should be 0).
+#: Unset (the default) leaves the single-device path — and the JSON's
+#: mesh fields are null.
+MESH_SPEC = os.environ.get("BENCH_MESH", "").strip()
+
 #: perf gates (the determinism item): the JSON grows a `gates` field
 #: judging fps_median, spread_mad, and saturation p99 against these
 #: thresholds. spread_mad defaults ON (warm spread under 0.15 of the
@@ -320,6 +329,7 @@ def build_pipeline(batch: int = BATCH, live_fps: int = 0,
         "tensor_transform mode=arithmetic "
         "option=typecast:float32,add:-127.5,div:127.5 ! "
         f"tensor_filter framework=jax model={model_name} name=filter "
+        f"{f'mesh={MESH_SPEC} ' if MESH_SPEC else ''}"
         f"inflight={INFLIGHT} ! "
         f"tensor_decoder mode=image_labeling "
         f"{'option2=batched ' if batch > 1 else ''}! "
@@ -1381,6 +1391,7 @@ def main():
     ingest = {"ingest_bound_fps": round(max(ingest_seq), 1)
               if any(ingest_seq) else None}
     lat_live = measure_latency_live()
+    mesh_fields = _measure_mesh_fields(fps_median, runs)
     result = {
         "metric": "mobilenetv2_224_pipeline_fps",
         "value": round(stats["fps"], 2),
@@ -1469,6 +1480,10 @@ def main():
         if flops and peak and probe["device_dispatch_ms_per_batch"]
         else None,
         "baseline_fps": baseline,
+        # mesh-sharded serving (BENCH_MESH=dp8): spec, warm median over
+        # the single-device reference, resharded bytes per measured
+        # frame (0 = every boundary hand-off was a matched zero-copy)
+        **mesh_fields,
         "platform": _platform(),
     }
     # flight recorder (obs/flight.py): the always-on attribution from
@@ -1553,6 +1568,40 @@ def _pool_hit_rate():
         return round(snap["hit_rate"], 3)
     except Exception:  # noqa: BLE001 — informative field only
         return None
+
+
+def _measure_mesh_fields(fps_median, runs) -> dict:
+    """Mesh-sharded run report (BENCH_MESH=dp8): the spec, the warm
+    median over a single-device reference run taken in the SAME weather
+    window with the kill switch thrown (NNSTPU_MESH=0 is the
+    byte-identical dp1 path, so the ratio isolates the mesh), and the
+    session's resharded bytes per measured frame — 0 when every
+    device-passthrough hand-off between sharded regions was a matched
+    zero-copy. All three are null without BENCH_MESH."""
+    if not MESH_SPEC:
+        return {"mesh": None, "shard_scaling": None,
+                "reshard_bytes_per_frame": None}
+    from nnstreamer_tpu.parallel import serve as _serve
+
+    frames = sum(int(r.get("frames") or 0) for r in runs)
+    per_frame = (round(_serve.reshard_bytes_total() / frames, 1)
+                 if frames else None)
+    prev = os.environ.get("NNSTPU_MESH")
+    os.environ["NNSTPU_MESH"] = "0"
+    try:
+        # the reference pays its own compile off the clock, like the
+        # flagship's warmup drain, so the ratio compares steady states
+        _collect(build_pipeline(BATCH, n_frames=WARMUP_DRAIN * BATCH))
+        ref_fps = measure_pipeline()["fps"]
+    finally:
+        if prev is None:
+            os.environ.pop("NNSTPU_MESH", None)
+        else:
+            os.environ["NNSTPU_MESH"] = prev
+    return {"mesh": MESH_SPEC,
+            "shard_scaling": (round(fps_median / ref_fps, 3)
+                              if ref_fps and fps_median else None),
+            "reshard_bytes_per_frame": per_frame}
 
 
 def _platform() -> str:

@@ -90,6 +90,15 @@ These rules encode invariants this codebase has already been burned by
   stops round-tripping, a key restored but never saved reads as absent
   on every real checkpoint. Classes whose schema is dynamic (no
   literal keys on one side, e.g. ``TensorRepo``) are skipped.
+- NNS117: a GSPMD sharding constructed outside the ``parallel``
+  package: ``NamedSharding``/``PositionalSharding`` instantiation, a
+  ``shard_map`` wrap, or a ``pjit`` call anywhere else scatters
+  device-placement decisions across the codebase. The serving plane
+  (``parallel/serve.py``) and the scaling toolbox (``parallel/
+  {mesh,sharded,ring,pipeline}.py``) are the audited homes for every
+  sharding: that is what makes the matched-sharding hand-off contract
+  and the per-shard HBM accounting enforceable. Callers pass a
+  mesh-spec string (``mesh=dp4``) or a plan object around instead.
 - NNS116: a wire-header ``struct.Struct`` whose field count disagrees
   with a pack/unpack site. For every ``NAME = struct.Struct("<fmt>")``
   binding in a file, each ``NAME.pack(...)`` must pass exactly as many
@@ -184,7 +193,17 @@ _SANCTIONED_FUNCS = {"to_host"}
 #: upload_many (frame transfers), the backend open() weight load and
 #: install_weights() swap (residency-unit registration)
 _MEM_SANCTIONED_FUNCS = {"to_device", "upload_many", "open",
-                         "install_weights"}
+                         "install_weights", "_register_resident"}
+
+#: sharding-construction callables (NNS117): allowed only inside the
+#: ``parallel`` package — the audited home of every placement decision
+_SHARDING_CTORS = {"NamedSharding", "jax.sharding.NamedSharding",
+                   "sharding.NamedSharding",
+                   "PositionalSharding", "jax.sharding.PositionalSharding",
+                   "shard_map", "jax.shard_map",
+                   "shard_map.shard_map",
+                   "jax.experimental.shard_map.shard_map",
+                   "pjit", "jax.experimental.pjit.pjit", "pjit.pjit"}
 
 #: obs hot-path recording function names (NNS114): the per-frame /
 #: per-event entry points of the always-on telemetry layer — anything
@@ -273,6 +292,9 @@ class _FileLinter(ast.NodeVisitor):
         self._collect_struct_bindings(tree)
         #: NNS114 applies only inside the obs package
         self._in_obs = "obs" in Path(rel).parts
+        #: NNS117 exempts the parallel package — the one audited home
+        #: where shardings may be constructed
+        self._in_parallel = "parallel" in Path(rel).parts
 
     # -- helpers -------------------------------------------------------------
     def emit(self, code: str, node: ast.AST, message: str,
@@ -364,6 +386,7 @@ class _FileLinter(ast.NodeVisitor):
         self._rule_nns112(node, dotted)
         self._rule_nns113(node, dotted)
         self._rule_nns114_deque(node, dotted)
+        self._rule_nns117(node, dotted)
         self._rule_nns116_pack(node)
         self.generic_visit(node)
 
@@ -637,6 +660,20 @@ class _FileLinter(ast.NodeVisitor):
             hint="route the upload through TensorBuffer.to_device/"
                  "upload_many, register the bytes with tensors/memory.py "
                  "(residency unit or note_h2d), or justify with a pragma")
+
+    def _rule_nns117(self, node: ast.Call, dotted: str) -> None:
+        if self._in_parallel or dotted not in _SHARDING_CTORS:
+            return
+        self.emit(
+            "NNS117", node,
+            f"{dotted}(...) constructs a GSPMD sharding outside the "
+            f"parallel package — placement decisions scattered across "
+            f"the codebase break the matched-sharding hand-off contract "
+            f"and the per-shard HBM accounting that parallel/serve.py "
+            f"makes auditable",
+            hint="name a mesh spec (mesh=dp4 / get_mesh_plan) and use "
+                 "the plan's batched()/replicated() shardings, or add a "
+                 "helper in parallel/ — or justify with a pragma")
 
     def _rule_nns114_deque(self, node: ast.Call, dotted: str) -> None:
         if not self._in_obs:

@@ -88,6 +88,10 @@ CODE_TABLE: Dict[str, str] = {
               "of values, or a tuple-unpack binding the wrong number of "
               "names, raises only at runtime — on the first real frame, "
               "usually on the peer)",
+    "NNS117": "GSPMD sharding constructed outside the parallel package "
+              "(NamedSharding/PositionalSharding/shard_map/pjit anywhere "
+              "else scatters placement decisions that parallel/serve.py "
+              "keeps auditable — pass a mesh spec or plan instead)",
     "NNS199": "nns-lint pragma without a justification",
     # -- concurrency (whole-program analysis) --------------------------------
     "NNS201": "access to a lock-guarded attribute outside the lock (the "

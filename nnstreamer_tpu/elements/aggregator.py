@@ -30,10 +30,13 @@ from typing import List, Optional
 
 import numpy as np
 
+from nnstreamer_tpu.log import get_logger
 from nnstreamer_tpu.obs import timeline as _timeline
 from nnstreamer_tpu.pipeline.element import Element
 from nnstreamer_tpu.registry import ELEMENT, subplugin
 from nnstreamer_tpu.tensors.buffer import TensorBuffer, is_device_array
+
+log = get_logger("elements.aggregator")
 
 
 @subplugin(ELEMENT, "tensor_aggregator")
@@ -114,6 +117,25 @@ class TensorAggregator(Element):
             self._flusher.join(timeout=5)
             self._flusher = None
         super().stop()
+
+    def note_mesh_quantum(self, quantum: int) -> None:
+        """Mesh-wide batch forming (parallel/serve.py): round frames-out
+        up to a multiple of the pipeline's dp shard count so every full
+        window this former emits splits evenly across the mesh. A
+        non-multiple window is still legal — the sharded region falls
+        back to a replicated invoke for it — but it serializes the batch
+        onto one shard, so the former should not produce one by
+        construction. Called by Pipeline.start() once the sharded plan
+        is known; pass-through configs (frames-out == 1) are left alone
+        because the user asked for per-frame service, not batching."""
+        q = max(1, int(quantum))
+        fout = int(self.get_property("frames_out"))
+        if q <= 1 or fout <= 1 or fout % q == 0:
+            return
+        rounded = ((fout + q - 1) // q) * q
+        log.info("%s: frames-out %d -> %d (mesh shard quantum %d)",
+                 self.name, fout, rounded, q)
+        self.set_property("frames_out", rounded)
 
     def transform_caps(self, pad, caps):
         return None  # announced from the first output (shape changes)

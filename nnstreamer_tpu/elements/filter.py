@@ -97,6 +97,13 @@ class TensorFilter(Element):
         "input_combination": None,
         "output_combination": None,
         "shared_tensor_filter_key": None,
+        # multi-chip serving plane (parallel/serve.py): mesh spec like
+        # "dp4" / "dp2xtp2" / "dp-1" — batch-shards the invoke over the
+        # device mesh with replicated weights; "shard" is an accepted
+        # alias. Unset (or NNSTPU_MESH=0) = byte-identical single-device
+        # path.
+        "mesh": None,
+        "shard": None,
         "throttle": 0,            # max invokes/sec; 0 = unthrottled
         # max device batches outstanding past this filter before the
         # producer thread fences the oldest (pipeline/dispatch.py):
@@ -119,6 +126,7 @@ class TensorFilter(Element):
         self._last_invoke_t = 0.0
         self._comb_cache: dict = {}
         self._m_invoke = None  # created lazily: labels need pipeline name
+        self._m_shard = None   # nns_shard_count gauge (mesh= filters only)
 
     def _obs_invoke(self):
         """Filter-specific metrics. ``nns_tensor_filter_invoke_seconds``
@@ -193,6 +201,7 @@ class TensorFilter(Element):
             model=model,
             custom=self.get_property("custom"),
             accelerator=self.get_property("accelerator"),
+            mesh=self.get_property("mesh") or self.get_property("shard"),
             input_info=self._forced_info("input", "inputtype"),
             output_info=self._forced_info("output", "outputtype"),
             is_updatable=bool(self.get_property("is_updatable")),
@@ -206,6 +215,18 @@ class TensorFilter(Element):
         fw.open(props)
         self.fw = fw
         self._obs_invoke()["opens"].inc()
+        plan = getattr(fw, "_mesh_plan", None)
+        if plan is not None and self._m_shard is None:
+            # nns_shard_count{filter=...}: how many chips this filter's
+            # serving mesh spans (0/absent = single-device). Exported on
+            # /metrics[.json] and federated by name like every gauge.
+            n = int(plan.shard_count)
+            self._m_shard = get_registry().gauge(
+                "nns_shard_count",
+                "Devices in this filter's serving mesh (mesh= property)",
+                fn=lambda _n=n: float(_n),
+                pipeline=getattr(self.pipeline, "name", "") or "",
+                filter=self.name)
         return fw
 
     def _forced_info(self, dim_key: str, type_key: str) -> Optional[TensorsInfo]:
@@ -330,6 +351,26 @@ class TensorFilter(Element):
                 if not isinstance(x, np.ndarray) else x
                 for x in model_inputs]
 
+        tl = _timeline.ACTIVE
+        seq = buf.meta.get(_timeline.TRACE_SEQ_META) \
+            if tl is not None else None
+        plan = getattr(fw, "_mesh_plan", None)
+        if plan is not None:
+            # unfused mesh invoke (e.g. the budgeted-weights path region
+            # fusion skips): place the batch HERE, where the frame's
+            # trace identity is known, so the placement wait lands in
+            # the ledger as its own `shard` stage — the fused path does
+            # the same in FusedRegion.chain. The backend's own
+            # place_batch then sees matched arrays and moves nothing.
+            from nnstreamer_tpu.parallel import serve as _serve
+
+            t_sh0 = _time.monotonic()
+            model_inputs = [_serve.place_batch(x, plan)
+                            for x in model_inputs]
+            if tl is not None and seq is not None:
+                tl.span("shard", seq, t_sh0, _time.monotonic(),
+                        track=self.name)
+
         fi = _faults.ACTIVE
         if fi is not None:
             # chaos hook, BEFORE the stash pop: a retrying error policy
@@ -352,9 +393,6 @@ class TensorFilter(Element):
             raise
         dt = _time.monotonic() - t0
         obs["invoke"].observe(dt)
-        tl = _timeline.ACTIVE
-        seq = buf.meta.get(_timeline.TRACE_SEQ_META) \
-            if tl is not None else None
         if tl is not None and seq is not None:
             tl.span("device", seq, t0, t0 + dt, track=self.name)
         sched = getattr(self.pipeline, "_slo_scheduler", None)
@@ -381,6 +419,13 @@ class TensorFilter(Element):
             # nothing is outstanding for them.
             self._window.admit(final, stash, frame=seq)
         out_buf = buf.with_tensors(final)
+        if plan is not None:
+            # NamedSharding-stamped hand-off: downstream sharded
+            # consumers (and verify_mesh_boundaries' runtime twin,
+            # place_batch) can see which mesh this batch already lives on
+            from nnstreamer_tpu.parallel import serve as _serve
+
+            out_buf.meta[_serve.MESH_SPEC_META] = plan.spec
         if peer_device_capable(self.srcpad):
             # device-capable downstream: keep the result resident (no-op
             # for host outputs or when NNSTPU_RESIDENT=0)
@@ -420,7 +465,8 @@ class TensorFilter(Element):
             "tensor_filter", backend_stage.key,
             tuple(in_comb or ()), tuple(out_comb or ()),
         )
-        return DeviceStage(consts=backend_stage.consts, fn=fn, key=key)
+        return DeviceStage(consts=backend_stage.consts, fn=fn, key=key,
+                           mesh=backend_stage.mesh)
 
     # -- events --------------------------------------------------------------
     def sink_event(self, pad, event: Event):

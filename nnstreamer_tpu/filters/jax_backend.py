@@ -52,9 +52,12 @@ from nnstreamer_tpu.filters.api import (
     shared_model_insert,
 )
 from nnstreamer_tpu.config import ARTIFACT_EXTS
+from nnstreamer_tpu.log import get_logger
 from nnstreamer_tpu.registry import FILTER, subplugin
 from nnstreamer_tpu.tensors import memory as _memory
 from nnstreamer_tpu.tensors.types import TensorInfo, TensorsInfo, TensorType
+
+log = get_logger("jax-filter")
 
 _registered: Dict[str, dict] = {}
 _reg_lock = threading.Lock()
@@ -169,9 +172,15 @@ class JaxFilter(FilterFramework):
         self._jitted: Optional[Callable] = None
         self._device = None
         self._sharding = None
+        #: parsed ``mesh=`` serving plan (parallel/serve.py MeshPlan);
+        #: None = single-device (or NNSTPU_MESH=0 killed the mesh)
+        self._mesh_plan = None
         #: residency unit holding the device params when an HBM budget
-        #: is active (tensors/memory.py); None = plain resident weights
+        #: is active (tensors/memory.py); None = plain resident weights.
+        #: Under a mesh this is the PRIMARY of a per-shard unit group
+        #: and _resident_keys lists every shard key for retirement.
         self._resident = None
+        self._resident_keys: List[str] = []
 
     # -- lifecycle -----------------------------------------------------------
     def open(self, props: FilterProperties) -> None:
@@ -218,6 +227,25 @@ class JaxFilter(FilterFramework):
 
                 self._sharding = batch_sharding(part.split(":", 1)[1])
 
+        # mesh= property (elements/filter.py): the first-class multi-chip
+        # serving plane. A MeshPlan is BatchSharding-compatible, so the
+        # invoke path below shards the batch over dp and replicates the
+        # weights exactly like custom=sharding: — plus the fused region
+        # compiles the whole-graph program across the mesh. Kill switch:
+        # NNSTPU_MESH=0 ignores the property and keeps this filter
+        # byte-identical to the single-device path.
+        self._mesh_plan = None
+        mesh_spec = getattr(props, "mesh", None)
+        if mesh_spec:
+            from nnstreamer_tpu.parallel import serve as _serve
+
+            if _serve.mesh_enabled():
+                self._mesh_plan = _serve.get_mesh_plan(mesh_spec)
+                self._sharding = self._mesh_plan
+            else:
+                log.info("mesh=%s requested but NNSTPU_MESH=0: "
+                         "single-device path", mesh_spec)
+
         if self._params is not None:
             tgt = self._sharding.replicated() if self._sharding else self._device
             acct = _memory.ACTIVE
@@ -226,15 +254,8 @@ class JaxFilter(FilterFramework):
                 # unit — self._params stays the HOST pytree (shapes for
                 # eval_shape), the device copy is fetched per invoke via
                 # the unit so an eviction genuinely frees the HBM
-                host_params = self._params
-
-                def _load(hp, _tgt=tgt):
-                    return jax.device_put(hp, _tgt)
-
-                self._resident = acct.residency.register(
-                    key=f"jax:{id(self)}", host_value=host_params,
-                    nbytes=_memory.pytree_nbytes(host_params),
-                    loader=_load, label=str(model))
+                self._resident = self._register_resident(
+                    acct, f"jax:{id(self)}", self._params, tgt, str(model))
                 self._resident.value()  # initial load, under the budget
             else:
                 self._params = jax.device_put(self._params, tgt)
@@ -275,6 +296,35 @@ class JaxFilter(FilterFramework):
             f".msgpack file, not a {'/'.join(ARTIFACT_EXTS)} artifact)"
         )
 
+    def _register_resident(self, acct, key_base: str, host_params: Any,
+                           tgt, label: str):
+        """Register the weights with the HBM accountant and return the
+        primary residency unit. Single-device: one unit. Under a mesh:
+        ONE UNIT PER SHARD in a load/evict group — the replicated
+        placement puts a full copy on every chip, so each shard unit
+        carries the full pytree bytes and ``nns_mem_used_bytes`` sums to
+        the real multi-chip HBM footprint. ``_resident_keys`` records
+        every key so close()/install_weights() retire the whole group."""
+        import jax
+
+        nbytes = _memory.pytree_nbytes(host_params)
+
+        def _load(hp, _tgt=tgt):
+            return jax.device_put(hp, _tgt)
+
+        plan = self._mesh_plan
+        if plan is None:
+            self._resident_keys = [key_base]
+            return acct.residency.register(
+                key=key_base, host_value=host_params, nbytes=nbytes,
+                loader=_load, label=label)
+        units = [acct.residency.register(
+            key=f"{key_base}:shard{k}", host_value=host_params,
+            nbytes=nbytes, loader=_load, label=f"{label}#shard{k}",
+            group=key_base) for k in range(plan.shard_count)]
+        self._resident_keys = [u.key for u in units]
+        return units[0]
+
     def install_weights(self, params: Any, epoch: int = 0) -> Dict[str, Any]:
         """In-place params swap for ``Pipeline.swap_model`` (serving
         continuity): the model *function* is unchanged, so the fused
@@ -293,21 +343,19 @@ class JaxFilter(FilterFramework):
         acct = _memory.ACTIVE
         out: Dict[str, Any] = {"residency": None, "retired": None}
         if acct is not None:
-            host_params = params
-
-            def _load(hp, _tgt=tgt):
-                return jax.device_put(hp, _tgt)
-
             old = self._resident
+            old_keys = list(self._resident_keys)
             new_key = f"jax:{id(self)}:e{int(epoch)}"
-            self._resident = acct.residency.register(
-                key=new_key, host_value=host_params,
-                nbytes=_memory.pytree_nbytes(host_params),
-                loader=_load,
-                label=f"{self.props.model}@e{int(epoch)}")
-            self._params = host_params
+            self._resident = self._register_resident(
+                acct, new_key, params, tgt,
+                f"{self.props.model}@e{int(epoch)}")
+            self._params = params
             if old is not None:
-                acct.residency.unregister(old.key)
+                # retire the WHOLE previous epoch — under a mesh that is
+                # one unit per shard, and leaving any behind would leak
+                # a full per-chip weight copy in nns_mem_used_bytes
+                for k in old_keys:
+                    acct.residency.unregister(k)
                 out["retired"] = old.key
             out["residency"] = new_key
             self._resident.value()  # load now, under the budget
@@ -320,8 +368,10 @@ class JaxFilter(FilterFramework):
         if self._resident is not None:
             acct = _memory.ACTIVE
             if acct is not None:
-                acct.residency.unregister(self._resident.key)
+                for k in (self._resident_keys or [self._resident.key]):
+                    acct.residency.unregister(k)
             self._resident = None
+            self._resident_keys = []
         self._fn = self._params = self._jitted = None
         super().close()
 
@@ -362,15 +412,19 @@ class JaxFilter(FilterFramework):
         """Expose the model as a pure fused-region stage; params ride as the
         stage consts so hot reload swaps them without recompiling.
 
-        Not fusible with batch sharding or an explicitly-requested platform:
-        invoke() places inputs with NamedSharding / onto the chosen device,
-        and a plain fused jit would silently drop that placement. Not
-        fusible either while an HBM budget holds the weights as an
-        evictable residency unit — fused consts would pin the evicted
-        device copy alive and the eviction would free nothing."""
-        if self._fn is None or self._sharding is not None or \
-                self._resident is not None or \
+        Not fusible with legacy ``custom=sharding:`` batch sharding or an
+        explicitly-requested platform: invoke() places inputs with
+        NamedSharding / onto the chosen device, and a plain fused jit
+        would silently drop that placement. A ``mesh=`` plan IS fusible —
+        the stage advertises the mesh spec and the region compiles the
+        whole-graph program with the plan's shardings (pipeline/fuse.py).
+        Not fusible while an HBM budget holds the weights as an evictable
+        residency unit — fused consts would pin the evicted device copy
+        alive and the eviction would free nothing."""
+        if self._fn is None or self._resident is not None or \
                 getattr(self, "_explicit_platform", None):
+            return None
+        if self._sharding is not None and self._mesh_plan is None:
             return None
         from nnstreamer_tpu.pipeline.fuse import DeviceStage
 
@@ -378,7 +432,9 @@ class JaxFilter(FilterFramework):
             return self._call(params, *tensors)
 
         return DeviceStage(consts=self._params, fn=fn,
-                           key=("jax", id(self), self._fn_token))
+                           key=("jax", id(self), self._fn_token),
+                           mesh=self._mesh_plan.spec
+                           if self._mesh_plan is not None else None)
 
     # -- hot path ------------------------------------------------------------
     def invoke(self, inputs: Sequence[Any]) -> List[Any]:
@@ -387,12 +443,22 @@ class JaxFilter(FilterFramework):
         if self._jitted is None:
             self._jitted = jax.jit(self._call)
         dev_inputs = []
-        for x in inputs:
-            if isinstance(x, jax.Array) and self._sharding is None:
-                dev_inputs.append(x)
-            else:
-                tgt = self._sharding.batched() if self._sharding else self._device
-                dev_inputs.append(jax.device_put(x, tgt))  # nns-lint: disable=NNS113 -- transient invoke input; the frame's bytes are tracked upstream at to_device/upload_many
+        if self._mesh_plan is not None:
+            # mesh invoke: batch-shard over dp via the serving plane —
+            # already-matched device arrays move ZERO bytes, a sharding
+            # mismatch re-places AND counts nns_reshard_bytes_total
+            from nnstreamer_tpu.parallel import serve as _serve
+
+            dev_inputs = [_serve.place_batch(x, self._mesh_plan)
+                          for x in inputs]
+        else:
+            for x in inputs:
+                if isinstance(x, jax.Array) and self._sharding is None:
+                    dev_inputs.append(x)
+                else:
+                    tgt = self._sharding.batched() if self._sharding \
+                        else self._device
+                    dev_inputs.append(jax.device_put(x, tgt))  # nns-lint: disable=NNS113 -- transient invoke input; the frame's bytes are tracked upstream at to_device/upload_many
         # budgeted mode routes through the residency unit: an evicted
         # model prefetches back in here (LRU touch per invoke)
         params = self._resident.value() if self._resident is not None \

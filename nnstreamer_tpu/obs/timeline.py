@@ -40,6 +40,9 @@ latency:
 - ``queue_wait``  FIFO queue residency (entry → drain pop)
 - ``sched_hold``  EDF-heap residency in a scheduler-mode queue
 - ``fence_wait``  dispatch-window fence block for the frame's own entry
+- ``shard``       mesh placement of the frame's tensors onto the serving
+                  mesh (sharded fused regions only; zero/absent on
+                  single-device pipelines and matched hand-offs)
 - ``device``      filter/fused-region invoke dispatch
 - ``d2h``         the sanctioned ``to_host()`` materialization block
 - ``decode``      tensor→media decode (host part)
@@ -84,8 +87,8 @@ TRACE_SEQ_META = "trace_seq"
 #: span kinds that tile a frame's critical path — the stage_breakdown /
 #: reconciliation set, in pipeline order
 LOCAL_STAGES: Tuple[str, ...] = ("ingest", "lane_reorder", "queue_wait",
-                                 "sched_hold", "fence_wait", "device",
-                                 "d2h", "decode", "sink")
+                                 "sched_hold", "fence_wait", "shard",
+                                 "device", "d2h", "decode", "sink")
 
 #: distributed-hop stages spliced into the CLIENT ledger by
 #: elements/query.py when cross-hop tracing is armed (obs/distributed):

@@ -106,16 +106,21 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh,
 
 def shard_params(params, mesh: Mesh, cfg: TransformerConfig,
                  pipelined: bool = False):
+    """Rule-sharded param placement, routed through the serving plane's
+    :func:`~nnstreamer_tpu.parallel.serve.place_params` so the per-shard
+    HBM registers with the budget accountant (``nns_mem_used_bytes``)
+    whenever one is active — multi-chip weights are no longer invisible
+    to the memory plane."""
+    from nnstreamer_tpu.parallel import serve as _serve
+
     if pipelined:
         from nnstreamer_tpu.parallel.pipeline import pipeline_param_specs
 
         specs = pipeline_param_specs(cfg)
     else:
         specs = transformer_param_specs(cfg)
-    return {
-        k: jax.device_put(v, NamedSharding(mesh, specs[k]))  # nns-lint: disable=NNS113 -- mesh-sharded training placement; the HBM budget accountant tracks single-device serving memory
-        for k, v in params.items()
-    }
+    return _serve.place_params(params, mesh, specs,
+                               label="sharded:transformer")
 
 
 def make_pp_train_step(cfg: TransformerConfig, mesh: Mesh,

@@ -32,6 +32,7 @@ from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 from nnstreamer_tpu.log import get_logger
 from nnstreamer_tpu.obs import timeline as _timeline
+from nnstreamer_tpu.parallel import serve as _serve
 from nnstreamer_tpu.pipeline import faults as _faults
 from nnstreamer_tpu.pipeline.element import (
     CustomEvent,
@@ -82,6 +83,10 @@ class DeviceStage:
     consts: Any
     fn: Callable[[Any, List[Any]], List[Any]]
     key: Any = None
+    #: serving-mesh spec (``parallel/serve.py`` grammar) this stage's
+    #: consts are placed for — the region adopts it and compiles the
+    #: whole-graph program sharded across the mesh. None = single device.
+    mesh: Optional[str] = None
     #: optional deferred host completion ``fn(host_buf) -> TensorBuffer``
     #: attached to outgoing buffers (TensorBuffer.finalize) — used by
     #: decoders whose math runs on device but whose output needs host-only
@@ -191,6 +196,9 @@ class FusedRegion(Element):
         self._m_retrace = None  # region re-trace counter (lazy)
         self._m_whole = None    # whole-graph program gauge (lazy)
         self._donating = False  # the live jit was built with donation
+        #: serving MeshPlan adopted from the members' mesh= specs (set by
+        #: _build); None = single-device program
+        self._mesh_plan = None
 
     # -- stage (re)build -----------------------------------------------------
     def _build(self) -> Tuple[list, Callable]:
@@ -205,11 +213,28 @@ class FusedRegion(Element):
                     f"longer fusible"
                 )
             stages.append(st)
-        keys = [st.key for st in stages]
+        # mesh adoption: a member carrying a mesh= spec asks the WHOLE
+        # region to compile sharded across that mesh. One program has one
+        # mesh — mixed specs inside a run are a hard plan-time error (NOT
+        # a FlowError: silently unsplicing to per-element dispatch would
+        # hide a sharding contract violation)
+        specs = sorted({st.mesh for st in stages if st.mesh is not None})
+        if len(specs) > 1:
+            raise _serve.MeshShardingError(
+                f"fused region {self.name}: members carry mixed mesh specs "
+                f"{specs}; align the mesh= properties or split the run "
+                f"with a non-fusible element")
+        plan = _serve.get_mesh_plan(specs[0]) \
+            if specs and _serve.mesh_enabled() else None
+        self._mesh_plan = plan
+        stage_keys = [st.key for st in stages]
+        # the mesh spec is part of the traced computation's identity: the
+        # same member fns compile to a different XLA program per mesh
+        keys = stage_keys + [("mesh", plan.spec if plan is not None else "")]
         cache = self._trace_cache
         # a None key means "cannot prove the computation is unchanged" —
         # never match it against the cache
-        if any(k is None for k in keys):
+        if any(k is None for k in stage_keys):
             cache = None
         if cache is not None and cache[0] == keys:
             jitted = cache[1]
@@ -237,6 +262,15 @@ class FusedRegion(Element):
             # substitutes a device-side replay copy whenever the
             # original must survive (unverified first frame, armed
             # retry/degrade policy, non-exclusive payload).
+            # under a mesh plan this same jit IS the whole-graph SHARDED
+            # program: chain() places inputs batch-sharded over dp
+            # (serve.place_batch) and GSPMD propagates that sharding
+            # through to the outputs — for leading-dim batch sharding
+            # the propagation is exact, so the hand-off into a
+            # downstream region on the same mesh is matched and moves
+            # zero bytes. No sharding is CONSTRUCTED here (NNS117);
+            # pinning out_shardings instead would reject the ragged
+            # batches (flush tails) that place_batch runs replicated.
             jitted = jax.jit(composed, donate_argnums=(1,)) \
                 if donation_enabled() else jax.jit(composed)
             self._trace_cache = (keys, jitted)
@@ -352,6 +386,19 @@ class FusedRegion(Element):
         exclusive = bool(buf.meta.pop(H2D_EXCLUSIVE_META, False))
         stash = buf.meta.pop(POOL_STASH_META, None)
         args = list(buf.tensors)
+        plan = self._mesh_plan
+        t_sh1 = t_dev0
+        if plan is not None:
+            # mesh placement BEFORE the donation decision: an input
+            # already carrying the plan's batch sharding (the matched
+            # hand-off from an upstream sharded region) passes through
+            # untouched — zero bytes, nns_reshard_bytes_total unmoved;
+            # host arrays scatter over dp. Under a mesh the pre-dispatch
+            # segment (placement, plus any injected invoke stall above)
+            # attributes to the "shard" span, and "device" starts here —
+            # the two stages still tile the frame's end-to-end time.
+            args = [_serve.place_batch(t, plan) for t in args]
+            t_sh1 = _time.monotonic()
         if self._donating and not (
                 exclusive and self._verified
                 and effective_policy(self) not in _REPLAY_POLICIES):
@@ -393,7 +440,9 @@ class FusedRegion(Element):
         if tl is not None:
             seq = buf.meta.get(_timeline.TRACE_SEQ_META)
             if seq is not None:
-                tl.span("device", seq, t_dev0, _time.monotonic(),
+                if plan is not None:
+                    tl.span("shard", seq, t_dev0, t_sh1, track=self.name)
+                tl.span("device", seq, t_sh1, _time.monotonic(),
                         track=self.name)
         # bounded async dispatch: register the outstanding batch (fences
         # the OLDEST only when more than `inflight` are in flight); the
@@ -401,6 +450,11 @@ class FusedRegion(Element):
         # that fence point
         self._window.admit(out, stash)
         out_buf = buf.with_tensors(list(out))
+        if plan is not None:
+            # stamp which serving plan produced these (NamedSharding-
+            # carrying) arrays — downstream consumers and dumps can read
+            # the spec without touching the device data
+            out_buf.meta[_serve.MESH_SPEC_META] = plan.spec
         if finalize is not None:
             out_buf = out_buf.replace(finalize=finalize)
         if peer_device_capable(self.srcpad):
@@ -561,3 +615,96 @@ def fuse_pipeline(pipe) -> List[FusedRegion]:
         region.splice(pipe)
         regions.append(region)
     return regions
+
+
+# --------------------------------------------------------------------------
+# plan-time matched-sharding verification (parallel/serve.py contract)
+# --------------------------------------------------------------------------
+def _element_mesh_spec(el) -> Optional[str]:
+    """The serving-mesh spec this element invokes under, or None. Covers
+    sharded fused regions (``_mesh_plan`` from _build) and UNFUSED
+    tensor_filters whose backend holds a plan (e.g. the budgeted-weights
+    invoke path, which region fusion deliberately skips)."""
+    plan = getattr(el, "_mesh_plan", None)
+    if plan is None:
+        plan = getattr(getattr(el, "fw", None), "_mesh_plan", None)
+    return plan.spec if plan is not None else None
+
+
+def verify_mesh_boundaries(pipe) -> None:
+    """PLAN-time check of the matched-sharding contract: every device-
+    passthrough hand-off between two mesh-sharded invokers must carry
+    identical mesh specs, so the producer's out-sharding equals the
+    consumer's in-sharding and the hand-off moves ZERO bytes. A mismatch
+    raises :class:`~nnstreamer_tpu.parallel.serve.MeshShardingError`
+    before any frame flows — a silent runtime reshard of every frame is
+    exactly the performance bug the ``mesh=`` property exists to prevent.
+    (Hand-offs that cross a non-passthrough element materialize to host
+    anyway and are exempt: that boundary's cost is already explicit.)
+
+    Runs in ``Pipeline.start()`` after regions compile; inert when no
+    element carries a mesh plan or ``NNSTPU_MESH=0``.
+    """
+    if not _serve.mesh_enabled():
+        return
+    producers = []
+    for el in _live_invokers(pipe):
+        spec = _element_mesh_spec(el)
+        if spec is not None:
+            producers.append((el, spec))
+    for el, spec in producers:
+        for pad in el.srcpads:
+            _walk_boundary(el, spec, pad, set())
+
+
+def _live_invokers(pipe):
+    """Pipeline elements buffers actually flow through: added elements
+    minus fused members, plus the spliced regions themselves (regions
+    live in ``pipe._regions``, not ``pipe.elements``)."""
+    for el in getattr(pipe, "elements", []):
+        if getattr(el, "_fused_region", None) is not None:
+            continue  # fused member: its pads are re-routed
+        yield el
+    for r in (getattr(pipe, "_regions", None) or ()):
+        if not getattr(r, "_dead", False):
+            yield r
+
+
+def pipeline_shard_count(pipe) -> int:
+    """Largest serving-mesh fan-out any invoker in the pipeline runs
+    under (1 = single device) — the SLO scheduler aligns its admission
+    batch cap to a multiple of this so every admitted micro-batch splits
+    evenly over dp shards."""
+    n = 1
+    for el in _live_invokers(pipe):
+        plan = getattr(el, "_mesh_plan", None)
+        if plan is None:
+            plan = getattr(getattr(el, "fw", None), "_mesh_plan", None)
+        if plan is not None:
+            n = max(n, int(plan.shard_count))
+    return n
+
+
+def _walk_boundary(producer, spec: str, pad: Pad, seen: set) -> None:
+    peer = pad.peer
+    if peer is None:
+        return
+    el = peer.element
+    if id(el) in seen:
+        return
+    seen.add(id(el))
+    consumer_spec = _element_mesh_spec(el)
+    if consumer_spec is not None:
+        if consumer_spec != spec:
+            raise _serve.MeshShardingError(
+                f"mesh boundary {producer.name} -> {el.name}: producer "
+                f"shards over mesh={spec!r} but consumer expects "
+                f"mesh={consumer_spec!r} — the hand-off would reshard "
+                f"every frame; align the mesh= properties (or break "
+                f"residency with a non-device-passthrough element to "
+                f"make the host bounce explicit)")
+        return  # matched; the consumer's own outputs get their own walk
+    if not getattr(el, "DEVICE_PASSTHROUGH", False):
+        return  # materializes to host — no device hand-off past here
+    for p in el.srcpads:
+        _walk_boundary(producer, spec, p, seen)

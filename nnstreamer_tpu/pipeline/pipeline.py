@@ -1058,6 +1058,31 @@ class Pipeline:
             self._regions = fuse_pipeline(self)
         for r in self._regions or ():
             r.start()
+        # mesh-sharded serving plane (parallel/serve.py): verify the
+        # matched-sharding contract across device-passthrough boundaries
+        # now that every region/backend holds its plan — a mismatch is a
+        # hard MeshShardingError HERE, before any frame could silently
+        # reshard; then align the SLO scheduler's admission quantum to
+        # the dp fan-out so admitted micro-batches split evenly. Both
+        # are no-ops without a mesh= property (or with NNSTPU_MESH=0).
+        from nnstreamer_tpu.pipeline.fuse import (
+            pipeline_shard_count,
+            verify_mesh_boundaries,
+        )
+
+        verify_mesh_boundaries(self)
+        mesh_quantum = pipeline_shard_count(self)
+        if self._slo_scheduler is not None:
+            self._slo_scheduler.note_mesh(mesh_quantum)
+        if mesh_quantum > 1:
+            # mesh-wide batch forming: batch formers (tensor_aggregator
+            # — the element the query server pipeline batches through)
+            # round their window up to the dp fan-out so formed batches
+            # split evenly across the mesh
+            for el in self.elements:
+                hook = getattr(el, "note_mesh_quantum", None)
+                if hook is not None:
+                    hook(mesh_quantum)
         # ingest lane splicing after fusion (pipeline/lanes.py): a
         # transform folded into a region is already out of the replicable
         # segment, so its math runs device-side while lanes parallelize

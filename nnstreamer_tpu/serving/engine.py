@@ -336,8 +336,9 @@ class ContinuousBatchingEngine:
         self._budget = np.zeros(self.B, np.int64)  # tokens still allowed
 
         if mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
+            from jax.sharding import PartitionSpec as P
 
+            from nnstreamer_tpu.parallel import serve as _serve
             from nnstreamer_tpu.parallel.sharded import (
                 transformer_param_specs,
             )
@@ -361,20 +362,22 @@ class ContinuousBatchingEngine:
                 return P(*(a if (a is not None and a in mesh.axis_names)
                            else None for a in spec))
 
-            specs = transformer_param_specs(cfg)
-            self.params = {
-                k: jax.device_put(v, NamedSharding(mesh, prune(specs[k])))  # nns-lint: disable=NNS113 -- mesh-sharded LM placement spans devices; the budget accountant scopes single-device pipeline serving
-                for k, v in params.items()
-            }
+            specs = {k: prune(s)
+                     for k, s in transformer_param_specs(cfg).items()}
+            # serving-plane placement (parallel/serve.py): per-shard HBM
+            # registers with the budget accountant when one is active
+            self.params = _serve.place_params(params, mesh, specs,
+                                              label="engine:lm")
 
             def shard_cache(cache):
                 # cache leaves: values [L,2,B,S,h,dh] and (int8 codec)
                 # scales [L,2,B,S,h] — same prefix, so slice the spec to
-                # each leaf's rank
+                # each leaf's rank. Working state the engine resizes on
+                # its own schedule — placed, not budget-registered.
                 full = (None, None, dp, None, tp, None)
-                return jax.tree.map(
-                    lambda a: jax.device_put(  # nns-lint: disable=NNS113 -- sharded KV-cache placement spans devices; outside the single-device budget accountant's scope
-                        a, NamedSharding(mesh, P(*full[:a.ndim]))), cache)
+                return _serve.place_tree(
+                    cache, mesh, lambda a: P(*full[:a.ndim]),
+                    label="engine:kv-cache")
 
             self._init_cache = lambda: shard_cache(
                 init_cache(cfg, self.B, self.S, kv_codec=kv_quant))

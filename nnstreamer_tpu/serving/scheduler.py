@@ -272,6 +272,12 @@ class SloScheduler:
             batch_cap_max=batch_cap_max, inflight=inflight0,
             inflight_max=inflight_max)
         self._lanes_hint = self._current_lanes()
+        #: serving-mesh dp fan-out (pipeline/fuse.py pipeline_shard_count,
+        #: set via note_mesh at start): batch_cap() rounds down to a
+        #: multiple of it so every admitted micro-batch splits evenly
+        #: over the shards — a ragged batch pads (wastes) one chip-step
+        #: on every device. 1 = single-device, no effect.
+        self._mesh_quantum = 1
         #: decaying synthetic backlog set by the supervision layer's
         #: memory-pressure ladder (shed rung): each admission decision
         #: consumes one unit, so a pressure burst sheds at the door for
@@ -507,8 +513,22 @@ class SloScheduler:
             self._apply_knobs()
 
     # -- knob application -----------------------------------------------------
+    def note_mesh(self, shard_count: int) -> None:
+        """Adopt the pipeline's serving-mesh fan-out (Pipeline.start()
+        after region fusion): the admission quantum becomes the dp shard
+        count so drained micro-batches always split evenly over chips."""
+        self._mesh_quantum = max(1, int(shard_count))
+
     def batch_cap(self) -> int:
-        return self.controller.batch_cap
+        cap = self.controller.batch_cap
+        q = self._mesh_quantum
+        if q > 1:
+            # align DOWN to the shard quantum (but never below one full
+            # mesh-wide batch): the AIMD controller keeps its power-of-
+            # two ladder; only the value handed to the queue drain is
+            # quantized, so controller state stays mesh-agnostic
+            cap = max(q, (cap // q) * q)
+        return cap
 
     def inflight_target(self) -> int:
         return self.controller.inflight
@@ -559,6 +579,7 @@ class SloScheduler:
             "service_time_ms": round(
                 self.estimator.service_time_s() * 1e3, 3),
             "batch_cap": c.batch_cap,
+            "mesh_quantum": self._mesh_quantum,
             "inflight_target": c.inflight,
             "controller_steps": c.steps,
             "p99_ms": round((c.last_p99_s or 0.0) * 1e3, 3),

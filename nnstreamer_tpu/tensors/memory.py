@@ -102,18 +102,37 @@ def pytree_nbytes(tree: Any) -> int:
     return total
 
 
+#: device-slot sentinel for PINNED units (adopted external placements):
+#: "resident" without this manager holding the real arrays
+_PINNED = object()
+
+
 class ResidencyUnit:
     """One evictable device allocation: host staging + a loader that
     re-creates the device copy. The unit is the ONLY holder of the
     device reference — owners fetch it per use via :meth:`value` (which
     touches the LRU and reloads after an eviction), so dropping the
-    unit's reference genuinely frees the HBM."""
+    unit's reference genuinely frees the HBM.
+
+    Two mesh-serving variants:
+
+    - ``group``: per-shard units of ONE sharded/replicated placement.
+      The group loads as a whole (one loader call installs the device
+      value into every member) and evicts as a whole — a single chip's
+      slice of a mesh placement cannot be freed alone, so accounting
+      must not pretend it can.
+    - ``pinned``: accounting-only adoption of a placement whose arrays
+      the OWNER holds (training params, a serving engine). Counted in
+      ``nns_mem_used_bytes`` but never an eviction victim — evicting
+      would free nothing while the owner's references live.
+    """
 
     __slots__ = ("key", "label", "nbytes", "_host", "_loader", "_device",
-                 "loads", "evictions")
+                 "loads", "evictions", "group", "pinned")
 
     def __init__(self, key: str, host_value: Any, nbytes: int,
-                 loader: Callable[[Any], Any], label: str = ""):
+                 loader: Optional[Callable[[Any], Any]], label: str = "",
+                 group: Optional[str] = None, pinned: bool = False):
         self.key = key
         self.label = label or key
         self.nbytes = int(nbytes)
@@ -122,6 +141,8 @@ class ResidencyUnit:
         self._device: Any = None
         self.loads = 0
         self.evictions = 0
+        self.group = group
+        self.pinned = bool(pinned)
 
     @property
     def resident(self) -> bool:
@@ -151,19 +172,47 @@ class ResidencyManager:
         self._lock = threading.RLock()
         #: key → unit, ordered coldest-first (OrderedDict as LRU)
         self._units: "OrderedDict[str, ResidencyUnit]" = OrderedDict()
+        #: group name → member units (mesh per-shard groups)
+        self._groups: Dict[str, list] = {}
 
     # -- registration -------------------------------------------------------
     def register(self, key: str, host_value: Any, nbytes: int,
                  loader: Callable[[Any], Any],
-                 label: str = "") -> ResidencyUnit:
+                 label: str = "", group: Optional[str] = None
+                 ) -> ResidencyUnit:
         """Adopt a reloadable device allocation. Does NOT load — the
-        first :meth:`ResidencyUnit.value` does, under the budget."""
-        unit = ResidencyUnit(key, host_value, int(nbytes), loader, label)
+        first :meth:`ResidencyUnit.value` does, under the budget.
+        ``group`` names a mesh per-shard group: one loader call loads
+        (and one eviction drops) every member together."""
+        unit = ResidencyUnit(key, host_value, int(nbytes), loader, label,
+                             group=group)
         with self._lock:
             old = self._units.pop(key, None)
             if old is not None:
                 self._evict_locked(old)
+                self._drop_from_group(old)
             self._units[key] = unit
+            if group is not None:
+                self._groups.setdefault(group, []).append(unit)
+        return unit
+
+    def adopt(self, key: str, nbytes: int, label: str = ""
+              ) -> ResidencyUnit:
+        """Account an externally-held device placement (mesh-sharded
+        training params, serving-engine weights) as a PINNED unit: the
+        bytes register now and un-register at :meth:`unregister`; the
+        unit is never an eviction victim because this manager does not
+        hold the arrays and could free nothing."""
+        unit = ResidencyUnit(key, None, int(nbytes), None, label,
+                             pinned=True)
+        unit._device = _PINNED
+        with self._lock:
+            old = self._units.pop(key, None)
+            if old is not None:
+                self._evict_locked(old)
+                self._drop_from_group(old)
+            self._units[key] = unit
+        self._budget.register(unit.nbytes, "weights")
         return unit
 
     def unregister(self, key: str) -> None:
@@ -177,6 +226,21 @@ class ResidencyManager:
                 unit._device = None
                 self._budget.unregister(unit.nbytes, "weights")
             unit._host = None
+            self._drop_from_group(unit)
+
+    def _drop_from_group(self, unit: ResidencyUnit) -> None:
+        if unit.group is None:
+            return
+        members = self._groups.get(unit.group)
+        if members is not None:
+            members[:] = [u for u in members if u is not unit]
+            if not members:
+                self._groups.pop(unit.group, None)
+
+    def _peers_locked(self, unit: ResidencyUnit) -> list:
+        if unit.group is None:
+            return [unit]
+        return list(self._groups.get(unit.group, ())) or [unit]
 
     # -- residency ----------------------------------------------------------
     def _ensure(self, unit: ResidencyUnit) -> Any:
@@ -184,28 +248,47 @@ class ResidencyManager:
             if unit.resident:
                 self._units.move_to_end(unit.key)  # LRU touch
                 return unit._device
-            # prefetch-on-route: make room among COLDER units, then load
-            self.reclaim(unit.nbytes, keep=unit)
+            # prefetch-on-route: make room among COLDER units, then load.
+            # A grouped (per-shard) unit loads its WHOLE group in one
+            # loader call — the placement is one sharded/replicated
+            # pytree, so partial residency does not exist.
+            peers = self._peers_locked(unit)
+            needed = sum(p.nbytes for p in peers if not p.resident)
+            self.reclaim(needed, keep=unit)
             dev = unit._loader(unit._host)
-            unit._device = dev
             unit.loads += 1
             if unit.loads > 1:
                 self._budget._m["prefetches"].inc()
-                _mark("mem_prefetch", unit=unit.label, nbytes=unit.nbytes)
+                _mark("mem_prefetch", unit=unit.label, nbytes=needed)
+            for p in peers:
+                if p.resident:
+                    continue
+                p._device = dev
+                if p is not unit:
+                    p.loads += 1
+                self._units.move_to_end(p.key)
+                self._budget.register(p.nbytes, "weights", reclaim=False)
             self._units.move_to_end(unit.key)
-            self._budget.register(unit.nbytes, "weights", reclaim=False)
             return dev
 
-    def _evict_locked(self, unit: ResidencyUnit) -> None:
-        if not unit.resident:
-            return
-        unit._device = None
-        unit.evictions += 1
-        self._budget.unregister(unit.nbytes, "weights")
-        self._budget._m["evictions"].inc()
-        _mark("mem_evict", unit=unit.label, nbytes=unit.nbytes)
+    def _evict_locked(self, unit: ResidencyUnit) -> int:
+        """Drop ``unit`` (and, for a grouped unit, its whole per-shard
+        group) to host staging. Returns bytes freed."""
+        if not unit.resident or unit.pinned:
+            return 0
+        freed = 0
+        for p in self._peers_locked(unit):
+            if not p.resident:
+                continue
+            p._device = None
+            p.evictions += 1
+            freed += p.nbytes
+            self._budget.unregister(p.nbytes, "weights")
+            self._budget._m["evictions"].inc()
+        _mark("mem_evict", unit=unit.label, nbytes=freed)
         log.info("evicted residency unit %s (%d bytes) to host staging",
-                 unit.label, unit.nbytes)
+                 unit.label, freed)
+        return freed
 
     def reclaim(self, needed: int, keep: Optional[ResidencyUnit] = None
                 ) -> int:
@@ -213,24 +296,27 @@ class ResidencyManager:
         budget (or no evictable units remain). Returns bytes freed."""
         freed = 0
         with self._lock:
+            keep_group = keep.group if keep is not None else None
             for unit in list(self._units.values()):
                 if self._budget.headroom() >= needed:
                     break
-                if unit is keep or not unit.resident:
+                if unit is keep or unit.pinned or not unit.resident:
                     continue
-                self._evict_locked(unit)
-                freed += unit.nbytes
+                if keep_group is not None and unit.group == keep_group:
+                    continue  # the touched unit's own shard peers
+                freed += self._evict_locked(unit)
         return freed
 
     def evict_all(self) -> int:
         """Pressure-ladder rung 1: drop every resident unit to host
-        staging. They reload on their next touch."""
+        staging. They reload on their next touch. Pinned units stay —
+        their arrays are owner-held and an eviction would free
+        nothing."""
         freed = 0
         with self._lock:
             for unit in self._units.values():
-                if unit.resident:
-                    self._evict_locked(unit)
-                    freed += unit.nbytes
+                if unit.resident and not unit.pinned:
+                    freed += self._evict_locked(unit)
         return freed
 
     def resident_count(self) -> int:
@@ -241,7 +327,8 @@ class ResidencyManager:
         with self._lock:
             units = [{"key": u.key, "label": u.label, "nbytes": u.nbytes,
                       "resident": u.resident, "loads": u.loads,
-                      "evictions": u.evictions}
+                      "evictions": u.evictions, "group": u.group,
+                      "pinned": u.pinned}
                      for u in self._units.values()]
         return {"units": units,
                 "resident": sum(1 for u in units if u["resident"])}

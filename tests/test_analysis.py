@@ -660,6 +660,40 @@ class TestAstLint:
                      if d.code == "NNS116"]
             assert diags == [], diags
 
+    def test_nns117_sharding_ctor_outside_parallel(self):
+        src = ("from jax.sharding import NamedSharding, PartitionSpec\n"
+               "def f(mesh, x):\n"
+               "    s = NamedSharding(mesh, PartitionSpec('dp'))\n"
+               "    return s\n")
+        assert "NNS117" in codes(lint_source(src, "elements/foo.py"))
+
+    def test_nns117_dotted_forms_and_pjit(self):
+        src = ("import jax\n"
+               "from jax.experimental import pjit\n"
+               "def f(mesh, fn):\n"
+               "    a = jax.sharding.NamedSharding(mesh, None)\n"
+               "    b = pjit.pjit(fn)\n"
+               "    return a, b\n")
+        assert codes(lint_source(src, "serving/x.py")) == ["NNS117",
+                                                          "NNS117"]
+
+    def test_nns117_inside_parallel_package_exempt(self):
+        src = ("from jax.sharding import NamedSharding\n"
+               "def f(mesh, spec):\n"
+               "    return NamedSharding(mesh, spec)\n")
+        assert by_code(
+            lint_source(src, "nnstreamer_tpu/parallel/serve.py"),
+            "NNS117") == []
+
+    def test_nns117_pragma_suppressible(self):
+        src = ("from jax.sharding import NamedSharding\n"
+               "def f(mesh, spec):\n"
+               "    return NamedSharding(  # nns-lint: disable=NNS117 -- "
+               "one-off placement in a test harness\n"
+               "        mesh, spec)\n")
+        assert by_code(lint_source(src, "elements/foo.py"),
+                       "NNS117") == []
+
     def test_pragma_suppresses_with_reason(self):
         src = ("import time\n"
                "d = time.time()  # nns-lint: disable=NNS101 -- epoch "

@@ -695,3 +695,132 @@ def test_greedy_stream_step_multi_matches_single():
     assert np.asarray(toks).tolist() == singles
     assert int(tok2[0]) == singles[-1]
     assert int(pos2) == 6
+
+
+# -- mesh-sharded streaming pipeline (parallel/serve.py) ----------------------
+#
+# Promotion of __graft_entry__.dryrun_multichip's fourth pass to a CI
+# gate: N live sources → merge-batch → one dpN-sharded XLA invoke via the
+# first-class `mesh=` tensor_filter property → device-side label decode →
+# host sink. The sharded run's labels must equal the single-device run's
+# exactly, and the hand-offs must not reshard a single byte.
+
+
+class TestMeshShardedPipeline:
+    N_SRC = 8
+    PATS = ["gradient", "ball", "black", "smpte"]
+
+    @pytest.fixture
+    def cls_model(self):
+        from nnstreamer_tpu.filters.jax_backend import (
+            register_jax_model,
+            unregister_jax_model,
+        )
+
+        w = jnp.asarray(np.random.default_rng(7).standard_normal(
+            (16 * 16 * 3, 10)).astype(np.float32))
+
+        def classify(x):  # [N,16,16,3] uint8 → [N,10] logits
+            xf = (x.astype(jnp.float32) - 127.5) / 127.5
+            return (xf.reshape(x.shape[0], -1) @ w,)
+
+        register_jax_model("mesh_pipe_cls", classify, None)
+        yield "mesh_pipe_cls"
+        unregister_jax_model("mesh_pipe_cls")
+
+    def _desc(self, model, extra=""):
+        srcs = "".join(
+            f"videotestsrc num-buffers=4 width=16 height=16 "
+            f"pattern={self.PATS[i % len(self.PATS)]} ! "
+            f"tensor_converter ! m. "
+            for i in range(self.N_SRC))
+        return (srcs +
+                "tensor_merge name=m mode=linear option=3 "
+                "sync-mode=slowest ! "
+                f"tensor_filter framework=jax model={model} {extra}! "
+                "tensor_decoder mode=image_labeling option2=batched ! "
+                "tensor_sink name=sink to-host=true")
+
+    def _labels(self, model, extra=""):
+        from nnstreamer_tpu import parse_launch
+
+        pipe = parse_launch(self._desc(model, extra))
+        msg = pipe.run(timeout=600)
+        assert msg is not None and msg.kind == "eos", f"pipeline: {msg}"
+        return [np.asarray(b.tensors[0]).tolist()
+                for b in pipe.get("sink").buffers]
+
+    def test_dp8_labels_match_single_device(self, cls_model):
+        from nnstreamer_tpu.parallel import serve
+
+        reshard0 = serve.reshard_bytes_total()
+        sharded = self._labels(cls_model, "mesh=dp8 ")
+        single = self._labels(cls_model)
+        assert len(sharded) == 4, sharded
+        assert sharded == single, (
+            f"mesh pipeline labels diverged: {sharded} vs {single}")
+        # merge hands the batch to the one sharded invoker straight from
+        # host — nothing in this graph may reshard
+        assert serve.reshard_bytes_total() == reshard0
+
+    def test_elementwise_dp8_byte_identical(self):
+        """Golden byte-identity: an elementwise model's dp8 outputs are
+        bit-equal to single-device (matmul contraction order varies with
+        the per-shard batch on CPU XLA, elementwise does not — this is
+        the strongest cross-mesh determinism CPU XLA can promise)."""
+        from nnstreamer_tpu import parse_launch
+        from nnstreamer_tpu.filters.jax_backend import (
+            register_jax_model,
+            unregister_jax_model,
+        )
+
+        def norm(x):
+            return ((x.astype(jnp.float32) - 127.5) / 127.5 * 0.977
+                    + 0.003,)
+
+        register_jax_model("mesh_pipe_elt", norm, None)
+        try:
+            outs = {}
+            for key, extra in (("dp8", "mesh=dp8 "), ("single", "")):
+                srcs = "".join(
+                    f"videotestsrc num-buffers=2 width=8 height=8 "
+                    f"pattern={self.PATS[i % len(self.PATS)]} ! "
+                    f"tensor_converter ! m. "
+                    for i in range(self.N_SRC))
+                pipe = parse_launch(
+                    srcs +
+                    "tensor_merge name=m mode=linear option=3 "
+                    "sync-mode=slowest ! "
+                    "tensor_filter framework=jax model=mesh_pipe_elt "
+                    f"{extra}! tensor_sink name=sink to-host=true")
+                msg = pipe.run(timeout=600)
+                assert msg is not None and msg.kind == "eos", msg
+                outs[key] = [np.asarray(b.tensors[0])
+                             for b in pipe.get("sink").buffers]
+        finally:
+            unregister_jax_model("mesh_pipe_elt")
+        assert len(outs["dp8"]) == len(outs["single"]) == 2
+        for a, b in zip(outs["dp8"], outs["single"]):
+            assert a.dtype == b.dtype and a.shape == b.shape
+            assert np.array_equal(a, b), "dp8 not byte-identical"
+
+    def test_kill_switch_single_device_path(self, cls_model, monkeypatch):
+        """NNSTPU_MESH=0 with a mesh= property still present must take
+        the byte-identical single-device path: no plan on the backend,
+        labels equal the plain run."""
+        from nnstreamer_tpu import parse_launch
+
+        monkeypatch.setenv("NNSTPU_MESH", "0")
+        pipe = parse_launch(self._desc(cls_model, "mesh=dp8 name=filter "))
+        pipe.start()
+        try:
+            assert pipe.get("filter").fw._mesh_plan is None, \
+                "kill switch must keep the backend planless"
+            msg = pipe.wait(timeout=600)
+            assert msg is not None and msg.kind == "eos", msg
+        finally:
+            pipe.stop()
+        killed = [np.asarray(b.tensors[0]).tolist()
+                  for b in pipe.get("sink").buffers]
+        monkeypatch.delenv("NNSTPU_MESH")
+        assert killed == self._labels(cls_model)
