@@ -108,6 +108,15 @@ These rules encode invariants this codebase has already been burned by
   ``_EXT2_HDR``, ...) are evolved by editing the format string and its
   pack/unpack sites in separate places — a count mismatch raises only
   at runtime, on the first real frame, usually on the peer.
+- NNS118: a direct subscript of a paged KV arena (a name whose final
+  component is ``arena``/``_arena``/``*_arena``, ``.at[...]`` included)
+  outside ``serving/kvpool.py``: the block pool is the one audited home
+  for host-side arena reads and mutations — refcounts, buffer donation,
+  and the zero-block/sentinel invariants all live there, and a raw
+  ``arena[...]`` elsewhere silently breaks them (a freed block's bytes
+  read as stale history, a donated buffer is use-after-free). The
+  model-side paged builders never see the arena whole; they receive
+  per-layer slices from the decode scan.
 
 Findings are suppressed per-line with::
 
@@ -295,6 +304,9 @@ class _FileLinter(ast.NodeVisitor):
         #: NNS117 exempts the parallel package — the one audited home
         #: where shardings may be constructed
         self._in_parallel = "parallel" in Path(rel).parts
+        #: NNS118 exempts the block pool itself — the one audited home
+        #: for direct KV-arena indexing
+        self._in_kvpool = Path(rel).name == "kvpool.py"
 
     # -- helpers -------------------------------------------------------------
     def emit(self, code: str, node: ast.AST, message: str,
@@ -392,6 +404,10 @@ class _FileLinter(ast.NodeVisitor):
 
     def visit_Assign(self, node: ast.Assign) -> None:
         self._rule_nns116_unpack(node)
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        self._rule_nns118(node)
         self.generic_visit(node)
 
     def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
@@ -674,6 +690,28 @@ class _FileLinter(ast.NodeVisitor):
             hint="name a mesh spec (mesh=dp4 / get_mesh_plan) and use "
                  "the plan's batched()/replicated() shardings, or add a "
                  "helper in parallel/ — or justify with a pragma")
+
+    def _rule_nns118(self, node: ast.Subscript) -> None:
+        if self._in_kvpool:
+            return
+        dotted = _dotted(node.value)
+        if dotted.endswith(".at"):
+            dotted = dotted[:-len(".at")]  # x.arena.at[...] indexes x.arena
+        if not dotted:
+            return
+        last = dotted.rsplit(".", 1)[-1]
+        if not (last in ("arena", "_arena") or last.endswith("_arena")):
+            return
+        self.emit(
+            "NNS118", node,
+            f"direct subscript of KV arena {dotted!r} outside "
+            f"serving/kvpool.py — block refcounts, buffer donation, and "
+            f"the zero-block/sentinel invariants live in the pool; a raw "
+            f"arena index elsewhere can read a freed block's stale bytes "
+            f"or write through a donated buffer",
+            hint="go through BlockPool (scatter_prefill/copy_block) or "
+                 "the models/transformer.py paged builders, which take "
+                 "per-layer slices — or justify with a pragma")
 
     def _rule_nns114_deque(self, node: ast.Call, dotted: str) -> None:
         if not self._in_obs:

@@ -35,7 +35,8 @@ token (ids are never negative) instead of desynchronizing or killing
 the server. Per-client drainers retire after ``idle_timeout`` seconds
 without traffic, so a long-running server doesn't accumulate one thread
 per connection ever made (the query server mints a fresh client id per
-TCP connection).
+TCP connection); a completion that races the idle window is handed to a
+fresh drainer rather than dropped, so retirement never costs a response.
 """
 
 from __future__ import annotations
@@ -65,6 +66,8 @@ class TensorLMServe(Element):
         "max_new_tokens": 64,    # default generation budget per request
         "timeout": 600.0,        # seconds a drainer waits on one result
         "idle_timeout": 60.0,    # seconds before an idle drainer retires
+        "speculate": 0,          # draft-then-verify lookahead (engine knob)
+        "speculate_layers": 0,   # draft depth override (0 = engine default)
     }
 
     #: error response payload — exactly one buffer per request keeps the
@@ -111,6 +114,16 @@ class TensorLMServe(Element):
             raise FlowError(
                 f"{self.name}: no engine registered as {name!r} "
                 f"(serving.register_engine first)")
+        spec = int(self.get_property("speculate"))
+        if spec and spec != getattr(self._engine, "speculate", 0):
+            # opt-in draft-then-verify: the knob lives on the element so
+            # a pipeline string can turn it on, but the machinery is the
+            # engine's (models/speculative.py). set_speculate raises if
+            # the engine is already mid-decode with a different K — a
+            # config conflict that should fail start(), not be papered
+            # over.
+            layers = int(self.get_property("speculate_layers")) or None
+            self._engine.set_speculate(spec, draft_layers=layers)
 
     def _cancel_all_inflight(self):
         """Nobody will read these streams anymore — the engine must not
@@ -205,6 +218,22 @@ class TensorLMServe(Element):
         with self._push_lock:
             self.srcpad.push(out)
 
+    def _adopt_orphans_locked(self, cid: int, items) -> None:
+        """Hand completions orphaned by a retiring drainer to a fresh
+        one. Caller holds ``_state_lock`` and has already removed the
+        old fifo/drainer for ``cid``, so registering here is
+        race-free; ``_inflight`` was counted at original enqueue and
+        must NOT be bumped again. (``stop()`` clears the fifo map in
+        the same critical section that sets ``_stopped``, so reaching
+        this path implies the element is still running.)"""
+        fifo = self._fifos[cid] = _queue.Queue()
+        for item in items:
+            fifo.put(item)
+        t = threading.Thread(target=self._drain, args=(cid, fifo),
+                             name=f"{self.name}-c{cid}", daemon=True)
+        self._drainers[cid] = t
+        t.start()
+
     # -- per-client completion drainer ---------------------------------------
     def _drain(self, cid: int, fifo: _queue.Queue):
         timeout = float(self.get_property("timeout"))
@@ -213,15 +242,33 @@ class TensorLMServe(Element):
             try:
                 item = fifo.get(timeout=idle)
             except _queue.Empty:
-                # retire if still empty under the lock (chain() holds the
-                # lock while enqueueing, so no request can slip between
-                # the check and the removal)
+                # Retire — carefully. A completion can land in the fifo
+                # between the idle timeout firing and the removal below
+                # (the engine finishes a stream just as the window
+                # closes). Dropping it would desync the framed
+                # protocol's one-response-per-request contract; but a
+                # retiring drainer must not keep consuming either, or a
+                # new request for the same client would spawn a SECOND
+                # drainer and the two would interleave responses. So:
+                # unregister under the lock, then hand any orphaned
+                # items to a fresh drainer that takes over the cid.
                 with self._state_lock:
-                    if fifo.empty() and self._fifos.get(cid) is fifo:
-                        del self._fifos[cid]
-                        del self._drainers[cid]
-                        return
-                continue
+                    if self._fifos.get(cid) is not fifo:
+                        # replaced or stopped: whoever owns the cid now
+                        # (or stop()'s _EOS, already in OUR fifo) drains
+                        # the rest — keep looping until we see it
+                        continue
+                    del self._fifos[cid]
+                    del self._drainers[cid]
+                    orphans = []
+                    try:
+                        while True:
+                            orphans.append(fifo.get_nowait())
+                    except _queue.Empty:
+                        pass
+                    if orphans:
+                        self._adopt_orphans_locked(cid, orphans)
+                return
             if item is self._EOS:
                 return
             stream, buf, err, t0 = item

@@ -227,6 +227,27 @@ def _slot_write(layer_cache, upd, pos, per_stream):
         layer_cache, upd, (0, 0, pos) + (0,) * (layer_cache.ndim - 3))
 
 
+def _paged_gather(pages, bt):
+    """Per-layer block gather: ``pages [NTOT, 2, T, ...]`` + block table
+    ``bt [b, MB]`` → contiguous ``[b, 2, MB*T, ...]`` k/v in global-slot
+    order. Table entries ≥ NTOT-1 (the pool's unallocated sentinel) clamp
+    onto the pool's permanent ZERO block at index NTOT-1, so unallocated
+    slots read exact zeros — finite, and masked out anyway."""
+    ntot = pages.shape[0]
+    g = pages[jnp.minimum(bt, ntot - 1)]                 # [b,MB,2,T,...]
+    g = jnp.moveaxis(g, 2, 1)                            # [b,2,MB,T,...]
+    b, two, mb, t = g.shape[:4]
+    return g.reshape((b, two, mb * t) + g.shape[4:])
+
+
+def _paged_scatter(pages, upd, blk, off):
+    """Per-layer block scatter: ``upd [b, c, 2, ...]`` into
+    ``pages[blk, :, off]`` (``blk``/``off`` are ``[b, c]``). Out-of-range
+    block ids (the sentinel) DROP — a masked write, not a clamped one, so
+    the zero block is never corrupted."""
+    return pages.at[blk, :, off].set(upd, mode="drop")
+
+
 class _RawKVCodec:
     """Cache = one array [L, 2, b, S, h, dh] in the model dtype."""
 
@@ -249,6 +270,21 @@ class _RawKVCodec:
         """kv [L, 2, b, s, h, dh] → cache slots [0, s)."""
         return jax.lax.dynamic_update_slice(
             cache, kv.astype(self.dtype), (0, 0, 0, 0, 0, 0))
+
+    def paged_init(self, L, ntot, T, h, dh):
+        """Paged arena [L, NTOT, 2, T, h, dh] — leading L so a layer scan
+        carries one block pool slice per layer (serving/kvpool.py owns
+        allocation; index NTOT-1 is the permanent zero block)."""
+        return jnp.zeros((L, ntot, 2, T, h, dh), self.dtype)
+
+    def paged_write(self, pages, kv, blk, off):
+        """kv [2, b, c, h, dh] → pages[blk[b,c], :, off[b,c]]."""
+        upd = jnp.transpose(kv.astype(self.dtype), (1, 2, 0, 3, 4))
+        return _paged_scatter(pages, upd, blk, off)
+
+    def paged_read(self, pages, bt):
+        g = _paged_gather(pages, bt)
+        return g[:, 0], g[:, 1]
 
 
 class _Int8KVCodec:
@@ -288,6 +324,30 @@ class _Int8KVCodec:
             "scale": jax.lax.dynamic_update_slice(
                 cache["scale"], s, (0, 0, 0, 0, 0)),
         }
+
+    def paged_init(self, L, ntot, T, h, dh):
+        return {"q": jnp.zeros((L, ntot, 2, T, h, dh), jnp.int8),
+                "scale": jnp.zeros((L, ntot, 2, T, h), jnp.float32)}
+
+    def paged_write(self, pages, kv, blk, off):
+        """Codec applied per block: each written vector quantizes with the
+        same per-vector absmax math as the monolithic write, so paged int8
+        caches are bit-identical to monolithic int8 ones."""
+        q, s = self._q(kv)                 # [2,b,c,h,dh], [2,b,c,h]
+        return {
+            "q": _paged_scatter(pages["q"],
+                                jnp.transpose(q, (1, 2, 0, 3, 4)),
+                                blk, off),
+            "scale": _paged_scatter(pages["scale"],
+                                    jnp.transpose(s, (1, 2, 0, 3)),
+                                    blk, off),
+        }
+
+    def paged_read(self, pages, bt):
+        gq = _paged_gather(pages["q"], bt)
+        gs = _paged_gather(pages["scale"], bt)
+        deq = gq.astype(jnp.float32) * gs[..., None]
+        return deq[:, 0], deq[:, 1]
 
 
 def _kv_codec(cfg: TransformerConfig, kv_codec: Optional[str]):
@@ -392,6 +452,11 @@ def build_chunk_decode(cfg: TransformerConfig,
 
     ``pos0`` is clamped so the chunk's writes stay inside the cache
     (same bounded-degradation contract as build_decode_step).
+
+    Like build_decode_step, ``pos0`` may also be a ``[b]`` vector — one
+    chunk origin per batch row (the batched speculative-verify shape:
+    every stream scores its own γ+1 candidates at its own depth in ONE
+    program). The scalar path traces exactly as before.
     """
     dtype = cfg.dtype
     s_max = max_seq or cfg.max_seq
@@ -399,9 +464,19 @@ def build_chunk_decode(cfg: TransformerConfig,
 
     def chunk(params, tokens, cache, pos0):
         b, c = tokens.shape
-        pos0 = jnp.minimum(jnp.asarray(pos0, jnp.int32), s_max - c)
-        positions = pos0 + jnp.arange(c)[None, :] * jnp.ones(
-            (b, 1), jnp.int32)                                   # [b,c]
+        pos0 = jnp.asarray(pos0, jnp.int32)
+        per_stream = pos0.ndim == 1
+        pos0 = jnp.minimum(pos0, s_max - c)
+        if per_stream:
+            positions = pos0[:, None] + jnp.arange(c)[None, :]   # [b,c]
+            # query i of row r (global position pos0[r]+i) sees
+            # slots <= pos0[r]+i
+            qpos = positions[:, None, :, None]                # [b,1,c,1]
+        else:
+            positions = pos0 + jnp.arange(c)[None, :] * jnp.ones(
+                (b, 1), jnp.int32)                               # [b,c]
+            # query i (global position pos0+i) sees slots <= pos0+i
+            qpos = (pos0 + jnp.arange(c))[None, None, :, None]
         x = params["embed"].astype(dtype)[tokens]
         layer_params = {k: v for k, v in params.items()
                         if k not in ("embed", "ln_f")}
@@ -410,11 +485,10 @@ def build_chunk_decode(cfg: TransformerConfig,
             x, = carry
             lp, layer_cache = lp_and_cache
             q, k, v = _block_qkv(x, lp, positions, dtype)  # [b,c,h,dh]
-            new_cache = codec.write(layer_cache, jnp.stack([k, v]), pos0)
+            new_cache = codec.write(layer_cache, jnp.stack([k, v]), pos0,
+                                    per_stream)
             slots = jnp.arange(s_max)
-            # query i (global position pos0+i) sees slots <= pos0+i
-            mask = slots[None, None, None, :] <= (
-                pos0 + jnp.arange(c))[None, None, :, None]
+            mask = slots[None, None, None, :] <= qpos
             ck, cv = codec.read(new_cache)
             a = _attend_cache(q, ck, cv, mask, cfg.head_dim, dtype)
             x = _block_tail(x, a, lp, cfg)
@@ -422,6 +496,122 @@ def build_chunk_decode(cfg: TransformerConfig,
 
         (x,), new_cache = lax.scan(layer, (x,), (layer_params, cache))
         return _final_logits(x, params), new_cache
+
+    return chunk
+
+
+def build_paged_decode_step(cfg: TransformerConfig,
+                            block_tokens: int,
+                            max_seq: Optional[int] = None,
+                            kv_codec: Optional[str] = None) -> Callable:
+    """Single-token decode against a PAGED KV cache (serving/kvpool.py):
+    ``step(params, token[int32 b], arena, bt[int32 b,MB], pos[int32 b]) ->
+    (logits[b, vocab], new_arena)``.
+
+    The arena is the pool's ``[L, NTOT, 2, T, h, dh]`` pytree; ``bt`` maps
+    each row's logical blocks ``0..MB-1`` (MB = S/T) to physical pool
+    blocks, with unallocated entries holding the pool sentinel (≥ NTOT).
+    Each step scatters k/v into physical slot ``(bt[pos//T], pos%T)`` and
+    gathers the row's table back into the contiguous ``[b, S, ...]``
+    layout the shared attention core expects — same slot ordering, same
+    write-before-attend discipline, and masked slots contribute EXACT
+    zeros (−1e30 scores underflow softmax to 0.0), so greedy outputs are
+    bit-identical to the monolithic cache. Rows whose table is all
+    sentinel (empty batch lanes) drop their writes and read the zero
+    block — inert by construction.
+    """
+    dtype = cfg.dtype
+    s_max = max_seq or cfg.max_seq
+    T = int(block_tokens)
+    if T <= 0 or s_max % T:
+        raise ValueError(
+            f"build_paged_decode_step: max_seq ({s_max}) must be a "
+            f"positive multiple of block_tokens ({block_tokens})")
+    codec = _kv_codec(cfg, kv_codec)
+
+    def step(params, token, arena, bt, pos):
+        pos = jnp.asarray(pos, jnp.int32)
+        pos_c = jnp.minimum(pos, s_max - 1)  # cache-length contract
+        x = params["embed"].astype(dtype)[token][:, None]       # [b,1,d]
+        positions = pos[:, None]
+        blk = jnp.take_along_axis(bt, (pos_c // T)[:, None], axis=1)
+        off = (pos_c % T)[:, None]                               # [b,1]
+        layer_params = {k: v for k, v in params.items()
+                        if k not in ("embed", "ln_f")}
+
+        def layer(carry, lp_and_pages):
+            x, = carry
+            lp, pages = lp_and_pages              # one layer's blocks
+            q, k, v = _block_qkv(x, lp, positions, dtype)  # [b,1,h,dh]
+            pages = codec.paged_write(pages, jnp.stack([k, v]), blk, off)
+            slots = jnp.arange(s_max)
+            mask = slots[None, None, None, :] <= pos_c[:, None, None,
+                                                       None]
+            ck, cv = codec.paged_read(pages, bt)
+            a = _attend_cache(q, ck, cv, mask, cfg.head_dim, dtype)
+            x = _block_tail(x, a, lp, cfg)
+            return (x,), pages
+
+        (x,), new_arena = lax.scan(layer, (x,), (layer_params, arena))
+        return _final_logits(x, params)[:, 0], new_arena
+
+    return step
+
+
+def build_paged_chunk(cfg: TransformerConfig,
+                      block_tokens: int,
+                      max_seq: Optional[int] = None,
+                      kv_codec: Optional[str] = None) -> Callable:
+    """Chunk decode against a paged KV cache — build_chunk_decode's paged
+    twin: ``chunk(params, tokens[int32 b,c], arena, bt[int32 b,MB],
+    pos0[int32 b], limit[int32 b]) -> (logits[b,c,vocab], new_arena)``.
+
+    Row r's token i sits at global position ``pos0[r]+i``, writes physical
+    slot ``(bt[r, p//T], p%T)`` and attends under a ``slot <= p`` mask.
+    ``limit[r]`` is the row's REAL chunk length: positions ≥ limit (bucket
+    padding) redirect their writes to the sentinel and drop, so a padded
+    warm prefix extension never smears pad k/v into pool blocks another
+    stream could inherit. Used for prefix-cache extension and speculative
+    verification on the paged path.
+    """
+    dtype = cfg.dtype
+    s_max = max_seq or cfg.max_seq
+    T = int(block_tokens)
+    if T <= 0 or s_max % T:
+        raise ValueError(
+            f"build_paged_chunk: max_seq ({s_max}) must be a positive "
+            f"multiple of block_tokens ({block_tokens})")
+    codec = _kv_codec(cfg, kv_codec)
+
+    def chunk(params, tokens, arena, bt, pos0, limit):
+        b, c = tokens.shape
+        pos0 = jnp.minimum(jnp.asarray(pos0, jnp.int32), s_max - c)
+        positions = pos0[:, None] + jnp.arange(c)[None, :]       # [b,c]
+        valid = jnp.arange(c)[None, :] < jnp.asarray(
+            limit, jnp.int32)[:, None]
+        ntot = jax.tree_util.tree_leaves(arena)[0].shape[1]
+        blk = jnp.take_along_axis(bt, positions // T, axis=1)    # [b,c]
+        blk = jnp.where(valid, blk, jnp.int32(ntot))   # pad writes drop
+        off = positions % T
+        x = params["embed"].astype(dtype)[tokens]
+        layer_params = {k: v for k, v in params.items()
+                        if k not in ("embed", "ln_f")}
+
+        def layer(carry, lp_and_pages):
+            x, = carry
+            lp, pages = lp_and_pages
+            q, k, v = _block_qkv(x, lp, positions, dtype)  # [b,c,h,dh]
+            pages = codec.paged_write(pages, jnp.stack([k, v]), blk, off)
+            slots = jnp.arange(s_max)
+            mask = slots[None, None, None, :] <= positions[:, None, :,
+                                                           None]
+            ck, cv = codec.paged_read(pages, bt)
+            a = _attend_cache(q, ck, cv, mask, cfg.head_dim, dtype)
+            x = _block_tail(x, a, lp, cfg)
+            return (x,), pages
+
+        (x,), new_arena = lax.scan(layer, (x,), (layer_params, arena))
+        return _final_logits(x, params), new_arena
 
     return chunk
 

@@ -37,6 +37,7 @@ import itertools
 import queue as _queue
 import threading
 import time as _time
+import weakref
 from typing import Any, Callable, Dict, Iterator, List, Optional
 
 import numpy as np
@@ -250,6 +251,25 @@ class ContinuousBatchingEngine:
         chunked ingestion keep the masked cache form (`_attend_cache`):
         their attention is over dynamically-positioned cache slots,
         which the causal-only kernel does not express.
+    block_tokens: > 0 enables the PAGED KV cache (serving/kvpool.py):
+        the cache becomes fixed-size blocks over one preallocated
+        arena, per-stream block tables, admission bounded by FREE
+        BLOCKS instead of batch slots — hundreds of streams time-share
+        the B decode lanes under per-token EDF deadlines, and a shared
+        prompt prefix costs its blocks once (copy-on-write block
+        tables). 0 (default) or ``NNSTPU_PAGED_KV=0`` keeps the
+        monolithic cache byte-identical to the unpaged engine.
+    kv_blocks: arena size in blocks (paged mode). Defaults to
+        ``max_streams * max_seq / block_tokens`` — the same HBM bytes
+        the monolithic cache would take.
+    speculate: > 0 enables speculative decoding — a ``speculate_layers``
+        -layer draft sliced from the target params
+        (models/speculative.py) proposes K tokens per round inside the
+        batched decode; the target verifies them in ONE chunk pass.
+        Greedy only (temperature must be 0), single-chip only, and
+        concurrency is capped at ``max_streams`` (the draft cache is
+        slot-structured). Output is byte-identical to non-speculative
+        greedy decoding by construction.
     """
 
     def __init__(self, cfg, params, max_streams: int = 4,
@@ -263,7 +283,11 @@ class ContinuousBatchingEngine:
                  kv_quant: Optional[str] = None,
                  prefix_cache: int = 0,
                  attention: str = "auto",
-                 slo_budget_ms: float = 0.0):
+                 slo_budget_ms: float = 0.0,
+                 block_tokens: int = 0,
+                 kv_blocks: Optional[int] = None,
+                 speculate: int = 0,
+                 speculate_layers: Optional[int] = None):
         import jax
         import jax.numpy as jnp
 
@@ -319,6 +343,36 @@ class ContinuousBatchingEngine:
         #: in-progress chunked admission: (request, slot, cache1, k) with
         #: k = next chunk index; one at a time, advanced between dispatches
         self._partial = None
+
+        from nnstreamer_tpu.serving import kvpool as _kvpool
+
+        self.block_tokens = int(block_tokens or 0)
+        #: paged KV cache on: block_tokens > 0 AND the env kill switch
+        #: (NNSTPU_PAGED_KV) allows it. Off → every code path below is
+        #: the unchanged monolithic engine.
+        self.paged = self.block_tokens > 0 and _kvpool.paged_enabled()
+        self._pool = None
+        if self.paged:
+            if self.S % self.block_tokens:
+                raise ValueError(
+                    f"serving: block_tokens ({self.block_tokens}) must "
+                    f"divide max_seq ({self.S})")
+            from nnstreamer_tpu.models.transformer import (
+                build_paged_chunk,
+                build_paged_decode_step,
+            )
+
+            #: block-table width: blocks per stream at full context
+            self.MB = self.S // self.block_tokens
+            self._paged_decode = build_paged_decode_step(
+                cfg, self.block_tokens, self.S, kv_codec=kv_quant)
+            self._paged_chunk_fn = build_paged_chunk(
+                cfg, self.block_tokens, self.S, kv_codec=kv_quant)
+            nb = int(kv_blocks) if kv_blocks else self.B * self.MB
+            if mesh is not None and "dp" in mesh.axis_names:
+                # arena block axis shards over dp: pad so NTOT divides
+                nb += (-(nb + 1)) % mesh.shape["dp"]
+            self._num_blocks = nb
 
         # host-side per-slot state
         self._pos = np.zeros(self.B, np.int32)
@@ -384,7 +438,10 @@ class ContinuousBatchingEngine:
         else:
             self._init_cache = lambda: init_cache(cfg, self.B, self.S,
                                                   kv_codec=kv_quant)
-        self._cache = self._init_cache()
+        # paged mode never materializes the monolithic [L,2,B,S,...]
+        # cache — the arena (created below, after obs_name) is the only
+        # KV storage
+        self._cache = None if self.paged else self._init_cache()
         self._pending: "_queue.Queue[_PendingRequest]" = _queue.Queue()
         self._next_id = 0
         self._lock = threading.Lock()
@@ -395,6 +452,8 @@ class ContinuousBatchingEngine:
             "tokens_generated": 0, "dispatches": 0, "prefills": 0,
             "prefill_chunks": 0, "slot_steps": 0, "active_slot_steps": 0,
             "prefix_hits": 0, "prefix_tokens_reused": 0,
+            "concurrent_streams_max": 0, "kv_sheds": 0, "kv_defers": 0,
+            "spec_drafted": 0, "spec_accepted": 0,
         }
         from nnstreamer_tpu.obs import (
             get_registry,
@@ -417,6 +476,29 @@ class ContinuousBatchingEngine:
 
             self._slo = SloScheduler(budget_ms=float(slo_budget_ms),
                                      name=self.obs_name)
+        from nnstreamer_tpu.obs.flight import LMTokenStats
+
+        #: per-token latency quantiles (TTFT vs inter-token split) —
+        #: nns_lm_ttft_p50/p99_ms, nns_lm_token_p50/p99_ms
+        self._lm_stats = LMTokenStats(self.obs_name)
+        self._mesh = mesh
+        if self.paged:
+            self._pool = _kvpool.BlockPool(
+                cfg, self._num_blocks, self.block_tokens,
+                kv_codec=kv_quant, mesh=mesh, owner=self.obs_name)
+            #: sid → per-stream decode state (stream, blocks, pos, last,
+            #: key, budget, deadline_t, slot); engine thread only. Every
+            #: ADMITTED stream lives here whether or not it currently
+            #: holds one of the B decode lanes.
+            self._sstate: Dict[int, dict] = {}
+            #: admission head deferred on block exhaustion (FIFO order
+            #: is preserved: nothing behind it admits until it fits)
+            self._held: Optional[_PendingRequest] = None
+            #: decode lane → sid occupying it (None = free lane)
+            self._lane: List[Optional[int]] = [None] * self.B
+            #: host mirror of the device block tables, one row per lane
+            self._bt = np.full((self.B, self.MB), self._pool.SENTINEL,
+                               np.int32)
         self.prefix_cache = int(prefix_cache)
         if self.prefix_cache < 0:
             raise ValueError(
@@ -469,7 +551,36 @@ class ContinuousBatchingEngine:
             return jax.jit(dispatch, donate_argnums=(2,))
 
         self._build_dispatch = build_dispatch
-        self._dispatch = build_dispatch(self.K)
+        if self.paged:
+            paged_decode = self._paged_decode
+
+            def build_paged_dispatch(K):
+                def dispatch(params, token, arena, bt, pos, keys):
+                    """Paged twin of the mono dispatch: same K-step scan,
+                    cache replaced by (arena, block tables). bt is LOOP-
+                    INVARIANT across the K steps — the loop tops up every
+                    bound stream's blocks through pos+K-1 first."""
+
+                    def body(carry, _):
+                        token, arena, pos, keys = carry
+                        logits, arena = paged_decode(params, token, arena,
+                                                     bt, pos)
+                        nxt, keys, lp = sample(logits, keys)
+                        return (nxt, arena, pos + 1, keys), (nxt, lp)
+
+                    (token, arena, pos, keys), (toks, lps) = jax.lax.scan(
+                        body, (token, arena, pos, keys), None, length=K)
+                    return (jnp.transpose(toks), jnp.transpose(lps),
+                            arena, keys, token, pos)
+
+                return jax.jit(dispatch, donate_argnums=(2,))
+
+            self._build_dispatch = build_paged_dispatch
+            self._dispatch = build_paged_dispatch(self.K)
+            self._paged_chunk_jitted = jax.jit(self._paged_chunk_fn,
+                                               donate_argnums=(2,))
+        else:
+            self._dispatch = build_dispatch(self.K)
         self._sample_first = jax.jit(sample)
 
         def insert(cache, cache1, slot):
@@ -489,6 +600,23 @@ class ContinuousBatchingEngine:
         self._chunk_jitted = jax.jit(self._chunk_fn, donate_argnums=(2,))
         self._jnp = jnp
         self._jax = jax
+
+        #: monolithic prefix-cache HBM accounting (tensors/memory.py
+        #: "kvcache" category): tuple key → (acct_key, nbytes). Paged
+        #: entries skip this — their blocks are arena bytes the pool
+        #: already registered.
+        self._prefix_acct: Dict[tuple, tuple] = {}
+        self._prefix_seq = itertools.count()
+        #: prefix keys the accountant dropped under pressure (on_drop
+        #: fires on an arbitrary thread; the engine thread reaps)
+        self._condemned: set = set()
+        self._condemned_lock = threading.Lock()
+
+        self.speculate = 0
+        self._speculate_layers: Optional[int] = None
+        self._spec: Optional[dict] = None
+        if int(speculate or 0) > 0:
+            self.set_speculate(int(speculate), speculate_layers)
 
     def _calibrate_k(self) -> None:
         """steps_per_dispatch="auto": pick K from MEASURED costs.
@@ -520,15 +648,30 @@ class ContinuousBatchingEngine:
         token = jnp.zeros((self.B,), jnp.int32)
         pos = jnp.zeros((self.B,), jnp.int32)
         keys = jnp.zeros((self.B, 2), jnp.uint32)
-        # dispatch DONATES the cache: reassign self._cache immediately
-        # after each call so a failure mid-calibration never leaves it
+        # dispatch DONATES the cache/arena: reassign immediately after
+        # each call so a failure mid-calibration never leaves it
         # pointing at deleted buffers (start() also reinits on error)
-        out = self._dispatch(self.params, token, self._cache, pos, keys)
-        self._cache = out[2]
+        if self.paged:
+            # all-sentinel block tables: writes drop, reads hit the zero
+            # block — a pure timing run that cannot corrupt the arena
+            bt = jnp.full((self.B, self.MB), self._pool.SENTINEL,
+                          jnp.int32)
+
+            def run():
+                out = self._dispatch(self.params, token,
+                                     self._pool.arena, bt, pos, keys)
+                self._pool.arena = out[2]
+                return out
+        else:
+            def run():
+                out = self._dispatch(self.params, token, self._cache,
+                                     pos, keys)
+                self._cache = out[2]
+                return out
+        out = run()
         _np.asarray(out[0])  # compile + warm
         t0 = _time.monotonic()
-        out = self._dispatch(self.params, token, self._cache, pos, keys)
-        self._cache = out[2]
+        out = run()
         _np.asarray(out[0])
         block = _time.monotonic() - t0
         step = max((block - rtt) / self.K, 1e-5)
@@ -565,8 +708,11 @@ class ContinuousBatchingEngine:
                 # live cache's buffers or left error arrays in it;
                 # release the old reference BEFORE reallocating so the
                 # two caches never coexist (HBM headroom)
-                self._cache = None
-                self._cache = self._init_cache()
+                if self.paged:
+                    self._pool.reset()
+                else:
+                    self._cache = None
+                    self._cache = self._init_cache()
         self._stop_evt.clear()
         self._thread = threading.Thread(target=self._loop,
                                         name="cb-engine", daemon=True)
@@ -599,6 +745,12 @@ class ContinuousBatchingEngine:
                 elif st is not None and not st.finished:
                     st._finish("engine-stopped")
                     self._slots[i] = None
+            if self.paged:
+                for state in list(self._sstate.values()):
+                    self._finish_paged(state, "engine-stopped")
+                if self._held is not None:
+                    self._held.stream._finish("engine-stopped")
+                    self._held = None
             while True:
                 try:
                     req = self._pending.get_nowait()
@@ -620,6 +772,10 @@ class ContinuousBatchingEngine:
         # fit the cache — equal to the plain n < S bound when C divides S
         limit = self.S - 1 if self.prefill_chunk is None else min(
             self.S - 1, (self.S // self.prefill_chunk) * self.prefill_chunk)
+        if self.speculate:
+            # a verify chunk writes kv at positions [pos, pos+K]; the
+            # per-stream budget keeps pos <= S-1-K only if admission does
+            limit = min(limit, self.S - 1 - self.speculate)
         if prompt.size > limit:
             raise ValueError(
                 f"serving: prompt length {prompt.size} must be <= {limit} "
@@ -639,8 +795,11 @@ class ContinuousBatchingEngine:
                 # (raises SloRejected before any slot/queue capacity is
                 # consumed — overload is turned away at the door, not
                 # discovered as a latency outlier)
-                backlog = self._pending.qsize() + sum(
-                    1 for s in self._slots if s is not None)
+                backlog = self._pending.qsize() + (
+                    len(self._sstate) + (1 if self._held is not None
+                                         else 0)
+                    if self.paged else
+                    sum(1 for s in self._slots if s is not None))
                 self._slo.admit_request(_time.monotonic(), backlog)
             sid = self._next_id
             self._next_id += 1
@@ -658,6 +817,8 @@ class ContinuousBatchingEngine:
 
     @property
     def active_streams(self) -> int:
+        if self.paged:
+            return len(self._sstate)
         return sum(1 for s in self._slots
                    if s is not None and s is not self._RESERVED)
 
@@ -701,11 +862,67 @@ class ContinuousBatchingEngine:
         kv = self._jax.tree.map(lambda a: a[:, :, :, :n], cache1)
         if key not in self._prefix:
             self._prefix_trie.insert(key)
+        else:
+            self._prefix_unaccount(key)  # re-stored: bytes change
         self._prefix[key] = (kv, logits)
         self._prefix.move_to_end(key)
+        self._prefix_account(key, kv)
         while len(self._prefix) > self.prefix_cache:
             evicted, _ = self._prefix.popitem(last=False)
             self._prefix_trie.remove(evicted)
+            self._prefix_unaccount(evicted)
+
+    # -- prefix-cache HBM accounting (tensors/memory.py, "kvcache") ----------
+    def _prefix_account(self, key: tuple, kv) -> None:
+        """Register one monolithic prefix entry's device bytes with the
+        HBM accountant as a DROPPABLE unit: under pressure the
+        accountant revokes it (on_drop condemns the key; the engine
+        thread reaps), so cached prefixes ride the evict rung of the
+        pressure ladder instead of being invisible HBM."""
+        from nnstreamer_tpu.tensors import memory as _memory
+
+        acct = _memory.ACTIVE
+        if acct is None:
+            return
+        nbytes = _memory.pytree_nbytes(kv)
+        acct_key = f"{self.obs_name}:prefix:{next(self._prefix_seq)}"
+        ref = weakref.ref(self)
+
+        def on_drop(_k, key=key):
+            eng = ref()
+            if eng is not None:
+                with eng._condemned_lock:
+                    eng._condemned.add(key)
+
+        acct.residency.register_droppable(
+            acct_key, nbytes, on_drop, label=f"{self.obs_name}:prefix")
+        self._prefix_acct[key] = (acct_key, nbytes)
+
+    def _prefix_unaccount(self, key: tuple) -> None:
+        rec = self._prefix_acct.pop(key, None)
+        if rec is None:
+            return
+        from nnstreamer_tpu.tensors import memory as _memory
+
+        acct = _memory.ACTIVE
+        if acct is not None:
+            acct.residency.unregister(rec[0])
+
+    def _reap_condemned(self) -> None:
+        """Engine-thread half of droppable prefix eviction: drop the
+        entries whose accounting units the pressure ladder revoked.
+        (Their bytes are already un-registered — only the engine's
+        references remain to release.)"""
+        if not self._condemned:
+            return
+        with self._condemned_lock:
+            keys = list(self._condemned)
+            self._condemned.clear()
+        for key in keys:
+            self._prefix_acct.pop(key, None)
+            if key in self._prefix:
+                del self._prefix[key]
+                self._prefix_trie.remove(key)
 
     def _place_prefix_kv(self, cache1, kv):
         """Write a cached kv slice into slots [0, n) of a fresh cache."""
@@ -821,13 +1038,23 @@ class ContinuousBatchingEngine:
             # final chunk: logits at the prompt's true last position
             self._partial = None
             logits_last = logits[:, (n - 1) - start]
+            if self.paged:
+                rec = self._activate_paged_from_cache1(req, logits_last,
+                                                       cache1)
+                if rec is None:  # pool exhausted: re-ingest when it isn't
+                    self.stats["kv_defers"] += 1
+                    self._held = req
+                else:
+                    self._activate_commit_paged(rec)
+                return
             self._prefix_store(prompt, cache1, logits_last)
             self._activate(req, slot, logits_last, cache1)
         except Exception as e:  # noqa: BLE001 — a failed chunk must free
             # the reserved slot and fail only this request
             log.warning("serving: chunked prefill failed: %s", e)
             self._partial = None
-            self._slots[slot] = None
+            if slot is not None:
+                self._slots[slot] = None
             req.stream._finish(f"error: {e}")
 
     def _activate_begin(self, req: _PendingRequest, slot: int, logits,
@@ -846,6 +1073,11 @@ class ContinuousBatchingEngine:
                                                   jnp.asarray(key))
         # dtype alignment happens inside the tree-aware _insert
         self._cache = self._insert(self._cache, cache1, slot)
+        if self._spec is not None:
+            # the shallow draft re-reads the whole prompt (cheap: half
+            # the layers, one bucketed prefill) so its cache is
+            # canonical from position 0
+            self._draft_prefill(req, slot)
         self._slots[slot] = req.stream  # claimed; mirrors land at commit
         return (req, slot, first_d, key_d, lp_d)
 
@@ -867,7 +1099,12 @@ class ContinuousBatchingEngine:
         self._last[slot] = first
         self._keys[slot] = np.asarray(key_d)[0]
         # cap generation so cache writes stay inside the slot's S window
-        self._budget[slot] = min(req.max_new, self.S - n)
+        # (a speculative verify chunk writes through pos+K, hence the
+        # extra margin; zero when speculation is off)
+        self._budget[slot] = min(req.max_new, self.S - n - self.speculate)
+        t0 = getattr(req.stream, "submit_t", None)
+        if t0 is not None:
+            self._lm_stats.observe_ttft(_time.monotonic() - t0)
         req.stream._emit(first, first_lp)
         self.stats["tokens_generated"] += 1
         self._post_emit(slot, first)
@@ -911,12 +1148,15 @@ class ContinuousBatchingEngine:
         or belong to a stream that already finished)."""
         toks = np.asarray(toks_dev)  # the D2H sync; timed below
         lps = np.asarray(lps_dev)
-        self.invoke_stats.record(_time.monotonic() - t0)
+        dt = _time.monotonic() - t0
+        self.invoke_stats.record(dt)
         self.stats["dispatches"] += 1
         self.stats["slot_steps"] += self.B * self.K
+        per_tok = dt / self.K
         for slot, st in snapshot:
             if self._slots[slot] is not st:
                 continue  # freed/replaced while the block was in flight
+            self._lm_stats.observe_token(per_tok)
             self._pos[slot] += self.K
             self._last[slot] = toks[slot, -1]
             for j in range(self.K):
@@ -965,11 +1205,759 @@ class ContinuousBatchingEngine:
             elif st is not None:
                 st._finish(f"error: {e}")
                 self._slots[slot] = None
-        self._cache = self._init_cache()
+        if self.paged:
+            for state in list(self._sstate.values()):
+                state["stream"]._finish(f"error: {e}")
+            self._sstate.clear()
+            if self._held is not None:
+                self._held.stream._finish(f"error: {e}")
+                self._held = None
+            self._lane = [None] * self.B
+            # the arena may hold donated-away/error buffers; a fresh one
+            # is the same bytes, so accounting is unchanged. Paged prefix
+            # entries hold block ids into the dead allocation map — drop
+            # them with it.
+            self._pool.reset()
+            self._bt[:] = self._pool.SENTINEL
+            self._prefix.clear()
+            self._prefix_trie = _PrefixTrie()
+        else:
+            self._cache = self._init_cache()
+        if self._spec is not None:
+            self._spec["dcache"] = None
+            self._spec["dcache"] = self._spec["init_dcache"]()
+
+    # -- speculative decoding (speculate=K) -----------------------------------
+    def set_speculate(self, k: int,
+                      draft_layers: Optional[int] = None) -> None:
+        """Reconfigure speculative decoding (the ``speculate=K`` knob on
+        tensor_lm_serve). No-op when unchanged; requires a stopped
+        engine loop — the draft cache and jitted round program are
+        rebuilt. ``k=0`` disables."""
+        k = int(k or 0)
+        if k == self.speculate and (
+                k == 0 or draft_layers == self._speculate_layers):
+            return
+        if self._thread is not None and self._thread.is_alive():
+            raise RuntimeError(
+                "serving: set_speculate requires a stopped engine loop")
+        if k < 0:
+            raise ValueError(f"serving: speculate must be >= 0, got {k}")
+        if k >= self.S:
+            raise ValueError(
+                f"serving: speculate ({k}) must be < max_seq ({self.S})")
+        self.speculate = k
+        self._speculate_layers = draft_layers
+        self._spec = None
+        if k:
+            self._build_speculative()
+
+    def _build_speculative(self) -> None:
+        """One jitted program per round: γ greedy draft steps (a
+        ``draft_layers``-deep prefix slice of the target,
+        models/speculative.py), then the target VERIFIES all γ+1
+        positions in a single chunk pass — per-row argmax match gives
+        n_emit ∈ [1, γ+1] tokens whose values are exactly what
+        non-speculative greedy decoding would emit (the target argmax
+        is ground truth; drafts only decide how many positions one
+        round advances). A rejected draft costs nothing to undo: the
+        host simply advances pos by n_emit, and the stale cache slots
+        above it are overwritten before they are ever attended (the
+        next round's chunk covers them). In paged mode the roll-back
+        is the block-table tail pointer — no block copies."""
+        if self.temperature > 0:
+            raise ValueError(
+                "serving: speculate requires greedy decoding "
+                "(temperature=0) — draft/verify parity is exact only "
+                "for argmax")
+        if self._mesh is not None:
+            raise ValueError(
+                "serving: speculate does not compose with mesh= (the "
+                "draft cache is slot-structured, not sharded)")
+        jax, jnp = self._jax, self._jnp
+        from nnstreamer_tpu.models.speculative import draft_from_target
+        from nnstreamer_tpu.models.transformer import (
+            build_decode_step,
+            build_prefill,
+            init_cache,
+        )
+
+        cfg = self.cfg
+        nl = self._speculate_layers or max(1, cfg.n_layers // 2)
+        dcfg, dparams = draft_from_target(cfg, self.params, nl)
+        draft_decode = build_decode_step(dcfg, self.S)
+        g = self.speculate
+
+        def init_dcache():
+            return init_cache(dcfg, self.B, self.S)
+
+        def draft_and_verify(params, dparams, token, dcache, pos,
+                             verify):
+            """Shared skeleton; ``verify(chunk_toks)`` runs the target
+            chunk and returns [b, γ+1, V] logits."""
+
+            def dbody(carry, _):
+                tok, dc, p = carry
+                lg, dc = draft_decode(dparams, tok, dc, p)
+                nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                return (nxt, dc, p + 1), nxt
+
+            (_tok, dcache, _p), drafts = jax.lax.scan(
+                dbody, (token, dcache, pos), None, length=g)
+            drafts = jnp.transpose(drafts)                 # [b, γ]
+            chunk_toks = jnp.concatenate([token[:, None], drafts],
+                                         axis=1)           # [b, γ+1]
+            logits = verify(chunk_toks)
+            tgt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            lps = jnp.take_along_axis(
+                jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1),
+                tgt[..., None], axis=-1)[..., 0]
+            match = (tgt[:, :g] == drafts).astype(jnp.int32)
+            n_emit = jnp.sum(jnp.cumprod(match, axis=1), axis=1) + 1
+            # draft-cache catch-up: (re)write the kv of the LAST emitted
+            # token at its position. For m <= γ it is an idempotent
+            # rewrite; for a full accept (m = γ+1) it fills the one
+            # position the draft scan never wrote, keeping the draft
+            # cache canonical (this affects acceptance rate only —
+            # correctness is the target's verify either way)
+            fix = jnp.where(
+                n_emit == 1, token,
+                jnp.take_along_axis(
+                    tgt, jnp.maximum(n_emit - 2, 0)[:, None], 1)[:, 0])
+            _lg, dcache = draft_decode(dparams, fix, dcache,
+                                       pos + n_emit - 1)
+            return tgt, lps, n_emit, dcache
+
+        if self.paged:
+            pchunk = self._paged_chunk_fn
+
+            def spec_round(params, dparams, token, arena, bt, dcache,
+                           pos):
+                out_box = []  # closure cell for the updated arena tree
+
+                def verify(chunk_toks):
+                    b = chunk_toks.shape[0]
+                    logits, new_arena = pchunk(
+                        params, chunk_toks, arena, bt, pos,
+                        jnp.full((b,), g + 1, jnp.int32))
+                    out_box.append(new_arena)
+                    return logits
+
+                tgt, lps, n_emit, dcache = draft_and_verify(
+                    params, dparams, token, dcache, pos, verify)
+                return tgt, lps, n_emit, out_box[0], dcache
+
+            dispatch = jax.jit(spec_round, donate_argnums=(3, 5))
+        else:
+            chunk = self._chunk_fn
+
+            def spec_round(params, dparams, token, cache, dcache, pos):
+                out_cache = []
+
+                def verify(chunk_toks):
+                    logits, new_cache = chunk(params, chunk_toks, cache,
+                                              pos)
+                    out_cache.append(new_cache)
+                    return logits
+
+                tgt, lps, n_emit, dcache = draft_and_verify(
+                    params, dparams, token, dcache, pos, verify)
+                return tgt, lps, n_emit, out_cache[0], dcache
+
+            dispatch = jax.jit(spec_round, donate_argnums=(3, 4))
+        self._spec = {
+            "dparams": dparams, "dcfg": dcfg,
+            "dcache": init_dcache(), "init_dcache": init_dcache,
+            "prefill": self._jax.jit(build_prefill(dcfg, self.S)),
+            "dispatch": dispatch,
+        }
+
+    def _draft_prefill(self, req: _PendingRequest, slot: int) -> None:
+        jnp = self._jnp
+        sp = self._spec
+        n = req.prompt.size
+        bucket = self._bucket(n)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = req.prompt
+        _lg, dcache1 = sp["prefill"](sp["dparams"], jnp.asarray(padded),
+                                     lengths=jnp.asarray([n], jnp.int32))
+        sp["dcache"] = self._insert(sp["dcache"], dcache1, slot)
+
+    def _spec_step_mono(self) -> None:
+        jnp = self._jnp
+        sp = self._spec
+        g = self.speculate
+        snapshot = [(slot, st) for slot, st in enumerate(self._slots)
+                    if st is not None and st is not self._RESERVED]
+        if not snapshot:
+            return
+        t0 = _time.monotonic()
+        tgt, lps, n_emit, cache, dcache = sp["dispatch"](
+            self.params, sp["dparams"], jnp.asarray(self._last),
+            self._cache, sp["dcache"], jnp.asarray(self._pos))
+        self._cache = cache
+        sp["dcache"] = dcache
+        tgt = np.asarray(tgt)
+        lps = np.asarray(lps)
+        n_emit = np.asarray(n_emit)
+        dt = _time.monotonic() - t0
+        self.invoke_stats.record(dt)
+        self.stats["dispatches"] += 1
+        self.stats["slot_steps"] += self.B * (g + 1)
+        for slot, st in snapshot:
+            if self._slots[slot] is not st:
+                continue
+            m = int(n_emit[slot])
+            self.stats["spec_drafted"] += g
+            self.stats["spec_accepted"] += m - 1
+            self._pos[slot] += m
+            self._last[slot] = int(tgt[slot, m - 1])
+            self._lm_stats.observe_token(dt / max(1, m))
+            for j in range(m):
+                tok = int(tgt[slot, j])
+                self.stats["tokens_generated"] += 1
+                self.stats["active_slot_steps"] += 1
+                st._emit(tok, float(lps[slot, j]))
+                self._post_emit(slot, tok)
+                if self._slots[slot] is None:
+                    break
+
+    def _spec_step_paged(self) -> None:
+        jnp = self._jnp
+        sp = self._spec
+        g = self.speculate
+        run = []
+        for st in list(self._sstate.values()):
+            if self._sstate.get(st["sid"]) is not st:
+                continue
+            if not self._topup(st):
+                continue
+            slot = st["slot"]
+            self._bt[slot, :] = self._pool.SENTINEL
+            self._bt[slot, :len(st["blocks"])] = st["blocks"]
+            run.append(st)
+        if not run:
+            return
+        last = np.zeros(self.B, np.int32)
+        pos = np.zeros(self.B, np.int32)
+        for st in run:
+            last[st["slot"]] = st["last"]
+            pos[st["slot"]] = st["pos"]
+        t0 = _time.monotonic()
+        tgt, lps, n_emit, arena, dcache = sp["dispatch"](
+            self.params, sp["dparams"], jnp.asarray(last),
+            self._pool.arena, jnp.asarray(self._bt), sp["dcache"],
+            jnp.asarray(pos))
+        self._pool.arena = arena
+        sp["dcache"] = dcache
+        tgt = np.asarray(tgt)
+        lps = np.asarray(lps)
+        n_emit = np.asarray(n_emit)
+        dt = _time.monotonic() - t0
+        self.invoke_stats.record(dt)
+        self.stats["dispatches"] += 1
+        self.stats["slot_steps"] += self.B * (g + 1)
+        for st in run:
+            if self._sstate.get(st["sid"]) is not st:
+                continue
+            slot = st["slot"]
+            m = int(n_emit[slot])
+            self.stats["spec_drafted"] += g
+            self.stats["spec_accepted"] += m - 1
+            # rejected drafts roll the block-table tail pointer back by
+            # construction: pos advances only m, and the stale kv above
+            # it is overwritten before it is ever attended
+            st["pos"] += m
+            st["last"] = int(tgt[slot, m - 1])
+            self._lm_stats.observe_token(dt / max(1, m))
+            for j in range(m):
+                tok = int(tgt[slot, j])
+                self.stats["tokens_generated"] += 1
+                self.stats["active_slot_steps"] += 1
+                st["stream"]._emit(tok, float(lps[slot, j]))
+                self._post_emit_paged(st, tok)
+                if self._sstate.get(st["sid"]) is not st:
+                    break
+
+    # -- paged mode (block_tokens > 0) ----------------------------------------
+    def _blocks_for(self, n: int) -> int:
+        """Blocks a fresh n-token-prompt stream needs up front: the
+        prompt's positions plus the first decode write (always
+        n//T + 1 — the tail block doubles as the decode block unless
+        the prompt ends exactly on a boundary)."""
+        return n // self.block_tokens + 1
+
+    def _alloc_blocks(self, k: int):
+        """Pool alloc with the evict rung of the pressure ladder: LRU
+        paged prefix entries are dropped until the allocation fits (or
+        nothing is left to drop — the caller then defers or sheds)."""
+        ids = self._pool.alloc(k)
+        while ids is None and self._evict_prefix_paged():
+            ids = self._pool.alloc(k)
+        return ids
+
+    def _evict_prefix_paged(self) -> bool:
+        if not self._prefix:
+            return False
+        from nnstreamer_tpu.tensors import memory as _memory
+
+        evicted, (ids, _logits) = self._prefix.popitem(last=False)
+        self._prefix_trie.remove(evicted)
+        self._pool.release(list(ids))
+        acct = _memory.ACTIVE
+        if acct is not None:
+            acct.count_pressure("evict")
+        return True
+
+    def _prefix_lookup_paged(self, prompt: np.ndarray):
+        """→ (lcp, entry key, logits). Longest common prefix between
+        ``prompt`` and a cached entry; logits only on an exact
+        whole-prompt == whole-key hit. Reuse happens at BLOCK
+        granularity (the caller rounds down)."""
+        if not self.prefix_cache:
+            return 0, None, None
+        best_key, lcp = self._prefix_trie.lookup(prompt)
+        if best_key is None or lcp <= 0:
+            return 0, None, None
+        self._prefix.move_to_end(best_key)
+        _ids, logits = self._prefix[best_key]
+        if not (lcp == prompt.size == len(best_key)):
+            logits = None
+        return lcp, best_key, logits
+
+    def _prefix_store_paged(self, prompt: np.ndarray, blocks,
+                            logits) -> None:
+        """Retain the stream's prompt-covering blocks as a cache entry:
+        sharing is a refcount bump, so a prefix costs its blocks ONCE
+        and reuse is exact by construction (same physical kv). The tail
+        block may be partial; every reader takes a COW copy of it, and
+        the donor stream's later appends land at offsets >= n % T —
+        outside the entry's [0, n) range."""
+        if not self.prefix_cache:
+            return
+        key = tuple(int(t) for t in prompt)
+        if key in self._prefix:
+            return
+        n = prompt.size
+        T = self.block_tokens
+        ids = tuple(blocks[:(n + T - 1) // T])
+        self._pool.retain(ids)
+        self._prefix_trie.insert(key)
+        self._prefix[key] = (ids, logits)
+        self._prefix.move_to_end(key)
+        while len(self._prefix) > self.prefix_cache:
+            evicted, (eids, _lg) = self._prefix.popitem(last=False)
+            self._prefix_trie.remove(evicted)
+            self._pool.release(list(eids))
+
+    def _admit_paged(self, req: _PendingRequest):
+        """Paged admission: allocate the stream's block table, prefill
+        cold / block-aligned warm / exact-hit, and return the
+        activation record — or None to DEFER when the pool cannot
+        cover the prompt (admission is bounded by FREE BLOCKS, not
+        batch slots; the caller holds the request so FIFO order keeps).
+        Deferral is cheap: every path allocates before device work."""
+        self._m_queue_wait.observe(_time.monotonic() - req.submit_t)
+        jnp = self._jnp
+        prompt = req.prompt
+        n = prompt.size
+        T = self.block_tokens
+        p, key_hit, cached_logits = self._prefix_lookup_paged(prompt)
+        if cached_logits is not None:  # exact whole-prompt hit
+            eids, _lg = self._prefix[key_hit]
+            fresh = self._alloc_blocks(1)
+            if fresh is None:
+                return None
+            full = n // T
+            shared = list(eids[:full])
+            self._pool.retain(shared)
+            blocks = shared + fresh
+            try:
+                if n % T:
+                    # COW fault: private copy of the entry's partial
+                    # tail — the stream appends there from offset n % T
+                    self._pool.copy_block(eids[full], fresh[0])
+                self.stats["prefix_hits"] += 1
+                self.stats["prefix_tokens_reused"] += n
+                return self._activate_begin_paged(req, cached_logits,
+                                                  blocks)
+            except Exception:
+                self._pool.release(blocks)
+                raise
+        q = min((p // T) * T, ((n - 1) // T) * T)  # block-aligned reuse
+        if (key_hit is not None
+                and q >= max(T, self.PREFIX_MIN_REUSE)
+                and q + self._bucket(n - q) <= self.S):
+            eids, _lg = self._prefix[key_hit]
+            shared = list(eids[:q // T])
+            fresh = self._alloc_blocks(self._blocks_for(n) - len(shared))
+            if fresh is None:
+                return None
+            self._pool.retain(shared)
+            blocks = shared + fresh
+            try:
+                self.stats["prefix_hits"] += 1
+                self.stats["prefix_tokens_reused"] += q
+                rem = n - q
+                c = self._bucket(rem)
+                toks = np.zeros((1, c), np.int32)
+                toks[0, :rem] = prompt[q:]
+                bt = np.full((1, self.MB), self._pool.SENTINEL, np.int32)
+                bt[0, :len(blocks)] = blocks
+                logits, arena = self._paged_chunk_jitted(
+                    self.params, jnp.asarray(toks), self._pool.arena,
+                    jnp.asarray(bt), jnp.asarray([q], jnp.int32),
+                    jnp.asarray([rem], jnp.int32))
+                self._pool.arena = arena
+                logits = logits[:, rem - 1]
+                self._prefix_store_paged(prompt, blocks, logits)
+                return self._activate_begin_paged(req, logits, blocks)
+            except Exception:
+                self._pool.release(blocks)
+                raise
+        blocks = self._alloc_blocks(self._blocks_for(n))
+        if blocks is None:
+            return None
+        try:
+            bucket = self._bucket(n)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :n] = prompt
+            logits, cache1 = self._prefill_jitted(
+                self.params, jnp.asarray(padded),
+                lengths=jnp.asarray([n], jnp.int32))
+            self._pool.scatter_prefill(cache1, blocks[:(n + T - 1) // T])
+            self._prefix_store_paged(prompt, blocks, logits)
+            return self._activate_begin_paged(req, logits, blocks)
+        except Exception:
+            self._pool.release(blocks)
+            raise
+
+    def _activate_paged_from_cache1(self, req: _PendingRequest, logits,
+                                    cache1):
+        """Chunked-prefill commit: scatter the finished batch-1 cache
+        into fresh blocks. None = pool exhausted (caller re-holds)."""
+        n = req.prompt.size
+        T = self.block_tokens
+        blocks = self._alloc_blocks(self._blocks_for(n))
+        if blocks is None:
+            return None
+        try:
+            self._pool.scatter_prefill(cache1, blocks[:(n + T - 1) // T])
+            self._prefix_store_paged(req.prompt, blocks, logits)
+            return self._activate_begin_paged(req, logits, blocks)
+        except Exception:
+            self._pool.release(blocks)
+            raise
+
+    def _begin_partial_paged(self, req: _PendingRequest) -> None:
+        """Chunked prompt ingestion, paged flavor: chunks build a
+        batch-1 monolithic cache that the FINAL chunk scatters into
+        fresh blocks — no slot is reserved, blocks allocate at
+        activation. (Prefix reuse is not wired on this path; chunked
+        paged prompts ingest from 0.)"""
+        self._m_queue_wait.observe(_time.monotonic() - req.submit_t)
+        self._partial = (req, None, self._init_cache1(), 0, 0)
+
+    def _activate_begin_paged(self, req: _PendingRequest, logits, blocks):
+        """Paged twin of _activate_begin: sample the first token,
+        create the stream's decode state. No lane is claimed (EDF
+        binds lanes per dispatch) — except in speculative mode, where
+        the slot-structured draft cache pins each stream to a lane for
+        life."""
+        jnp = self._jnp
+        stream = req.stream
+        sid = stream.stream_id
+        key = np.asarray([self.seed & 0xFFFFFFFF, sid & 0xFFFFFFFF],
+                         np.uint32)[None]
+        first_d, key_d, lp_d = self._sample_first(logits,
+                                                  jnp.asarray(key))
+        n = req.prompt.size
+        now = _time.monotonic()
+        slo_s = self._slo.budget_s if self._slo is not None else 60.0
+        state = {
+            "sid": sid, "stream": stream, "blocks": list(blocks),
+            "pos": n, "last": 0, "key": np.zeros(2, np.uint32),
+            # cap writes inside S (a verify chunk writes through pos+K)
+            "budget": min(req.max_new, self.S - n - self.speculate),
+            #: absolute deadline feeding the per-token EDF key
+            "deadline_t": getattr(stream, "submit_t", now) + slo_s,
+            "slot": None,
+        }
+        self._sstate[sid] = state
+        if self._spec is not None:
+            slot = self._lane.index(None)
+            self._lane[slot] = sid
+            state["slot"] = slot
+            self._draft_prefill(req, slot)
+        return (req, state, first_d, key_d, lp_d)
+
+    def _activate_commit_paged(self, rec) -> None:
+        req, state, first_d, key_d, lp_d = rec
+        self.stats["prefills"] += 1
+        first = int(np.asarray(first_d)[0])
+        state["last"] = first
+        state["key"] = np.asarray(key_d)[0].copy()
+        t0 = getattr(req.stream, "submit_t", None)
+        if t0 is not None:
+            self._lm_stats.observe_ttft(_time.monotonic() - t0)
+        req.stream._emit(first, float(np.asarray(lp_d)[0]))
+        self.stats["tokens_generated"] += 1
+        self._post_emit_paged(state, first)
+
+    def _post_emit_paged(self, state, tok: int) -> None:
+        state["budget"] -= 1
+        done_eos = self.eos_id is not None and tok == self.eos_id
+        done = done_eos or state["budget"] <= 0
+        if done and self._slo is not None:
+            t0 = getattr(state["stream"], "submit_t", None)
+            if t0 is not None:
+                now = _time.monotonic()
+                self._slo.observe_completion(now - t0, now, frames=1)
+                self._slo.observe_service(now - t0, frames=1)
+        if done_eos:
+            self._finish_paged(state, "eos")
+        elif state["budget"] <= 0:
+            self._finish_paged(state, "length")
+
+    def _finish_paged(self, state, reason: str) -> None:
+        """Paged stream teardown: blocks return to the pool BEFORE the
+        client wakes (mirroring the mono engine's slot-free-before-
+        finish contract, so a caller that observes its stream done also
+        observes the capacity released)."""
+        self._sstate.pop(state["sid"], None)
+        slot = state["slot"]
+        if slot is not None:
+            self._lane[slot] = None
+            self._bt[slot, :] = self._pool.SENTINEL
+            state["slot"] = None
+        if state["blocks"]:
+            self._pool.release(state["blocks"])
+            state["blocks"] = []
+        state["stream"]._finish(reason)
+
+    def _shed_one(self, keep_sid: int) -> bool:
+        """Decode-time block exhaustion: revoke the MOST-LATE admitted
+        stream's blocks (deepest past deadline), replaying the
+        admission-revocation accounting — pressure rung "shed", the
+        SLO scheduler's shed counters, finish reason "shed". False =
+        the only candidate was ``keep_sid`` itself (the caller gives
+        that stream up — self-shed)."""
+        from nnstreamer_tpu.tensors import memory as _memory
+
+        cands = [st for st in self._sstate.values()
+                 if st["sid"] != keep_sid]
+        self_shed = not cands
+        if self_shed:
+            victim = self._sstate.get(keep_sid)
+            if victim is None:
+                return False
+        else:
+            victim = min(cands, key=lambda st: st["deadline_t"])
+        now = _time.monotonic()
+        late = victim["deadline_t"] <= now
+        acct = _memory.ACTIVE
+        if acct is not None:
+            acct.count_pressure("shed")
+        if self._slo is not None:
+            self._slo.note_shed_request(now, late)
+        self.stats["kv_sheds"] += 1
+        log.warning("serving: paged KV exhausted — shedding stream %d "
+                    "(%s)", victim["sid"], "late" if late else "capacity")
+        self._finish_paged(victim, "shed")
+        return not self_shed
+
+    def _topup(self, state) -> bool:
+        """Grow ``state``'s block table to cover the whole next
+        dispatch block (pos+K-1; pos+K for a speculative verify),
+        walking the evict → shed ladder on exhaustion. False = the
+        stream itself was shed."""
+        steps = (self.speculate + 1) if self._spec is not None else self.K
+        hi = (state["pos"] + steps - 1) // self.block_tokens
+        while len(state["blocks"]) <= hi:
+            ids = self._alloc_blocks(hi + 1 - len(state["blocks"]))
+            if ids is None:
+                if not self._shed_one(state["sid"]):
+                    return False
+                continue
+            state["blocks"].extend(ids)
+        return True
+
+    def _decode_step_paged(self) -> None:
+        """One EDF-scheduled K-step decode block: bind the B most
+        urgent streams (per-TOKEN deadline — a nearly-late short
+        stream preempts a long one at block granularity), top up their
+        block tables, run the ONE jitted paged program, emit."""
+        jnp = self._jnp
+        from nnstreamer_tpu.serving.scheduler import token_deadline
+
+        now = _time.monotonic()
+        states = list(self._sstate.values())
+        if len(states) > self.B:
+            states.sort(key=lambda st: token_deadline(
+                now, st["deadline_t"], st["budget"]))
+            selected = states[:self.B]
+            keep = {st["sid"] for st in selected}
+            # park preempted streams' lanes (their kv lives in the
+            # arena; state re-binds whenever EDF selects them again)
+            for slot, sid in enumerate(self._lane):
+                if sid is not None and sid not in keep:
+                    parked = self._sstate.get(sid)
+                    if parked is not None:
+                        parked["slot"] = None
+                    self._lane[slot] = None
+                    self._bt[slot, :] = self._pool.SENTINEL
+        else:
+            selected = states
+        run = []
+        for st in selected:
+            if self._sstate.get(st["sid"]) is not st:
+                continue  # shed while topping up an earlier stream
+            if not self._topup(st):
+                continue  # self-shed
+            if st["slot"] is None:
+                slot = self._lane.index(None)
+                self._lane[slot] = st["sid"]
+                st["slot"] = slot
+            slot = st["slot"]
+            self._bt[slot, :] = self._pool.SENTINEL
+            self._bt[slot, :len(st["blocks"])] = st["blocks"]
+            run.append(st)
+        if not run:
+            return
+        last = np.zeros(self.B, np.int32)
+        pos = np.zeros(self.B, np.int32)
+        keys = np.zeros((self.B, 2), np.uint32)
+        for st in run:
+            last[st["slot"]] = st["last"]
+            pos[st["slot"]] = st["pos"]
+            keys[st["slot"]] = st["key"]
+        t0 = _time.monotonic()
+        toks, lps, arena, keys_d, _last_d, _pos_d = self._dispatch(
+            self.params, jnp.asarray(last), self._pool.arena,
+            jnp.asarray(self._bt), jnp.asarray(pos), jnp.asarray(keys))
+        self._pool.arena = arena
+        toks = np.asarray(toks)
+        lps = np.asarray(lps)
+        keys_np = np.asarray(keys_d)
+        dt = _time.monotonic() - t0
+        self.invoke_stats.record(dt)
+        self.stats["dispatches"] += 1
+        self.stats["slot_steps"] += self.B * self.K
+        per_tok = dt / self.K
+        for st in run:
+            if self._sstate.get(st["sid"]) is not st:
+                continue
+            slot = st["slot"]
+            st["key"] = keys_np[slot].copy()
+            st["pos"] += self.K
+            st["last"] = int(toks[slot, -1])
+            self._lm_stats.observe_token(per_tok)
+            for j in range(self.K):
+                tok = int(toks[slot, j])
+                self.stats["tokens_generated"] += 1
+                self.stats["active_slot_steps"] += 1
+                st["stream"]._emit(tok, float(lps[slot, j]))
+                self._post_emit_paged(st, tok)
+                if self._sstate.get(st["sid"]) is not st:
+                    break  # EOS/length/shed mid-block: drop the tail
+
+    def _loop_paged(self):
+        """Paged engine loop. Dispatch → emit runs synchronously (the
+        host state it re-uploads per block is a few hundred int32s —
+        noise next to the gather the decode already pays), which keeps
+        lane parking/rebinding and EDF preemption a plain host-side
+        concern instead of a device-state pipeline hazard."""
+        while not self._stop_evt.is_set():
+            self._reap_condemned()
+            for state in list(self._sstate.values()):
+                if state["stream"].cancelled:
+                    self._finish_paged(state, "cancelled")
+            if self._held is not None and self._held.stream.cancelled:
+                self._held.stream._finish("cancelled")
+                self._held = None
+            progressed = False
+            if self._partial is not None:
+                if self._partial[0].stream.cancelled:
+                    self._partial[0].stream._finish("cancelled")
+                    self._partial = None
+                else:
+                    self._advance_partial()
+                    progressed = True
+            admitted = []
+            while self._partial is None:
+                if self._spec is not None and \
+                        len(self._sstate) >= self.B:
+                    break  # slot-structured draft cache caps streams
+                if self._held is not None:
+                    req, self._held = self._held, None
+                else:
+                    try:
+                        req = self._pending.get_nowait()
+                    except _queue.Empty:
+                        break
+                if req.stream.cancelled:
+                    req.stream._finish("cancelled")
+                    continue
+                try:
+                    if self.prefill_chunk is not None:
+                        self._begin_partial_paged(req)
+                        progressed = True
+                        break
+                    rec = self._admit_paged(req)
+                except Exception as e:  # noqa: BLE001 — a bad request
+                    # must not kill the engine loop
+                    log.warning("serving: admit failed: %s", e)
+                    req.stream._finish(f"error: {e}")
+                    continue
+                if rec is None:
+                    # pool can't cover this prompt yet: hold the head
+                    # (completions free blocks; FIFO order preserved)
+                    self.stats["kv_defers"] += 1
+                    self._held = req
+                    break
+                admitted.append(rec)
+                progressed = True
+            for rec in admitted:  # start all fetches before blocking
+                for d in (rec[2], rec[3], rec[4]):
+                    start_async = getattr(d, "copy_to_host_async", None)
+                    if start_async is not None:
+                        start_async()
+            for rec in admitted:
+                try:
+                    self._activate_commit_paged(rec)
+                except Exception as e:  # noqa: BLE001 — fail only this
+                    # stream
+                    log.warning("serving: activate failed: %s", e)
+                    state = rec[1]
+                    if self._sstate.get(state["sid"]) is state:
+                        self._finish_paged(state, f"error: {e}")
+                    else:
+                        rec[0].stream._finish(f"error: {e}")
+            if len(self._sstate) > self.stats["concurrent_streams_max"]:
+                self.stats["concurrent_streams_max"] = len(self._sstate)
+            if not self._sstate:
+                if not progressed:
+                    self._wake.wait(timeout=0.05)
+                    self._wake.clear()
+                continue
+            try:
+                if self._spec is not None:
+                    self._spec_step_paged()
+                else:
+                    self._decode_step_paged()
+            except Exception as e:  # noqa: BLE001 — a device failure
+                # must not strand clients blocked on their streams
+                self._recover(e)
 
     def _loop(self):
+        if self.paged:
+            return self._loop_paged()
+        return self._loop_mono()
+
+    def _loop_mono(self):
         jnp = self._jnp
         while not self._stop_evt.is_set():
+            self._reap_condemned()
             # honor cancellations first: active slots free at this block
             # boundary; a half-ingested prompt stops mid-prefill
             for slot in range(self.B):
@@ -1071,6 +2059,16 @@ class ContinuousBatchingEngine:
                         self._wake.wait(timeout=0.05)
                         self._wake.clear()
                     continue
+            if self._spec is not None:
+                # speculative rounds replace the K-step dispatch; they
+                # run synchronously off the host mirrors (variable
+                # per-stream emit counts don't pipeline)
+                try:
+                    self._sync_host_state()
+                    self._spec_step_mono()
+                except Exception as e:  # noqa: BLE001
+                    self._recover(e)
+                continue
             try:
                 t0 = _time.monotonic()
                 if self._dev_state is None:

@@ -1,0 +1,270 @@
+"""Paged KV-cache allocator: one preallocated device arena, block tables.
+
+The monolithic serving cache gives every one of ``max_streams`` batch
+slots the full ``max_seq`` window — HBM cost B×S whether streams use it
+or not, concurrency hard-capped at B. This module carves the same bytes
+into fixed ``block_tokens``-sized blocks instead (the compiler-first
+O(1) autoregressive-caching form, PAPERS.md):
+
+- **Arena** — one device pytree per codec, leaves ``[L, NTOT, 2, T, h,
+  dh]`` (int8 adds a ``[L, NTOT, 2, T, h]`` scale leaf). The leading L
+  axis lets the decode layer scan carry one per-layer block-pool slice,
+  exactly like the monolithic cache's leading L. ``NTOT = num_blocks +
+  1``: index ``num_blocks`` is a permanent ZERO block that is never
+  allocated and never written.
+- **Sentinel** — unallocated block-table entries hold ``SENTINEL =
+  NTOT``, deliberately out of bounds: gathers clamp onto the zero block
+  (reads are exact zeros, finite and masked anyway) and scatters use
+  ``mode="drop"`` (writes vanish). One sentinel serves empty batch
+  lanes, bucket padding, and not-yet-allocated tail blocks alike.
+- **Free list / refcounts** — LIFO free list (hot blocks stay hot in
+  whatever cache hierarchy sits under HBM), per-block refcounts so
+  copy-on-write prefix sharing is a ``retain``; a block returns to the
+  free list when its last owner releases it. Allocation is
+  all-or-nothing: a stream that cannot get every block it asked for
+  gets none, so the engine's shed ladder sees a clean failure.
+- **Accounting** — the arena registers its bytes with the PR-12 HBM
+  accountant under the ``kvcache`` category at construction, so cache
+  pressure shows up in ``nns_mem_used_bytes{category="kvcache"}`` and
+  rides the same evict → shed → cpu ladder as weights and frames.
+
+Model-side consumers (models/transformer.py paged builders) never index
+the arena directly — they receive per-layer slices from the scan and a
+block table. Direct arena subscripts outside this file are flagged by
+lint rule NNS118: every host-side mutation (prefill scatter, COW block
+copy) must go through the pool so refcounts, donation, and the zero
+block's invariants stay in one place.
+
+Kill switch: ``NNSTPU_PAGED_KV=0`` (or ``block_tokens=0`` on the
+engine) disables paging entirely — the engine then never imports an
+arena and runs the monolithic PR-18 path byte-identically.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from nnstreamer_tpu.tensors import memory as _memory
+
+_FALSY = ("0", "false", "no", "off")
+
+
+def paged_enabled() -> bool:
+    """Environment kill switch (default ON; the engine additionally
+    requires ``block_tokens > 0``, which defaults off)."""
+    return os.environ.get("NNSTPU_PAGED_KV", "1").strip().lower() \
+        not in _FALSY
+
+
+def _scatter_prefill_impl(arena, cache1, bids):
+    """Scatter a batch-1 monolithic cache ([L, 2, 1, S, ...] leaves) into
+    arena blocks ``bids`` ([S/T] int32, sentinel entries drop). Block i
+    receives slots [i*T, (i+1)*T) — including any trailing bucket-pad
+    garbage in the last data block, which stays masked until the owning
+    stream overwrites it (the same padded-prefill contract as the
+    monolithic cache)."""
+    import jax
+    import jax.numpy as jnp
+
+    def leaf(a, c):
+        L = c.shape[0]
+        S = c.shape[3]
+        T = a.shape[3]
+        u = c[:, :, 0]                                   # [L,2,S,...]
+        u = u.reshape((L, 2, S // T, T) + u.shape[3:])
+        u = jnp.moveaxis(u, 2, 1)                        # [L,MB,2,T,...]
+        return a.at[:, bids].set(u.astype(a.dtype), mode="drop")
+
+    return jax.tree.map(leaf, arena, cache1)
+
+
+def _copy_block_impl(arena, src, dst):
+    """Copy one physical block across every layer/leaf — the COW fault
+    path when a stream extends a shared prefix whose tail block is only
+    partially full."""
+    import jax
+
+    return jax.tree.map(lambda a: a.at[:, dst].set(a[:, src]), arena)
+
+
+class BlockPool:
+    """Allocator + device arena for one engine's paged KV cache.
+
+    Host-side state (free list, refcounts) is guarded by a lock so the
+    engine thread and observers can touch it concurrently; device state
+    (``self.arena``) is owned by the engine loop, which threads it
+    through jitted programs with donation and writes the result back.
+    """
+
+    def __init__(self, cfg, num_blocks: int, block_tokens: int,
+                 kv_codec: Optional[str] = None, mesh=None,
+                 owner: str = "kvpool"):
+        from nnstreamer_tpu.models.transformer import _kv_codec
+
+        if num_blocks <= 0:
+            raise ValueError(f"BlockPool: num_blocks must be positive, "
+                             f"got {num_blocks}")
+        if block_tokens <= 0:
+            raise ValueError(f"BlockPool: block_tokens must be positive, "
+                             f"got {block_tokens}")
+        self.cfg = cfg
+        self.num_blocks = int(num_blocks)
+        self.block_tokens = int(block_tokens)
+        self.ntot = self.num_blocks + 1       # + the permanent zero block
+        self.SENTINEL = self.ntot             # out of bounds on purpose
+        self.kv_codec = kv_codec
+        self.mesh = mesh
+        self.owner = owner
+        self._codec = _kv_codec(cfg, kv_codec)
+        self._lock = threading.Lock()
+        self._free: List[int] = list(range(self.num_blocks))
+        self._ref = np.zeros(self.num_blocks, np.int64)
+        self.arena = self._make_arena()
+
+        import jax
+        leaves = jax.tree_util.tree_leaves(self.arena)
+        self.nbytes = int(sum(l.nbytes for l in leaves))
+        self._jit_scatter = jax.jit(_scatter_prefill_impl,
+                                    donate_argnums=(0,))
+        self._jit_copy = jax.jit(_copy_block_impl, donate_argnums=(0,))
+
+        acct = _memory.ACTIVE
+        if acct is not None:
+            acct.register(self.nbytes, "kvcache")
+            self._acct_finalizer = weakref.finalize(
+                self, _unregister_arena, weakref.ref(acct), self.nbytes)
+        else:
+            self._acct_finalizer = None
+
+    # -- arena construction -------------------------------------------
+
+    def _make_arena(self):
+        cfg = self.cfg
+        arena = self._codec.paged_init(cfg.n_layers, self.ntot,
+                                       self.block_tokens, cfg.n_heads,
+                                       cfg.head_dim)
+        if self.mesh is not None:
+            arena = self._place(arena)
+        return arena
+
+    def _place(self, arena):
+        from jax.sharding import PartitionSpec as P
+
+        from nnstreamer_tpu.parallel import serve as _serve
+
+        names = set(self.mesh.axis_names)
+        dp = "dp" if "dp" in names else None
+        tp = "tp" if "tp" in names else None
+        if dp and self.ntot % self.mesh.shape["dp"]:
+            raise ValueError(
+                f"BlockPool: arena block count {self.ntot} (incl. zero "
+                f"block) must divide over dp={self.mesh.shape['dp']} — "
+                f"pad num_blocks")
+
+        def spec_of(leaf):
+            # [L, NTOT, 2, T, h(, dh)] — blocks over dp, heads over tp
+            head = (None, dp, None, None, tp)
+            return P(*(head + (None,) * (leaf.ndim - 5)))
+
+        return _serve.place_tree(arena, self.mesh, spec_of,
+                                 label=f"{self.owner}:kvpool")
+
+    # -- host-side bookkeeping ----------------------------------------
+
+    @property
+    def free_blocks(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def alloc(self, k: int) -> Optional[List[int]]:
+        """All-or-nothing: ``k`` fresh blocks (refcount 1 each) or None."""
+        if k <= 0:
+            return []
+        with self._lock:
+            if len(self._free) < k:
+                return None
+            ids = [self._free.pop() for _ in range(k)]
+            for i in ids:
+                self._ref[i] = 1
+            return ids
+
+    def retain(self, ids: Sequence[int]) -> None:
+        with self._lock:
+            for i in ids:
+                if self._ref[i] <= 0:
+                    raise RuntimeError(
+                        f"BlockPool.retain: block {i} is not live")
+                self._ref[i] += 1
+
+    def release(self, ids: Sequence[int]) -> None:
+        with self._lock:
+            for i in ids:
+                if self._ref[i] <= 0:
+                    raise RuntimeError(
+                        f"BlockPool.release: block {i} over-released")
+                self._ref[i] -= 1
+                if self._ref[i] == 0:
+                    self._free.append(i)
+
+    def live_blocks(self) -> int:
+        with self._lock:
+            return int(np.count_nonzero(self._ref))
+
+    # -- device-side helpers ------------------------------------------
+
+    def scatter_prefill(self, cache1, block_ids: Sequence[int]) -> None:
+        """Move a batch-1 prefill cache into ``block_ids`` (padded with
+        the sentinel up to S/T). Mutates ``self.arena`` in place (the old
+        arena buffer is donated)."""
+        import jax.numpy as jnp
+
+        mb = _leaf_slots(cache1) // self.block_tokens
+        bids = np.full(mb, self.SENTINEL, np.int32)
+        bids[:len(block_ids)] = block_ids
+        self.arena = self._jit_scatter(self.arena, cache1,
+                                       jnp.asarray(bids))
+
+    def copy_block(self, src: int, dst: int) -> None:
+        """COW fault: duplicate physical block ``src`` into ``dst``."""
+        import jax.numpy as jnp
+
+        self.arena = self._jit_copy(self.arena,
+                                    jnp.asarray(src, jnp.int32),
+                                    jnp.asarray(dst, jnp.int32))
+
+    def reset(self) -> None:
+        """Drop every allocation and rebuild a zeroed arena — the engine
+        recovery path (mirrors re-running ``_init_cache`` on the
+        monolithic engine). Accounting is unchanged: same bytes."""
+        with self._lock:
+            self._free = list(range(self.num_blocks))
+            self._ref[:] = 0
+        self.arena = self._make_arena()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "num_blocks": self.num_blocks,
+                "block_tokens": self.block_tokens,
+                "free_blocks": len(self._free),
+                "live_blocks": int(np.count_nonzero(self._ref)),
+                "nbytes": self.nbytes,
+            }
+
+
+def _leaf_slots(cache1) -> int:
+    """Sequence length S of a batch-1 monolithic cache pytree."""
+    import jax
+
+    return jax.tree_util.tree_leaves(cache1)[0].shape[3]
+
+
+def _unregister_arena(acct_ref, nbytes):
+    acct = acct_ref()
+    if acct is not None:
+        acct.unregister(nbytes, "kvcache")

@@ -242,6 +242,16 @@ class FeedbackController:
             self._lat.extend(state.get("latencies", ()))
 
 
+def token_deadline(now: float, deadline_t: float, remaining: int) -> float:
+    """Per-TOKEN EDF key for continuous-batching decode: spread a
+    stream's remaining slack evenly over its remaining token budget, so
+    a nearly-late short stream sorts ahead of a comfortable long one —
+    the serving engine feeds these into its lane selection each block
+    (token-level preemption). Row-independent math: a stream's schedule
+    key never depends on which other streams share the batch."""
+    return now + max(0.0, deadline_t - now) / max(1, int(remaining))
+
+
 class SloScheduler:
     """Owns the admitted population between ingress and device dispatch.
 
@@ -482,6 +492,17 @@ class SloScheduler:
         if tl is not None:
             tl.mark("sched_shed", buf.meta.get(_timeline.TRACE_SEQ_META),
                     track="scheduler", late=late)
+
+    def note_shed_request(self, now: float, late: bool = True) -> None:
+        """Request-path analog of :meth:`note_shed`: an ADMITTED decode
+        stream had its KV blocks revoked back to the pool (serving
+        engine cache-pressure shed). Replays the admission revocation
+        accounting — the admitted population nets out through the same
+        shed counters the frame path uses."""
+        self._m["shed_late" if late else "shed_capacity"].inc()
+        tl = _timeline.ACTIVE
+        if tl is not None:
+            tl.mark("sched_shed", None, track="scheduler", late=late)
 
     # -- observation feeds ----------------------------------------------------
     def observe_service(self, seconds: float, frames: int = 1) -> None:

@@ -1,0 +1,150 @@
+"""Prefix-cache entries as accounted, droppable HBM (satellite fix).
+
+Before this PR the trie-backed prefix cache held device arrays that
+never registered with the HBM accountant — invisible bytes the pressure
+ladder could neither see nor reclaim. Now every monolithic prefix entry
+registers under the ``kvcache`` category as a DROPPABLE residency unit:
+eviction surrenders the bytes (on_drop condemns the key; the engine
+thread reaps), and LRU turnover un-registers as entries rotate out.
+(The paged engine needs none of this per-entry machinery — its entries
+are refcounts on pool blocks, and the arena itself is one registered
+``kvcache`` unit, covered in test_kvpool.py.)"""
+
+import gc
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from nnstreamer_tpu.serving import ContinuousBatchingEngine  # noqa: E402
+from nnstreamer_tpu.tensors import memory  # noqa: E402
+from tests.test_serving import CFG, PARAMS, reference_greedy  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _budget():
+    memory.deactivate()
+    budget = memory.activate(1 << 30)
+    # the budget's counters are registry-global singletons; tests
+    # elsewhere assert their ABSOLUTE values, so put back every tick
+    # these tests add
+    flat = [budget._m["evictions"], budget._m["prefetches"],
+            *budget._m["pressure"].values()]
+    saved = [c.value for c in flat]
+    yield budget
+    memory.deactivate()
+    for c, v in zip(flat, saved):
+        c._value = v
+
+
+def _kv_bytes(budget):
+    return budget.snapshot()["used_by_category"].get("kvcache", 0)
+
+
+def _prefix_units(budget):
+    return [u for u in budget.residency.snapshot()["units"]
+            if ":prefix" in u["label"]]
+
+
+# -- the residency primitive ----------------------------------------------
+
+
+def test_droppable_unit_accounting(_budget):
+    dropped = []
+    _budget.residency.register_droppable(
+        "t:prefix:0", 1000, dropped.append, label="t:prefix")
+    assert _kv_bytes(_budget) == 1000
+    assert _budget.residency.evict_all() == 1000
+    assert dropped == ["t:prefix:0"]      # owner told to surrender
+    assert _kv_bytes(_budget) == 0
+    # unregister (owner closed) releases bytes WITHOUT the callback
+    _budget.residency.register_droppable(
+        "t:prefix:1", 500, dropped.append, label="t:prefix")
+    _budget.residency.unregister("t:prefix:1")
+    assert _kv_bytes(_budget) == 0
+    assert dropped == ["t:prefix:0"]
+
+
+# -- the engine's prefix cache rides it -----------------------------------
+
+
+PROMPT_A = [7, 3, 9, 1, 4, 6, 2, 8, 5, 11]
+PROMPT_B = [13, 17, 19, 23, 29, 31, 37, 41]
+PROMPT_C = [2, 4, 6, 8, 10, 12, 14, 16, 18]
+
+
+def mono_engine(**kw):
+    kw.setdefault("max_streams", 2)
+    kw.setdefault("steps_per_dispatch", 4)
+    kw.setdefault("temperature", 0.0)
+    kw.setdefault("prefix_cache", 2)
+    return ContinuousBatchingEngine(CFG, PARAMS, **kw).start()
+
+
+def test_prefix_entries_register_kvcache_bytes(_budget):
+    eng = mono_engine()
+    try:
+        assert not eng.paged
+        eng.generate(PROMPT_A, max_new_tokens=4, timeout=120)
+        used = _kv_bytes(_budget)
+        assert used > 0, "prefix entry bytes invisible to the accountant"
+        units = _prefix_units(_budget)
+        assert len(units) == 1
+        assert units[0]["category"] == "kvcache"
+        assert sum(u["nbytes"] for u in units) == used
+    finally:
+        eng.stop()
+    # engine teardown releases the entries' accounting
+    del eng
+    gc.collect()
+
+
+def test_lru_turnover_unregisters_bytes(_budget):
+    eng = mono_engine(prefix_cache=2)
+    try:
+        for p in (PROMPT_A, PROMPT_B):
+            eng.generate(p, max_new_tokens=4, timeout=120)
+        two = _kv_bytes(_budget)
+        assert len(_prefix_units(_budget)) == 2
+        # third distinct prompt: capacity 2 evicts the LRU entry and its
+        # bytes leave the ledger with it
+        eng.generate(PROMPT_C, max_new_tokens=4, timeout=120)
+        assert len(_prefix_units(_budget)) == 2
+        assert len(eng._prefix) == 2
+        assert _kv_bytes(_budget) <= two + max(
+            u["nbytes"] for u in _prefix_units(_budget))
+        # the ledger tracks exactly the live entries
+        assert _kv_bytes(_budget) == sum(
+            u["nbytes"] for u in _prefix_units(_budget))
+    finally:
+        eng.stop()
+
+
+def test_pressure_eviction_drops_entries_and_serving_continues(_budget):
+    eng = mono_engine()
+    try:
+        want = reference_greedy(PROMPT_A, 6)
+        assert eng.generate(PROMPT_A, max_new_tokens=6,
+                            timeout=120) == want
+        assert _kv_bytes(_budget) > 0
+        # pressure-ladder rung 1: the accountant revokes droppable units
+        freed = _budget.residency.evict_all()
+        assert freed > 0
+        assert _kv_bytes(_budget) == 0    # bytes surrendered immediately
+        assert eng._condemned               # reap pending, engine-side
+        # serving continues — the next request both reaps the condemned
+        # entry and re-decodes exactly (the cache is an optimization,
+        # never a correctness dependency)
+        assert eng.generate(PROMPT_A, max_new_tokens=6,
+                            timeout=120) == want
+        deadline = time.monotonic() + 10
+        while eng._condemned and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not eng._condemned
+        # the re-decode re-stored the prefix: accounted again
+        assert _kv_bytes(_budget) > 0
+    finally:
+        eng.stop()

@@ -1,0 +1,134 @@
+"""Drainer retirement vs late completions (elements/lm_serve.py).
+
+The framed protocol's contract is one response per request, in order.
+A per-client drainer retires after ``idle_timeout`` of silence — but a
+completion can land in the fifo in the window between the idle timeout
+firing and the drainer unregistering itself. The old code dropped that
+item (and desynced every later response for the client); the fix drains
+orphans after unregistering and hands them to a fresh drainer.
+
+``RacyQueue`` makes the window deterministic: its first blocking get()
+raises Empty *after* planting the late completion, exactly the
+interleaving the wild race produces."""
+
+import queue as _queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from nnstreamer_tpu import parse_launch  # noqa: E402
+from nnstreamer_tpu.serving import (  # noqa: E402
+    ContinuousBatchingEngine,
+    register_engine,
+    unregister_engine,
+)
+from nnstreamer_tpu.tensors.buffer import TensorBuffer  # noqa: E402
+from tests.test_serving import CFG, PARAMS, reference_greedy  # noqa: E402
+
+
+class RacyQueue(_queue.Queue):
+    """First blocking get() plants ``late_item`` then raises Empty —
+    the completion arrives exactly as the idle window closes."""
+
+    def __init__(self, late_item):
+        super().__init__()
+        self._late = late_item
+        self._raced = False
+        self._lied = False
+
+    def get(self, block=True, timeout=None):
+        if block and not self._raced:
+            self._raced = True
+            super().put(self._late)
+            raise _queue.Empty
+        return super().get(block=block, timeout=timeout)
+
+    def empty(self):
+        # an empty() probe at retirement is exactly the TOCTOU the fix
+        # removes: lie True once, as a real race would have it — code
+        # that trusts the probe drops the item; code that drains via
+        # get_nowait() delivers it
+        if not self._lied:
+            self._lied = True
+            return True
+        return super().empty()
+
+
+@pytest.fixture
+def race_rig():
+    engine = ContinuousBatchingEngine(
+        CFG, PARAMS, max_streams=2, steps_per_dispatch=4,
+        temperature=0.0).start()
+    register_engine("lm_race", engine)
+    pipe = parse_launch(
+        "appsrc name=src ! tensor_lm_serve engine=lm_race "
+        "max-new-tokens=4 idle-timeout=0.05 name=serve ! "
+        "tensor_sink name=out to-host=true")
+    outs = []
+    pipe.get("out").connect(lambda b: outs.append(b))
+    pipe.start()
+    yield engine, pipe, outs
+    pipe.stop()
+    engine.stop()
+    unregister_engine("lm_race")
+
+
+def test_completion_racing_retirement_is_not_dropped(race_rig):
+    engine, pipe, outs = race_rig
+    serve = pipe.get("serve")
+    prompt = [5, 11, 23]
+    stream = engine.submit(prompt, max_new_tokens=4)
+    # completed BEFORE the drainer ever sees it (poll the flag —
+    # result() is one-shot and belongs to the drainer)
+    deadline = time.monotonic() + 120
+    while not stream.finished and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert stream.finished
+    buf = TensorBuffer([np.asarray(prompt, np.int32)], pts=0,
+                       meta={"query_client_id": 9})
+    fifo = RacyQueue((stream, buf, None, time.monotonic()))
+    with serve._state_lock:
+        serve._fifos[9] = fifo
+        serve._inflight += 1
+        t = threading.Thread(target=serve._drain, args=(9, fifo),
+                             daemon=True)
+        serve._drainers[9] = t
+    t.start()
+    deadline = time.monotonic() + 30
+    while not outs and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert outs, "late completion was dropped at drainer retirement"
+    assert np.asarray(outs[0].tensors[0]).tolist() == \
+        reference_greedy(prompt, 4)
+    assert outs[0].meta["lm_finish_reason"] in ("eos", "length")
+    # the adopting drainer retires cleanly too — no fifo leak
+    deadline = time.monotonic() + 10
+    while 9 in serve._fifos and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert 9 not in serve._fifos and 9 not in serve._drainers
+
+
+def test_retirement_hammering_answers_every_request(race_rig):
+    """Stochastic cousin: requests spaced ~one idle window apart, so
+    retirement and arrival interleave constantly. Every request must
+    still get exactly one in-order response."""
+    engine, pipe, outs = race_rig
+    serve = pipe.get("serve")
+    prompts = [[4, 8, 15], [16, 23], [42, 7, 9, 1], [2, 2], [9, 9, 9],
+               [13, 2], [31, 5], [1, 2, 3]]
+    for i, p in enumerate(prompts):
+        serve._chain_entry(serve.sinkpads[0], TensorBuffer(
+            [np.asarray(p, np.int32)], pts=i,
+            meta={"query_client_id": 7}))
+        time.sleep(0.05)  # ~= idle-timeout: maximal retirement churn
+    deadline = time.monotonic() + 120
+    while len(outs) < len(prompts) and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert len(outs) == len(prompts)
+    got = [np.asarray(b.tensors[0]).tolist() for b in outs]
+    assert got == [reference_greedy(p, 4) for p in prompts]

@@ -1,0 +1,253 @@
+"""Paged-KV continuous batching (serving/engine.py + serving/kvpool.py).
+
+The correctness bar, per ISSUE 19's acceptance criteria:
+
+- ``NNSTPU_PAGED_KV=0`` (or ``block_tokens=0``) keeps the monolithic
+  cache — the engine never builds a pool and outputs are byte-identical
+  to the unpaged engine (pinned here);
+- with paging ON, greedy outputs are byte-identical to the monolithic
+  cache for the same prompts — single stream, concurrent streams,
+  ``kv_quant=int8``, chunked prefill, and oversubscription (more
+  streams than decode lanes) alike;
+- the decode loop stays ONE jitted program (retrace count pinned);
+- under a starved pool the evict -> shed ladder fires, shed streams'
+  blocks return to the free list, and surviving streams stay exact;
+- copy-on-write prefix sharing retains blocks once across streams;
+- paging x int8 x mesh=dp2 composes byte-identically (satellite 4).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from nnstreamer_tpu.serving import ContinuousBatchingEngine  # noqa: E402
+from tests.test_serving import CFG, PARAMS, reference_greedy  # noqa: E402
+
+T = 8
+
+
+def paged_engine(**kw):
+    kw.setdefault("max_streams", 3)
+    kw.setdefault("steps_per_dispatch", 4)
+    kw.setdefault("temperature", 0.0)
+    kw.setdefault("block_tokens", T)
+    return ContinuousBatchingEngine(CFG, PARAMS, **kw).start()
+
+
+PROMPTS = [[5, 11, 23, 42, 7], [4, 8, 15], [16, 23], [42, 7, 9, 1],
+           [2, 2, 2, 2, 2], [31, 59, 26, 53], [9] * 17, [13, 2]]
+
+
+# -- kill switch ----------------------------------------------------------
+
+
+def test_env_kill_switch_keeps_monolithic_path(monkeypatch):
+    monkeypatch.setenv("NNSTPU_PAGED_KV", "0")
+    eng = paged_engine()  # block_tokens set, env wins
+    try:
+        assert not eng.paged
+        assert eng._cache is not None          # monolithic cache built
+        assert not hasattr(eng, "_pool") or eng._pool is None
+        got = eng.generate(PROMPTS[0], max_new_tokens=9, timeout=120)
+    finally:
+        eng.stop()
+    assert got == reference_greedy(PROMPTS[0], 9)
+
+
+def test_block_tokens_zero_is_monolithic():
+    eng = ContinuousBatchingEngine(
+        CFG, PARAMS, max_streams=2, steps_per_dispatch=4,
+        temperature=0.0).start()
+    try:
+        assert not eng.paged and eng._cache is not None
+    finally:
+        eng.stop()
+
+
+# -- greedy byte-parity vs the monolithic cache ---------------------------
+
+
+def test_single_stream_matches_reference():
+    eng = paged_engine()
+    try:
+        assert eng.paged
+        for p in PROMPTS[:4]:
+            assert eng.generate(p, max_new_tokens=9, timeout=120) == \
+                reference_greedy(p, 9), f"prompt={p}"
+    finally:
+        eng.stop()
+
+
+def test_concurrent_streams_match_isolated_runs():
+    eng = paged_engine()
+    try:
+        streams = [eng.submit(p, max_new_tokens=9) for p in PROMPTS[:5]]
+        results = [s.result(timeout=240) for s in streams]
+    finally:
+        eng.stop()
+    for p, got in zip(PROMPTS, results):
+        assert got == reference_greedy(p, 9), f"prompt={p}"
+
+
+def test_int8_paged_matches_int8_monolithic():
+    """The per-block int8 codec must equal the monolithic int8 cache
+    bit for bit — same quantization grid, different storage layout."""
+    mono = ContinuousBatchingEngine(
+        CFG, PARAMS, max_streams=2, steps_per_dispatch=4,
+        temperature=0.0, kv_quant="int8").start()
+    try:
+        want = [mono.generate(p, max_new_tokens=9, timeout=120)
+                for p in PROMPTS[:3]]
+    finally:
+        mono.stop()
+    eng = paged_engine(kv_quant="int8")
+    try:
+        got = [eng.generate(p, max_new_tokens=9, timeout=120)
+               for p in PROMPTS[:3]]
+    finally:
+        eng.stop()
+    assert got == want
+
+
+def test_chunked_prefill_composes_with_paging():
+    eng = paged_engine(prefill_chunk=16)
+    try:
+        for p in (PROMPTS[6], list(range(1, 30))):
+            assert eng.generate(p, max_new_tokens=6, timeout=120) == \
+                reference_greedy(p, 6), f"len={len(p)}"
+    finally:
+        eng.stop()
+
+
+# -- one jitted decode program --------------------------------------------
+
+
+def test_decode_loop_stays_one_jitted_program():
+    eng = paged_engine()
+    try:
+        streams = [eng.submit(p, max_new_tokens=7) for p in PROMPTS[:5]]
+        for s in streams:
+            s.result(timeout=240)
+        # every dispatch reuses the single traced program: block tables
+        # and positions are data, not shape, so stream churn and block
+        # growth never retrace
+        assert eng._dispatch._cache_size() == 1
+    finally:
+        eng.stop()
+
+
+# -- oversubscription: more streams than decode lanes ---------------------
+
+
+def test_oversubscribed_streams_stay_exact():
+    """12 streams over 2 decode lanes: EDF time-sharing parks and
+    rebinds lanes at block granularity, and every stream's output is
+    still byte-identical to its isolated run."""
+    eng = paged_engine(max_streams=2, kv_blocks=64)
+    try:
+        prompts = [PROMPTS[i % len(PROMPTS)] for i in range(12)]
+        streams = [eng.submit(p, max_new_tokens=8) for p in prompts]
+        results = [s.result(timeout=480) for s in streams]
+        assert eng.stats["concurrent_streams_max"] > eng.B
+    finally:
+        eng.stop()
+    for p, got in zip(prompts, results):
+        assert got == reference_greedy(p, 8), f"prompt={p}"
+
+
+def test_starved_pool_sheds_and_recycles_blocks():
+    """A pool too small for the offered load must shed (most-late
+    stream first), count it, and return every block to the free list —
+    never wedge admission or leak."""
+    eng = paged_engine(max_streams=2, kv_blocks=6, prefix_cache=0)
+    try:
+        streams = [eng.submit(PROMPTS[i % len(PROMPTS)],
+                              max_new_tokens=24) for i in range(8)]
+        done = [s.result(timeout=480) for s in streams]
+        reasons = [s.finish_reason for s in streams]
+        assert eng.stats["kv_sheds"] > 0
+        assert all(r in ("length", "shed", "eos") for r in reasons)
+        # shed streams still returned their partial output
+        assert all(done[i] is not None for i in range(len(done)))
+        assert eng._pool.live_blocks() == 0
+        # non-shed streams remained exact despite the churn
+        for s, p, got in zip(streams, [PROMPTS[i % len(PROMPTS)]
+                                       for i in range(8)], done):
+            if s.finish_reason == "length":
+                assert got == reference_greedy(p, 24), f"prompt={p}"
+    finally:
+        eng.stop()
+
+
+# -- copy-on-write prefix sharing -----------------------------------------
+
+
+def test_prefix_cache_shares_blocks_copy_on_write():
+    base = [7, 3, 9, 1, 4, 6, 2, 8, 5, 11, 13, 17, 19, 23, 29, 27, 25]
+    eng = paged_engine(prefix_cache=4, kv_blocks=64)
+    try:
+        cold = eng.generate(base, max_new_tokens=6, timeout=120)
+        live_after_cold = eng._pool.live_blocks()
+        assert live_after_cold > 0      # the entry retains its blocks
+        hit = eng.generate(base, max_new_tokens=6, timeout=120)
+        ext = eng.generate(base + [31, 37], max_new_tokens=6, timeout=120)
+        assert eng.stats["prefix_hits"] >= 2
+        assert eng.stats["prefix_tokens_reused"] >= len(base) + 16
+    finally:
+        eng.stop()
+    assert hit == cold == reference_greedy(base, 6)
+    assert ext == reference_greedy(base + [31, 37], 6)
+
+
+def test_prefix_entry_blocks_survive_donor_stream_exit():
+    """The cached prefix must stay valid after the stream that created
+    it finishes and its private blocks are recycled — the refcount is
+    what keeps the shared full blocks alive."""
+    base = list(range(1, 18))
+    eng = paged_engine(prefix_cache=8, kv_blocks=64)
+    try:
+        eng.generate(base, max_new_tokens=4, timeout=120)
+        # churn the pool: unrelated streams recycle the donor's blocks
+        for p in PROMPTS[:4]:
+            eng.generate(p, max_new_tokens=6, timeout=120)
+        got = eng.generate(base, max_new_tokens=9, timeout=120)
+        assert eng.stats["prefix_hits"] >= 1
+    finally:
+        eng.stop()
+    assert got == reference_greedy(base, 9)
+
+
+# -- satellite 4: paging x int8 x mesh=dp2 --------------------------------
+
+
+def test_paged_int8_dp2_mesh_matches_single_device():
+    from nnstreamer_tpu.parallel.mesh import make_mesh
+
+    mono = ContinuousBatchingEngine(
+        CFG, PARAMS, max_streams=2, steps_per_dispatch=4,
+        temperature=0.0, kv_quant="int8").start()
+    try:
+        want = [mono.generate(p, max_new_tokens=8, timeout=240)
+                for p in PROMPTS[:3]]
+    finally:
+        mono.stop()
+
+    mesh = make_mesh([("dp", 2)])
+    eng = ContinuousBatchingEngine(
+        CFG, PARAMS, max_streams=2, steps_per_dispatch=4,
+        temperature=0.0, kv_quant="int8", block_tokens=T,
+        mesh=mesh).start()
+    try:
+        assert eng.paged
+        # the arena (incl. zero block) divides over dp ranks
+        assert eng._pool.ntot % 2 == 0
+        got = [eng.generate(p, max_new_tokens=8, timeout=240)
+               for p in PROMPTS[:3]]
+        streams = [eng.submit(p, max_new_tokens=8) for p in PROMPTS[:3]]
+        conc = [s.result(timeout=240) for s in streams]
+    finally:
+        eng.stop()
+    assert got == want
+    assert conc == want
