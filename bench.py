@@ -1302,6 +1302,81 @@ def measure_lm() -> dict:
                 kv_hbm_bytes_per_token=round(kv_per_tok, 1))
 
 
+def measure_fleet() -> dict:
+    """Replicated-fleet scaling (``BENCH_FLEET=N``): N echo replicas
+    (serving/fleet.py, CPU-bound ``--spin-ms`` service so added
+    replicas buy real process parallelism) behind one discovery
+    operation, fronted by a single ``balance=shortest-slack`` client.
+    The run measures admitted fps at every fleet size 1..N from the
+    same machine/weather window; ``fleet_scaling`` =
+    fps_N / (N * fps_1) is the near-linear-throughput score gated by
+    ``BENCH_GATE_FLEET_SCALING_MIN`` (CI: 0.75 at N=3 on loopback
+    CPU)."""
+    import time as _t
+
+    from nnstreamer_tpu.registry import ELEMENT, get_subplugin
+    from nnstreamer_tpu.serving.fleet import FleetLauncher
+    from nnstreamer_tpu.tensors.buffer import TensorBuffer
+
+    n = max(1, int(os.environ.get("BENCH_FLEET", "3") or 3))
+    spin_ms = float(os.environ.get("BENCH_FLEET_SPIN_MS", "20"))
+    frames = int(os.environ.get("BENCH_FLEET_FRAMES", "120"))
+    warmup = 8
+
+    def run_once(k: int) -> float:
+        fleet = FleetLauncher(replicas=k, operation=f"bench-fleet{k}",
+                              spin_ms=spin_ms).start()
+        try:
+            eps = fleet.endpoints(timeout=30.0)
+            if len(eps) < k:
+                raise RuntimeError(
+                    f"fleet of {k} never fully advertised ({eps})")
+            Client = get_subplugin(ELEMENT, "tensor_query_client")
+            cl = Client(operation=f"bench-fleet{k}",
+                        broker_port=fleet.broker_port, reliable=True,
+                        balance="shortest-slack",
+                        max_in_flight=4 * k, timeout=10.0)
+            outs = []
+            cl.srcpad.push = lambda b: outs.append(b)
+            try:
+                for i in range(warmup):  # connects + RTT priming
+                    cl.chain(cl.sinkpad, TensorBuffer(
+                        [np.full((4,), i, dtype=np.float32)], pts=i))
+                t0 = _t.monotonic()
+                for i in range(warmup, warmup + frames):
+                    cl.chain(cl.sinkpad, TensorBuffer(
+                        [np.full((4,), i, dtype=np.float32)], pts=i))
+                cl.handle_eos()
+                dt = _t.monotonic() - t0
+            finally:
+                cl.stop()
+            if len(outs) != warmup + frames:
+                raise RuntimeError(
+                    f"fleet of {k} lost frames: {len(outs)} of "
+                    f"{warmup + frames}")
+            return frames / dt
+        finally:
+            fleet.stop()
+
+    fps = [run_once(k) for k in range(1, n + 1)]
+    scaling = fps[-1] / (n * fps[0]) if n > 1 and fps[0] else 1.0
+    gate_min = float(
+        os.environ.get("BENCH_GATE_FLEET_SCALING_MIN", "0") or 0)
+    gates = {
+        "fleet_scaling": {
+            "value": round(scaling, 3),
+            "min": gate_min or None,
+            "ok": not gate_min or scaling >= gate_min,
+        },
+    }
+    gates["ok"] = gates["fleet_scaling"]["ok"]
+    return dict(metric="fleet_admitted_fps", fps=fps[-1], frames=frames,
+                fleet_replicas=n,
+                fleet_admitted_fps=[round(f, 1) for f in fps],
+                fleet_scaling=round(scaling, 3),
+                fleet_spin_ms=spin_ms, gates=gates)
+
+
 EXTRA_CONFIGS = {
     "ssd": measure_ssd,
     "pose4": measure_pose_mux,
@@ -1313,6 +1388,7 @@ EXTRA_CONFIGS = {
     "serve": measure_serve,
     "spec": measure_spec,
     "lm": measure_lm,
+    "fleet": measure_fleet,
 }
 
 
@@ -1375,6 +1451,8 @@ def main():
     if not config and os.environ.get(
             "BENCH_LM", "").strip().lower() in ("1", "true", "yes", "on"):
         config = "lm"  # BENCH_LM=1 — the paged LM-serving report
+    if not config and os.environ.get("BENCH_FLEET", "").strip():
+        config = "fleet"  # BENCH_FLEET=N — replicated-fleet scaling
     if config and config != "mobilenet":
         def _emit(r):
             extra = {k: v for k, v in r.items()
@@ -1394,7 +1472,11 @@ def main():
                   f"(choose from {', '.join(EXTRA_CONFIGS)})",
                   file=sys.stderr)
             sys.exit(2)
-        _emit(EXTRA_CONFIGS[config]())
+        r = EXTRA_CONFIGS[config]()
+        _emit(r)
+        g = r.get("gates")
+        if ENFORCE_GATES and isinstance(g, dict) and not g.get("ok", True):
+            sys.exit(1)
         return
 
     # fixed-length warmup drain (WARMUP_DRAIN buffers): compile, tunnel

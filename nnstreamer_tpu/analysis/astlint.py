@@ -117,6 +117,15 @@ These rules encode invariants this codebase has already been burned by
   read as stale history, a donated buffer is use-after-free). The
   model-side paged builders never see the arena whole; they receive
   per-layer slices from the decode scan.
+- NNS119: a hard-coded ``host:port`` string literal outside
+  ``query/discovery.py``, config modules, and tests. A replicated fleet
+  (serving/fleet.py) moves endpoints at every deploy — replicas bind
+  ephemeral ports and re-advertise through the broker — so a baked-in
+  endpoint silently pins code to one replica and bypasses discovery,
+  the breaker, and the balancer. Endpoints belong in element properties
+  (``servers=``/``operation=``), CLI flags, or discovery ads; the
+  discovery module itself and configuration defaults are the audited
+  homes for literal endpoints.
 
 Findings are suppressed per-line with::
 
@@ -149,6 +158,12 @@ _METRIC_NAME_RE = re.compile(r"^nns_[a-z0-9]+(_[a-z0-9]+)+$")
 #: socket methods that block on the network
 _SOCKET_BLOCKING = {"recv", "recvfrom", "recv_into", "accept", "connect",
                     "sendall", "sendto"}
+
+#: NNS119: a full-string ``host:port`` endpoint literal. The host part
+#: must contain a letter or a dot so times ("12:30") and ratios never
+#: match; the port is 2-5 digits so drive letters ("C:1") stay out
+_HOSTPORT_RE = re.compile(
+    r"^[A-Za-z0-9_.\-]*[A-Za-z.][A-Za-z0-9_.\-]*:\d{2,5}$")
 
 #: sync-forcing callables by dotted name (NNS107): each one blocks the
 #: caller until outstanding device work retires (or copies D2H, which
@@ -307,6 +322,15 @@ class _FileLinter(ast.NodeVisitor):
         #: NNS118 exempts the block pool itself — the one audited home
         #: for direct KV-arena indexing
         self._in_kvpool = Path(rel).name == "kvpool.py"
+        #: NNS119 exempts the discovery module (the audited home for
+        #: endpoint strings), config modules, and test code
+        parts = Path(rel).parts
+        fname = Path(rel).name
+        self._nns119_exempt = (
+            fname == "discovery.py"
+            or fname in ("config.py", "settings.py", "conftest.py")
+            or "tests" in parts
+            or fname.startswith("test_"))
 
     # -- helpers -------------------------------------------------------------
     def emit(self, code: str, node: ast.AST, message: str,
@@ -408,6 +432,10 @@ class _FileLinter(ast.NodeVisitor):
 
     def visit_Subscript(self, node: ast.Subscript) -> None:
         self._rule_nns118(node)
+        self.generic_visit(node)
+
+    def visit_Constant(self, node: ast.Constant) -> None:
+        self._rule_nns119(node)
         self.generic_visit(node)
 
     def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
@@ -712,6 +740,24 @@ class _FileLinter(ast.NodeVisitor):
             hint="go through BlockPool (scatter_prefill/copy_block) or "
                  "the models/transformer.py paged builders, which take "
                  "per-layer slices — or justify with a pragma")
+
+    def _rule_nns119(self, node: ast.Constant) -> None:
+        if self._nns119_exempt:
+            return
+        if not isinstance(node.value, str):
+            return
+        if not _HOSTPORT_RE.match(node.value):
+            return
+        self.emit(
+            "NNS119", node,
+            f"hard-coded endpoint literal {node.value!r} — fleet "
+            f"replicas bind ephemeral ports and move at every deploy, "
+            f"so a baked-in host:port pins this code to one replica and "
+            f"bypasses discovery, the circuit breaker, and the "
+            f"shortest-slack balancer",
+            hint="take the endpoint from an element property (servers=/"
+                 "operation=), a CLI flag, or a discovery ad "
+                 "(query/discovery.py) — or justify with a pragma")
 
     def _rule_nns114_deque(self, node: ast.Call, dotted: str) -> None:
         if not self._in_obs:

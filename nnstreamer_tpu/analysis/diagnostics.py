@@ -92,6 +92,16 @@ CODE_TABLE: Dict[str, str] = {
               "(NamedSharding/PositionalSharding/shard_map/pjit anywhere "
               "else scatters placement decisions that parallel/serve.py "
               "keeps auditable — pass a mesh spec or plan instead)",
+    "NNS118": "direct subscript of a paged KV arena outside "
+              "serving/kvpool.py (block refcounts, buffer donation, and "
+              "the zero-block/sentinel invariants live in the pool; a "
+              "raw arena index elsewhere can read a freed block's stale "
+              "bytes or write through a donated buffer)",
+    "NNS119": "hard-coded host:port endpoint literal outside "
+              "query/discovery.py, config modules, and tests (fleet "
+              "replicas bind ephemeral ports and move at every deploy — "
+              "a baked-in endpoint pins code to one replica and "
+              "bypasses discovery, the breaker, and the balancer)",
     "NNS199": "nns-lint pragma without a justification",
     # -- concurrency (whole-program analysis) --------------------------------
     "NNS201": "access to a lock-guarded attribute outside the lock (the "

@@ -12,15 +12,18 @@ to its client by client-id meta). Client failover walks a server list
 
 from __future__ import annotations
 
+import os
+import select
 import socket
 import threading
 import time
 import uuid
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from nnstreamer_tpu.obs import distributed as _dist
 from nnstreamer_tpu.obs import timeline as _timeline
 from nnstreamer_tpu.pipeline import faults as _faults
+from nnstreamer_tpu.query import balance as _bal
 from nnstreamer_tpu.pipeline.element import (
     CapsEvent,
     Element,
@@ -33,6 +36,36 @@ from nnstreamer_tpu.query import resilience as _res
 from nnstreamer_tpu.query.server import QueryServer
 from nnstreamer_tpu.registry import ELEMENT, subplugin
 from nnstreamer_tpu.tensors.types import TensorFormat, TensorsConfig
+
+
+class _BChannel:
+    """One balance-mode connection to one replica endpoint: its socket,
+    its dt1 grant, and the entries currently routed to it (send order).
+
+    Reconnects are sticky: a failed channel retries ITS endpoint with
+    bounded backoff before its entries are rerouted, so resends land in
+    that replica's (possibly checkpoint-restored) dedup window and stay
+    exactly-once across a rolling restart; only after ``max_retry``
+    consecutive failures do the survivors hedge to a sibling replica."""
+
+    __slots__ = ("endpoint", "sock", "dt1", "pending", "failures",
+                 "next_attempt_t")
+
+    def __init__(self, endpoint: Tuple[str, int]):
+        self.endpoint = endpoint
+        self.sock: Optional[socket.socket] = None
+        self.dt1 = False
+        self.pending: List[_res.PendingEntry] = []
+        self.failures = 0          # consecutive connect/stall failures
+        self.next_attempt_t = 0.0  # monotonic gate on the next reconnect
+
+    def kill(self) -> None:
+        sock, self.sock = self.sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
 
 
 @subplugin(ELEMENT, "tensor_query_client")
@@ -100,6 +133,19 @@ class TensorQueryClient(Element):
         # read-only counter: frames the REMOTE end expired (deadline
         # propagation) — intentional sheds, not losses
         "frames_expired": 0,
+        # -- fleet balancing (query/balance.py) --------------------------
+        # "shortest-slack" (requires reliable=true) keeps a channel per
+        # live endpoint and routes each frame to the one with the lowest
+        # expected completion time (per-endpoint RTT EWMA + local
+        # in-flight + the load block of refreshed discovery ads).
+        # Results deliver downstream in send order. "off" (default, also
+        # forced by NNSTPU_FLEET=0) keeps the single-connection path
+        # byte-identical to pre-fleet builds.
+        "balance": "off",
+        # >0 ages discovery ads out of the balancer's candidate list
+        # when a replica stops refreshing (pair with the serversrc's
+        # advertise-interval-s; 0 trusts retained ads forever)
+        "discovery_stale_s": 0.0,
     }
 
     def __init__(self, name=None, **props):
@@ -121,11 +167,21 @@ class TensorQueryClient(Element):
         self._r_next_id = 1  # monotone per-instance request id
         self._r_pending: List[_res.PendingEntry] = []
         self._r_breakers: dict = {}  # (host, port) → CircuitBreaker
-        self._r_stats = _res.EndpointStats()
+        #: (host, port) → EndpointStats — per-endpoint like the breakers,
+        #: so hedge timeouts and balancer scores use the latency
+        #: distribution of the replica actually being talked to
+        self._r_stats: Dict[Tuple[str, int], _res.EndpointStats] = {}
         self._r_endpoint: Optional[Tuple[str, int]] = None
         #: this connection granted the dt1 distributed-trace feature in
         #: its HELLO echo — only then do we speak TRANSFER_EX2
         self._r_dt1 = False
+        # -- balance-mode state (query/balance.py) -----------------------
+        self._b_channels: Dict[Tuple[str, int], _BChannel] = {}
+        self._b_pending: Dict[int, _res.PendingEntry] = {}  # req_id →
+        self._b_results: Dict[int, tuple] = {}  # req_id → (result, entry)
+        self._b_done_ids: set = set()  # completed without a result
+        self._b_deliver_next: Optional[int] = None  # in-order watermark
+        self._b_discovery = None  # persistent ServerDiscovery (balance)
 
     def set_property(self, key: str, value) -> None:
         if key.replace("-", "_") in ("frames_dropped", "frames_expired"):
@@ -134,12 +190,18 @@ class TensorQueryClient(Element):
 
     def _drop_pending_locked(self) -> int:
         """Clear in-flight requests, bumping the frames-dropped counter."""
-        n = len(self._pending) + len(self._r_pending)
+        n = len(self._pending) + len(self._r_pending) + len(self._b_pending)
         if n:
             self._pending.clear()
             self._r_pending.clear()
+            self._b_pending.clear()
             self._props["frames_dropped"] = \
                 int(self._props.get("frames_dropped", 0)) + n
+        for ch in self._b_channels.values():
+            ch.pending.clear()
+        self._b_results.clear()
+        self._b_done_ids.clear()
+        self._b_deliver_next = None
         return n
 
     def _server_list(self) -> List[Tuple[str, int]]:
@@ -176,7 +238,6 @@ class TensorQueryClient(Element):
 
     def _connect_one(self, host: str, port: int) -> None:
         """One connection attempt on the configured wire."""
-        caps_repr = repr(self.sinkpad.caps) if self.sinkpad.caps else ""
         timeout = float(self.get_property("timeout"))
         if self._refwire():
             from nnstreamer_tpu.query import refwire as R
@@ -204,6 +265,19 @@ class TensorQueryClient(Element):
                                   rc.server_caps)
             self._sock = rc  # truthy connection marker for chain()
             return
+        sock, cid = self._open_nnstpu(host, port)
+        if cid is not None:
+            self._client_id = cid
+        self._sock = sock
+
+    def _open_nnstpu(self, host: str,
+                     port: int) -> Tuple[socket.socket, Optional[int]]:
+        """Classic-wire connect + handshake, returning the fresh socket
+        and the server-assigned client id (balance mode opens one of
+        these per endpoint; the single-connection paths assign it to
+        ``self._sock``)."""
+        caps_repr = repr(self.sinkpad.caps) if self.sinkpad.caps else ""
+        timeout = float(self.get_property("timeout"))
         sock = P.connect(host, port, timeout=timeout)
         P.send_msg(sock, P.Cmd.REQUEST_INFO, caps_repr.encode())
         cmd, payload = P.recv_msg(sock)
@@ -212,9 +286,8 @@ class TensorQueryClient(Element):
         if cmd is not P.Cmd.APPROVE:
             raise P.QueryProtocolError(f"bad handshake reply {cmd}")
         cmd, payload = P.recv_msg(sock)
-        if cmd is P.Cmd.CLIENT_ID:
-            self._client_id = int(payload.decode())
-        self._sock = sock
+        cid = int(payload.decode()) if cmd is P.Cmd.CLIENT_ID else None
+        return sock, cid
 
     def _connect(self):
         """Connect with failover across the server list (reference
@@ -249,6 +322,17 @@ class TensorQueryClient(Element):
                 except OSError:
                     pass
                 self._sock = None
+            for ch in self._b_channels.values():
+                if ch.sock is not None:
+                    try:
+                        P.send_msg(ch.sock, P.Cmd.BYE)
+                    except OSError:
+                        pass
+                ch.kill()
+            self._b_channels.clear()
+            if self._b_discovery is not None:
+                self._b_discovery.close()
+                self._b_discovery = None
             # in-flight requests die with the connection — a restart must
             # not pair old (pts, meta) with new results
             self._drop_pending_locked()
@@ -358,6 +442,13 @@ class TensorQueryClient(Element):
                 endpoint=f"{host}:{port}")
         return br
 
+    def _r_stat(self, host: str, port: int) -> _res.EndpointStats:
+        key = (host, port)
+        st = self._r_stats.get(key)
+        if st is None:
+            st = self._r_stats[key] = _res.EndpointStats()
+        return st
+
     def _r_make_entry(self, buf) -> _res.PendingEntry:
         deadline_t = None
         if self.get_property("propagate_deadline"):
@@ -369,12 +460,18 @@ class TensorQueryClient(Element):
         return _res.PendingEntry(req_id, buf.pts, dict(buf.meta),
                                  P.pack_buffer(buf), deadline_t=deadline_t)
 
-    def _r_send_entry(self, entry: _res.PendingEntry) -> None:
+    def _r_send_entry(self, entry: _res.PendingEntry,
+                      ch: Optional["_BChannel"] = None) -> None:
         """Send (or resend) one entry as TRANSFER_EX. The slack is
         recomputed from the entry's deadline at every send, so a resend
-        carries the budget that is actually left."""
+        carries the budget that is actually left. ``ch`` routes the send
+        over a balance-mode channel; None (default) is the classic
+        single-connection path, byte-identical to pre-fleet builds."""
+        sock = self._sock if ch is None else ch.sock
+        dt1 = self._r_dt1 if ch is None else ch.dt1
+        entry.endpoint = self._r_endpoint if ch is None else ch.endpoint
         now = time.monotonic()
-        if self._r_dt1:
+        if dt1:
             trace_id = entry.meta.get(_timeline.TRACE_SEQ_META)
             entry.sent_wall = _dist.wall_now()
             cmd = P.Cmd.TRANSFER_EX2
@@ -394,24 +491,27 @@ class TensorQueryClient(Element):
                 entry.sent_t = now
                 return  # swallowed; the recv timeout path resends it
             if act == "disconnect":
-                self._kill_sock()
+                if ch is None:
+                    self._kill_sock()
+                else:
+                    ch.kill()
                 raise OSError("injected fault: query.send disconnect")
             if act == "corrupt":
                 # guaranteed-detectable: the server's unpack runs out of
                 # bytes, forgets the dedup entry, and kicks us — the
                 # resend after reconnect re-invokes exactly once
                 payload = payload[:max(1, len(payload) // 2)]
-        P.send_msg(self._sock, cmd, payload)
+        P.send_msg(sock, cmd, payload)
         entry.sent_t = now
 
-    def _r_hello(self) -> None:
+    def _hello_on(self, sock) -> bool:
+        """HELLO handshake on one connection; returns the dt1 grant."""
         window = max(1, int(self.get_property("max_in_flight")))
-        self._r_dt1 = False
-        P.send_msg(self._sock, P.Cmd.HELLO,
+        P.send_msg(sock, P.Cmd.HELLO,
                    f"{self._r_instance}:{max(64, window * 8)}"
                    f"{_dist.hello_offer()}".encode())
         try:
-            cmd, payload = P.recv_msg(self._sock)
+            cmd, payload = P.recv_msg(sock)
         except socket.timeout:
             raise P.QueryProtocolError(
                 "server did not acknowledge HELLO — reliable mode needs "
@@ -421,7 +521,11 @@ class TensorQueryClient(Element):
             raise P.QueryProtocolError(
                 f"bad HELLO reply {cmd} — reliable mode needs a "
                 f"tensor_query_serversrc started with reliable=true")
-        self._r_dt1 = _dist.hello_accepts(payload)
+        return _dist.hello_accepts(payload)
+
+    def _r_hello(self) -> None:
+        self._r_dt1 = False
+        self._r_dt1 = self._hello_on(self._sock)
 
     def _r_resend_pending(self) -> None:
         """Resend the undelivered suffix in order after a reconnect.
@@ -468,6 +572,9 @@ class TensorQueryClient(Element):
                 continue
             try:
                 self._connect_one(host, port)
+                # stamped before the resends so every entry's RTT
+                # observation credits the endpoint it was sent to
+                self._r_endpoint = (host, port)
                 self._r_hello()
                 self._r_resend_pending()
             except (OSError, P.QueryProtocolError) as e:
@@ -480,7 +587,6 @@ class TensorQueryClient(Element):
                 policy.sleep(attempt)
                 continue
             breaker.record_success()
-            self._r_endpoint = (host, port)
             return
         raise P.QueryProtocolError(
             f"all query servers unreachable: {last_err}")
@@ -545,9 +651,14 @@ class TensorQueryClient(Element):
         tl = _timeline.ACTIVE
         while len(self._r_pending) >= min_pending:
             hedging = hedge_ms > 0.0 and failures == 0
-            recv_t = min(timeout,
-                         self._r_stats.hedge_timeout(hedge_ms / 1e3)) \
-                if hedging else timeout
+            if hedging:
+                st = self._r_stat(*self._r_endpoint) \
+                    if self._r_endpoint is not None else None
+                recv_t = min(timeout,
+                             st.hedge_timeout(hedge_ms / 1e3)
+                             if st is not None else hedge_ms / 1e3)
+            else:
+                recv_t = timeout
             try:
                 self._r_ensure_connected()
                 cmd, payload = self._r_recv(recv_t)
@@ -583,8 +694,9 @@ class TensorQueryClient(Element):
                 entry = self._r_pop_pending(req_id)
                 if entry is None:
                     continue  # dedup replay of an already-delivered result
-                if entry.sent_t:
-                    self._r_stats.observe(time.monotonic() - entry.sent_t)
+                if entry.sent_t and entry.endpoint is not None:
+                    self._r_stat(*entry.endpoint).observe(
+                        time.monotonic() - entry.sent_t)
                 done.append((P.unpack_buffer(body), entry))
                 failures = 0
             elif cmd is P.Cmd.RESULT_EX2:
@@ -595,7 +707,9 @@ class TensorQueryClient(Element):
                     continue  # dedup replay of an already-delivered result
                 now = time.monotonic()
                 if entry.sent_t:
-                    self._r_stats.observe(now - entry.sent_t)
+                    if entry.endpoint is not None:
+                        self._r_stat(*entry.endpoint).observe(
+                            now - entry.sent_t)
                     # splice the remote span vector into this frame's
                     # ledger, anchored inside our own RTT window
                     _dist.splice_remote(
@@ -651,9 +765,368 @@ class TensorQueryClient(Element):
             raise err  # after pushing the good results collected so far
         return ret
 
+    # -- fleet balancing (query/balance.py) ---------------------------------
+    def _balance_on(self) -> bool:
+        """True when the shortest-slack balancer owns this client's
+        routing. ``balance=off`` (default) and the ``NNSTPU_FLEET=0``
+        kill switch both leave the classic single-connection paths
+        untouched — no balance state is ever created."""
+        mode = str(self.get_property("balance") or _bal.MODE_OFF)
+        if mode in ("", _bal.MODE_OFF):
+            return False
+        if os.environ.get("NNSTPU_FLEET", "").strip() == "0":
+            return False
+        if mode != _bal.MODE_SHORTEST_SLACK:
+            raise FlowError(
+                f"tensor_query_client: unknown balance mode {mode!r} "
+                f"(off | shortest-slack)")
+        return True
+
+    def _b_server_list(self) -> List[Tuple[str, int]]:
+        """Candidate endpoints, refreshed per route. With an operation,
+        the discovery subscription is kept open (unlike the classic
+        per-connect lookup) so refreshed ads keep delivering fresh load
+        blocks and stale replicas age out mid-stream."""
+        operation = self.get_property("operation")
+        if not operation:
+            return self._server_list()  # static servers=/host:port list
+        if self._b_discovery is None:
+            from nnstreamer_tpu.query.discovery import ServerDiscovery
+
+            stale = float(self.get_property("discovery_stale_s") or 0.0)
+            self._b_discovery = ServerDiscovery(
+                self.get_property("broker_host"),
+                int(self.get_property("broker_port")),
+                str(operation), stale_s=stale if stale > 0 else None)
+            return self._b_discovery.wait_servers(
+                timeout=float(self.get_property("timeout")))
+        found = self._b_discovery.servers_now()
+        if not found:
+            found = self._b_discovery.wait_servers(
+                timeout=float(self.get_property("timeout")))
+        return found
+
+    def _b_channel(self, endpoint: Tuple[str, int]) -> _BChannel:
+        ch = self._b_channels.get(endpoint)
+        if ch is None:
+            ch = self._b_channels[endpoint] = _BChannel(endpoint)
+        return ch
+
+    def _b_candidates(self, exclude=()):
+        """(endpoint, rtt, inflight, load) rows for the policy ranking —
+        breaker-open endpoints excluded here (the policy stays pure)."""
+        cands = []
+        for host, port in self._b_server_list():
+            ep = (host, port)
+            if ep in exclude:
+                continue
+            if not self._r_breaker(host, port).allow():
+                continue
+            ch = self._b_channels.get(ep)
+            raw = self._b_discovery.load(host, port) \
+                if self._b_discovery is not None else None
+            load = _bal.parse_ad_load({"load": raw}) if raw else None
+            cands.append((ep, self._r_stat(host, port).ewma(),
+                          len(ch.pending) if ch is not None else 0, load))
+        return cands
+
+    def _b_ensure_channel(self, ch: _BChannel) -> None:
+        if ch.sock is not None:
+            return
+        sock, _cid = self._open_nnstpu(*ch.endpoint)
+        try:
+            ch.dt1 = self._hello_on(sock)
+        except (OSError, P.QueryProtocolError):
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
+        ch.sock = sock
+
+    def _b_channel_failure(self, ch: _BChannel, err: Exception) -> None:
+        backoff_s = float(self.get_property("reconnect_backoff_ms")
+                          or 50.0) / 1e3
+        self._r_breaker(*ch.endpoint).record_failure()
+        ch.kill()
+        ch.failures += 1
+        ch.next_attempt_t = time.monotonic() + min(
+            2.0, backoff_s * (2 ** min(ch.failures - 1, 6)))
+        self.log.warning("fleet channel %s:%d error: %s (failure %d)",
+                         ch.endpoint[0], ch.endpoint[1], err, ch.failures)
+
+    def _b_route(self, entry: _res.PendingEntry, exclude=()) -> None:
+        """Send one entry to the best-scoring endpoint, walking the
+        ranking (then backing off and re-resolving the server list) on
+        failure. Raises only when no replica accepts the frame within
+        ``max_retry`` rounds."""
+        policy = _res.RetryPolicy(
+            base_ms=float(self.get_property("reconnect_backoff_ms")
+                          or 50.0),
+            key=f"{self.name}:lb")
+        limit = max(1, int(self.get_property("max_retry")))
+        last_err: Optional[Exception] = None
+        for attempt in range(1, limit + 1):
+            ranked = _bal.rank(self._b_candidates(exclude=exclude))
+            if not ranked and exclude:
+                # every sibling is breaker-open or gone — the excluded
+                # (draining) endpoint beats dropping the frame
+                ranked = _bal.rank(self._b_candidates())
+            for sc, ep in ranked:
+                ch = self._b_channel(ep)
+                if ch.sock is None and \
+                        time.monotonic() < ch.next_attempt_t:
+                    continue  # endpoint still in reconnect backoff
+                try:
+                    self._b_ensure_channel(ch)
+                    self._r_send_entry(entry, ch=ch)
+                except (OSError, P.QueryProtocolError) as e:
+                    last_err = e
+                    self._b_channel_failure(ch, e)
+                    continue
+                self._r_breaker(*ep).record_success()
+                ch.pending.append(entry)
+                self._b_pending[entry.req_id] = entry
+                _bal.note_route(ep, sc)
+                return
+            policy.sleep(attempt)
+        raise P.QueryProtocolError(
+            f"fleet: no replica accepted req {entry.req_id}: {last_err}")
+
+    def _b_recv(self, ch: _BChannel, timeout: float):
+        fi = _faults.ACTIVE
+        if fi is not None:
+            act = fi.action("query.recv")
+            if act == "disconnect":
+                ch.kill()
+                raise OSError("injected fault: query.recv disconnect")
+            if act is not None:
+                raise P.QueryProtocolError(
+                    f"injected fault: query.recv {act}")
+        ch.sock.settimeout(max(0.001, timeout))
+        return P.recv_msg(ch.sock)
+
+    def _b_pop(self, req_id: int) -> Optional[_res.PendingEntry]:
+        """Claim a completed request id — None for a duplicate (the
+        hedged twin already answered; ignore, exactly-once holds)."""
+        entry = self._b_pending.pop(req_id, None)
+        if entry is None:
+            return None
+        for other in self._b_channels.values():
+            for i, e in enumerate(other.pending):
+                if e.req_id == req_id:
+                    other.pending.pop(i)
+                    break
+        return entry
+
+    def _b_observe(self, ch: _BChannel, entry: _res.PendingEntry,
+                   now: float) -> None:
+        ep = entry.endpoint or ch.endpoint
+        self._r_stat(*ep).observe(now - entry.sent_t)
+
+    def _b_handle_msg(self, ch: _BChannel, cmd, payload) -> bool:
+        """Apply one received message; True when it completed a frame."""
+        tl = _timeline.ACTIVE
+        if cmd is P.Cmd.RESULT_EX:
+            req_id, _slack, body = P.unpack_ext(payload)
+            entry = self._b_pop(req_id)
+            if entry is None:
+                return False  # dedup replay of a delivered result
+            if entry.sent_t:
+                self._b_observe(ch, entry, time.monotonic())
+            self._b_results[req_id] = (P.unpack_buffer(body), entry)
+            ch.failures = 0
+            return True
+        if cmd is P.Cmd.RESULT_EX2:
+            req_id, _slack, _tid, _stamp, blob, body = \
+                P.unpack_ext2(payload)
+            entry = self._b_pop(req_id)
+            if entry is None:
+                return False  # dedup replay of a delivered result
+            now = time.monotonic()
+            if entry.sent_t:
+                self._b_observe(ch, entry, now)
+                _dist.splice_remote(
+                    tl, entry.meta.get(_timeline.TRACE_SEQ_META),
+                    entry.sent_t, now, entry.sent_wall,
+                    _dist.unpack_span_blob(blob))
+            self._b_results[req_id] = (P.unpack_buffer(body), entry)
+            ch.failures = 0
+            return True
+        if cmd is P.Cmd.EXPIRED:
+            req_id, _slack, _body = P.unpack_ext(payload)
+            entry = self._b_pop(req_id)
+            ch.failures = 0
+            if entry is None:
+                return False
+            self._b_done_ids.add(req_id)
+            self._props["frames_expired"] = \
+                int(self._props.get("frames_expired", 0)) + 1
+            if tl is not None:
+                tl.mark("net_expired",
+                        entry.meta.get(_timeline.TRACE_SEQ_META),
+                        track="net", req_id=req_id)
+            self.log.info("frame pts=%s expired remotely (req %d)",
+                          entry.pts, req_id)
+            return True
+        if cmd is P.Cmd.PING:
+            return False
+        self._b_channel_failure(ch, P.QueryProtocolError(
+            f"unexpected {cmd} in balance mode"))
+        return False
+
+    def _b_stall_timeout(self, ch: _BChannel) -> float:
+        hedge_ms = float(self.get_property("hedge_ms") or 0.0)
+        if hedge_ms > 0.0:
+            return self._r_stat(*ch.endpoint).hedge_timeout(
+                hedge_ms / 1e3)
+        return float(self.get_property("timeout"))
+
+    def _b_check_channels(self) -> None:
+        """Recovery pass: stalled live channels are killed (their next
+        pass reconnects), dead channels reconnect sticky and resend, and
+        a channel past ``max_retry`` failures hedges its survivors to
+        sibling replicas."""
+        limit = max(1, int(self.get_property("max_retry")))
+        m = _res.metrics()
+        for ch in list(self._b_channels.values()):
+            if not ch.pending:
+                continue
+            now = time.monotonic()
+            if ch.sock is not None:
+                oldest = min((e.sent_t for e in ch.pending if e.sent_t),
+                             default=0.0)
+                stall_t = self._b_stall_timeout(ch)
+                if oldest and now - oldest > stall_t:
+                    self._b_channel_failure(ch, TimeoutError(
+                        f"no result within {stall_t:.3f}s"))
+                continue
+            if ch.failures > limit:
+                entries, ch.pending = ch.pending, []
+                ch.failures = 0  # fresh slate if the endpoint returns
+                for e in entries:
+                    m["hedges"].inc()
+                    _bal.lb_metrics()["reroutes"].inc()
+                    try:
+                        self._b_route(e, exclude=(ch.endpoint,))
+                    except P.QueryProtocolError:
+                        # honest last resort: account the frame dropped
+                        self._b_pending.pop(e.req_id, None)
+                        self._b_done_ids.add(e.req_id)
+                        self._props["frames_dropped"] = \
+                            int(self._props.get("frames_dropped", 0)) + 1
+                continue
+            if now < ch.next_attempt_t:
+                continue
+            try:
+                self._b_ensure_channel(ch)
+                for e in ch.pending:  # sticky resend, in send order
+                    self._r_send_entry(e, ch=ch)
+                    m["retries"].inc()
+            except (OSError, P.QueryProtocolError) as e:
+                self._b_channel_failure(ch, e)
+
+    def _b_flush_ready(self) -> List[tuple]:
+        """The in-order deliverable prefix: results release downstream
+        strictly in send order, so balance mode keeps the classic
+        single-connection ordering contract across N channels."""
+        out: List[tuple] = []
+        while self._b_deliver_next is not None:
+            rid = self._b_deliver_next
+            got = self._b_results.pop(rid, None)  # atomic claim
+            if got is not None:
+                out.append(got)
+            elif rid in self._b_done_ids or (
+                    rid < self._r_next_id
+                    and rid not in self._b_pending):
+                # expired/dropped (or gone without a trace) — skip it
+                # rather than wedge the stream; discard is a no-op for
+                # ids that were never in the done set
+                self._b_done_ids.discard(rid)
+            else:
+                break
+            self._b_deliver_next = rid + 1
+        return out
+
+    def _b_drain_locked(self, min_pending: int):
+        """Receive across every live channel until fewer than
+        ``min_pending`` frames remain in flight (caller holds the lock).
+        Returns ``(done, err)`` with ``done`` the in-order deliverable
+        prefix; ``err`` reports exhaustion after the whole fleet made no
+        progress for ``timeout * (max_retry + 1)``, with the remaining
+        frames dropped and counted (the honest last resort)."""
+        err: Optional[Exception] = None
+        timeout = float(self.get_property("timeout"))
+        limit = max(1, int(self.get_property("max_retry")))
+        deadline = time.monotonic() + timeout * (limit + 1)
+        while len(self._b_pending) >= min_pending:
+            socks = {ch.sock: ch for ch in self._b_channels.values()
+                     if ch.sock is not None and ch.pending}
+            progress = False
+            if socks:
+                try:
+                    readable, _, _ = select.select(
+                        list(socks), [], [], 0.02)
+                except (OSError, ValueError):
+                    readable = []  # a racing close invalidated an fd
+                for s in readable:
+                    ch = socks[s]
+                    try:
+                        cmd, payload = self._b_recv(ch, timeout)
+                    except (socket.timeout, OSError,
+                            P.QueryProtocolError) as e:
+                        self._b_channel_failure(ch, e)
+                        continue
+                    if self._b_handle_msg(ch, cmd, payload):
+                        progress = True
+            self._b_check_channels()
+            if progress:
+                deadline = time.monotonic() + timeout * (limit + 1)
+            else:
+                if time.monotonic() > deadline:
+                    err = TimeoutError(
+                        f"{self.name}: fleet made no progress within "
+                        f"{timeout * (limit + 1):.1f}s "
+                        f"({len(self._b_pending)} frame(s) in flight)")
+                    for rid in list(self._b_pending):
+                        self._b_pop(rid)
+                        self._b_done_ids.add(rid)
+                        self._props["frames_dropped"] = \
+                            int(self._props.get("frames_dropped", 0)) + 1
+                    break
+                if not socks:
+                    time.sleep(0.01)  # whole fleet down: wait on backoff
+        return self._b_flush_ready(), err
+
+    def _chain_balanced(self, buf):
+        if self._refwire():
+            raise FlowError(
+                "tensor_query_client: balance requires wire=nnstpu")
+        window = max(1, int(self.get_property("max_in_flight")))
+        with self._lock:
+            entry = self._r_make_entry(buf)
+            if self._b_deliver_next is None:
+                self._b_deliver_next = entry.req_id
+            self._b_route(entry)
+            done, err = self._b_drain_locked(min_pending=window)
+        ret = FlowReturn.OK
+        for result, done_entry in done:
+            ret = self._push_result(result, done_entry.pts,
+                                    done_entry.meta)
+        if err is not None:
+            raise err  # after pushing the good results collected so far
+        return ret
+
     def chain(self, pad, buf):
         if self.get_property("reliable"):
+            if self._balance_on():
+                return self._chain_balanced(buf)
             return self._chain_resilient(buf)
+        if self._balance_on():
+            raise FlowError(
+                "tensor_query_client: balance=shortest-slack requires "
+                "reliable=true (request ids + the server dedup window "
+                "are what make re-routed frames exactly-once)")
         window = max(1, int(self.get_property("max_in_flight")))
         if window == 1:
             # synchronous round trip with per-frame resend on reconnect
@@ -737,7 +1210,10 @@ class TensorQueryClient(Element):
         silently instead of failing the pipeline."""
         if self.get_property("reliable"):
             with self._lock:
-                done, err = self._r_drain_locked(min_pending=1)
+                if self._balance_on():
+                    done, err = self._b_drain_locked(min_pending=1)
+                else:
+                    done, err = self._r_drain_locked(min_pending=1)
             for result, entry in done:
                 self._push_result(result, entry.pts, entry.meta)
             if err is not None:
@@ -787,6 +1263,10 @@ class TensorQueryServerSrc(SourceElement):
         # advertised through the broker so fleet federation
         # (obs/distributed.py) can discover its scrape targets
         "metrics_port": 0,
+        # > 0: re-publish the discovery ad on this cadence, each refresh
+        # carrying a live load block (ingress depth + SLO-scheduler slack)
+        # for shortest-slack clients; 0 keeps the classic publish-once ad
+        "advertise_interval_s": 0.0,
     }
 
     _SERVERS = {}
@@ -814,6 +1294,8 @@ class TensorQueryServerSrc(SourceElement):
         if operation:
             from nnstreamer_tpu.query.discovery import ServerAdvertiser
 
+            refresh_s = float(
+                self.get_property("advertise_interval_s") or 0.0)
             self._advertiser = ServerAdvertiser(
                 self.get_property("broker_host"),
                 int(self.get_property("broker_port")),
@@ -821,8 +1303,34 @@ class TensorQueryServerSrc(SourceElement):
                 self.get_property("advertise_host"),
                 self.server.port,
                 metrics_port=int(self.get_property("metrics_port") or 0),
+                load_fn=self._ad_load if refresh_s > 0 else None,
+                refresh_s=refresh_s,
             )
             self._advertiser.publish()
+
+    def _ad_load(self) -> Optional[dict]:
+        """Live load block for the refreshed discovery ad: ingress queue
+        depth, plus the SLO scheduler's service estimate and the slack a
+        newly admitted frame would have left (budget minus the expected
+        wait behind the queued work). Scheduler-less replicas advertise
+        depth alone — the balancer treats missing fields as unknown."""
+        server = self.server
+        if server is None:
+            return None
+        depth = int(server.incoming.qsize())
+        load: dict = {"queue_depth": depth}
+        sched = getattr(self.pipeline, "_slo_scheduler", None) \
+            if self.pipeline is not None else None
+        if sched is not None:
+            snap = sched.snapshot()
+            svc = float(snap.get("service_time_ms") or 0.0)
+            budget = float(snap.get("budget_ms") or 0.0)
+            if svc > 0.0:
+                load["service_ms"] = svc
+                if budget > 0.0:
+                    load["slack_headroom_ms"] = \
+                        budget - (depth + 1) * svc
+        return load
 
     def stop(self):
         if self._advertiser is not None:

@@ -48,10 +48,18 @@ def make_broker_client(broker_host: str, broker_port: int):
 
 class ServerAdvertiser:
     """Server side: publish (retained) this server's endpoint for an
-    operation (reference tensor_query_hybrid_publish)."""
+    operation (reference tensor_query_hybrid_publish).
+
+    With ``refresh_s`` > 0 the ad is re-published on that cadence (meant
+    to ride under a client's ``stale_s`` TTL, so a live replica never
+    ages out), each refresh carrying a fresh ``ts`` and — when a
+    ``load_fn`` is wired — a fresh ``load`` block (queue depth / slack
+    headroom from the replica's scheduler) for the shortest-slack
+    balancer. ``refresh_s`` 0 keeps the classic publish-once behavior."""
 
     def __init__(self, broker_host: str, broker_port: int, operation: str,
-                 host: str, port: int, metrics_port: Optional[int] = None):
+                 host: str, port: int, metrics_port: Optional[int] = None,
+                 load_fn=None, refresh_s: float = 0.0):
         self.client = make_broker_client(broker_host, broker_port)
         self.topic = f"{TOPIC_PREFIX}{operation}/{host}:{port}"
         wall_ts = time.time()  # advertised epoch timestamp, read by peers
@@ -60,12 +68,51 @@ class ServerAdvertiser:
             # fleet federation (obs/distributed.py) scrapes replicas that
             # advertise where their /metrics.json lives
             self.endpoint["metrics_port"] = int(metrics_port)
+        #: () → load dict for the ad's ``load`` block (or None to omit);
+        #: see query/balance.py:parse_ad_load for the field contract
+        self.load_fn = load_fn
+        self.refresh_s = float(refresh_s or 0.0)
+        self._stop = threading.Event()
+        self._refresher: Optional[threading.Thread] = None
+
+    def _payload(self) -> bytes:
+        ad = dict(self.endpoint)
+        wall_ts = time.time()  # refreshed stamp: peers judge staleness
+        ad["ts"] = wall_ts
+        if self.load_fn is not None:
+            try:
+                load = self.load_fn()
+            except Exception as e:  # noqa: BLE001 — an ad without a load
+                # block is still a valid ad; the balancer falls back to
+                # RTT-only for this endpoint instead of losing it
+                log.warning("advertiser load_fn failed: %s", e)
+                load = None
+            if load:
+                ad["load"] = load
+        return json.dumps(ad).encode()
 
     def publish(self) -> None:
-        self.client.publish(self.topic,
-                            json.dumps(self.endpoint).encode(), retain=True)
+        self.client.publish(self.topic, self._payload(), retain=True)
+        if self.refresh_s > 0 and self._refresher is None:
+            self._refresher = threading.Thread(
+                target=self._refresh_loop, name="discovery-refresh",
+                daemon=True)
+            self._refresher.start()
+
+    def _refresh_loop(self) -> None:
+        while not self._stop.wait(self.refresh_s):
+            try:
+                self.client.publish(self.topic, self._payload(),
+                                    retain=True)
+            except OSError as e:
+                log.warning("ad refresh lost broker: %s", e)
+                return
 
     def retract(self) -> None:
+        self._stop.set()
+        if self._refresher is not None:
+            self._refresher.join(timeout=2.0)
+            self._refresher = None
         self.client.publish(self.topic, b"", retain=True)  # tombstone
         self.client.close()
 
@@ -152,6 +199,25 @@ class ServerDiscovery:
             time.sleep(settle)  # collect the rest of the retained burst
         with self._lock:
             return self._live_locked()
+
+    def servers_now(self) -> List[Tuple[str, int]]:
+        """Non-blocking live-server snapshot (stale ads evicted) — the
+        balancer's per-route refresh, vs ``wait_servers`` which blocks
+        for the first ad."""
+        with self._lock:
+            return self._live_locked()
+
+    def load(self, host: str, port: int) -> Optional[dict]:
+        """The raw ``load`` block of this endpoint's latest ad, or None
+        when the ad carries none (pre-fleet replica, or the endpoint is
+        unknown). Parsing/validation is the balancer's job
+        (``query.balance.parse_ad_load``)."""
+        with self._lock:
+            info = self._meta.get(f"{host}:{port}")
+        if not info:
+            return None
+        load = info.get("load")
+        return load if isinstance(load, dict) else None
 
     def metrics_endpoints(self) -> List[Tuple[str, int]]:
         """``(host, metrics_port)`` for every live server whose ad

@@ -366,6 +366,10 @@ class PendingEntry:
     deadline_t: Optional[float] = None  # monotonic; None = no deadline
     sent_t: float = 0.0
     sent_wall: float = 0.0  # advisory wall stamp for dist-trace splits
+    #: (host, port) the last send went to — RTT observations credit this
+    #: endpoint's EndpointStats, not whichever endpoint is current when
+    #: the result lands (a hedged result may arrive after a failover)
+    endpoint: Optional[Tuple[str, int]] = None
 
     def slack_s(self, now: float) -> float:
         """Wire slack for this send: negative = no deadline; 0.0 = the

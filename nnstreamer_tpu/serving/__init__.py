@@ -54,10 +54,14 @@ def __getattr__(name: str):
         from nnstreamer_tpu.serving import engine as _engine
 
         return getattr(_engine, name)
+    if name == "FleetLauncher":
+        from nnstreamer_tpu.serving.fleet import FleetLauncher
+
+        return FleetLauncher
     raise AttributeError(name)
 
 
 __all__ = ["ContinuousBatchingEngine", "GenerationStream",
            "register_engine", "get_engine", "unregister_engine",
            "SloScheduler", "SloRejected", "ServiceRateEstimator",
-           "FeedbackController"]
+           "FeedbackController", "FleetLauncher"]

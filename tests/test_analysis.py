@@ -694,6 +694,38 @@ class TestAstLint:
         assert by_code(lint_source(src, "elements/foo.py"),
                        "NNS117") == []
 
+    def test_nns119_hardcoded_endpoint_literal(self):
+        src = ("def connect():\n"
+               "    ep = '127.0.0.1:3000'\n"
+               "    return ep\n")
+        assert "NNS119" in codes(lint_source(src, "elements/foo.py"))
+
+    def test_nns119_hostname_form_flagged(self):
+        src = "BROKER = 'edge-broker.local:1883'\n"
+        assert "NNS119" in codes(lint_source(src, "serving/x.py"))
+
+    def test_nns119_non_endpoints_pass(self):
+        # times, ratios, short ports, and plain hosts must not match
+        src = ("a = '12:30'\n"          # clock time: no letter/dot host
+               "b = 'C:1'\n"           # 1-digit port
+               "c = 'host:port'\n"     # no numeric port
+               "d = '127.0.0.1'\n"     # no port at all
+               "e = 'a label: 42 things'\n")
+        assert by_code(lint_source(src, "elements/foo.py"),
+                       "NNS119") == []
+
+    def test_nns119_discovery_config_and_tests_exempt(self):
+        src = "DEFAULT = '127.0.0.1:1883'\n"
+        for rel in ("query/discovery.py", "config.py",
+                    "tests/test_x.py", "test_foo.py"):
+            assert by_code(lint_source(src, rel), "NNS119") == [], rel
+
+    def test_nns119_pragma_suppressible(self):
+        src = ("WELL_KNOWN = '127.0.0.1:1883'  # nns-lint: "
+               "disable=NNS119 -- the MQTT standard port default\n")
+        assert by_code(lint_source(src, "elements/foo.py"),
+                       "NNS119") == []
+
     def test_pragma_suppresses_with_reason(self):
         src = ("import time\n"
                "d = time.time()  # nns-lint: disable=NNS101 -- epoch "

@@ -1,0 +1,356 @@
+"""Shortest-slack balancer tests (query/balance.py + the client's
+balance mode).
+
+Covers the pure policy (scoring, ranking determinism, ad-load parsing
+incl. the pre-fleet load-unknown compat contract), the per-endpoint RTT
+stats regression (a shared EndpointStats once gave every server the
+same hedge timeout), the kill switches (``balance=off`` /
+``NNSTPU_FLEET=0`` keep the exact single-connection resilient path),
+and the 2-replica loopback behavior: a stalled replica sheds its share
+of routes to its healthy sibling.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.pipeline.element import FlowError
+from nnstreamer_tpu.query import balance as B
+from nnstreamer_tpu.query import resilience as R
+from nnstreamer_tpu.registry import ELEMENT, get_subplugin
+from nnstreamer_tpu.tensors.buffer import TensorBuffer
+
+
+# ---------------------------------------------------------------------------
+# policy: parse_ad_load
+# ---------------------------------------------------------------------------
+class TestParseAdLoad:
+    def test_pre_fleet_ad_is_load_unknown(self):
+        # the exact ad shape every pre-fleet server publishes
+        # (discovery.py before the load block existed) — pinned: it must
+        # parse as load-unknown, not as zero load, so a mixed fleet
+        # balances on RTT alone instead of favoring old replicas
+        old_ad = {"host": "127.0.0.1", "port": 3000, "ts": 123.0}
+        assert B.parse_ad_load(old_ad) is None
+
+    def test_none_and_malformed(self):
+        assert B.parse_ad_load(None) is None
+        assert B.parse_ad_load({}) is None
+        assert B.parse_ad_load({"load": "busy"}) is None
+        assert B.parse_ad_load({"load": {"queue_depth": "many"}}) is None
+
+    def test_full_block(self):
+        load = B.parse_ad_load({"load": {
+            "queue_depth": 3, "service_ms": 7.5,
+            "slack_headroom_ms": -12.0}})
+        assert load == B.EndpointLoad(queue_depth=3, service_ms=7.5,
+                                      slack_headroom_ms=-12.0)
+
+    def test_partial_block(self):
+        load = B.parse_ad_load({"load": {"queue_depth": 2}})
+        assert load.queue_depth == 2
+        assert load.service_ms is None
+        assert load.slack_headroom_ms is None
+
+
+# ---------------------------------------------------------------------------
+# policy: score / rank
+# ---------------------------------------------------------------------------
+class TestScore:
+    def test_monotone_in_inflight(self):
+        assert B.score(0.01, 0, None) < B.score(0.01, 1, None) \
+            < B.score(0.01, 4, None)
+
+    def test_monotone_in_queue_depth(self):
+        shallow = B.EndpointLoad(queue_depth=1, service_ms=5.0)
+        deep = B.EndpointLoad(queue_depth=10, service_ms=5.0)
+        assert B.score(0.01, 0, shallow) < B.score(0.01, 0, deep)
+
+    def test_negative_headroom_penalized(self):
+        ok = B.EndpointLoad(queue_depth=0, service_ms=5.0,
+                            slack_headroom_ms=20.0)
+        over = B.EndpointLoad(queue_depth=0, service_ms=5.0,
+                              slack_headroom_ms=-50.0)
+        assert B.score(0.01, 0, over) - B.score(0.01, 0, ok) == \
+            pytest.approx(0.05)
+
+    def test_load_unknown_falls_back_to_rtt_and_inflight(self):
+        # no load block: inflight still differentiates (converted
+        # through the RTT), so join-shortest-queue survives old ads
+        assert B.score(0.01, 0, None) < B.score(0.01, 3, None)
+
+    def test_cold_endpoint_outranks_warm(self):
+        # an unsampled endpoint (rtt None → DEFAULT_RTT_S) must score
+        # below any realistically-warmed sibling so it gets probed
+        assert B.score(None, 0, None) < B.score(0.002, 0, None)
+
+    def test_rank_orders_and_tie_breaks_deterministically(self):
+        a, b, c = ("hostA", 1), ("hostB", 2), ("hostC", 3)
+        ranked = B.rank([(c, 0.01, 0, None), (a, 0.01, 0, None),
+                         (b, 0.05, 0, None)])
+        # equal scores (a, c) tie-break on the endpoint tuple
+        assert [ep for _, ep in ranked] == [a, c, b]
+        again = B.rank([(a, 0.01, 0, None), (c, 0.01, 0, None),
+                        (b, 0.05, 0, None)])
+        assert ranked == again
+
+
+# ---------------------------------------------------------------------------
+# satellite regression: per-endpoint RTT stats
+# ---------------------------------------------------------------------------
+class TestPerEndpointStats:
+    def test_two_endpoints_get_distinct_hedge_timeouts(self):
+        """Regression: _r_stats was ONE EndpointStats shared by every
+        server, so a slow replica inflated the fast replica's hedge
+        timer. Two endpoints with 10x different RTTs must keep
+        independent stats and different hedge timeouts."""
+        Client = get_subplugin(ELEMENT, "tensor_query_client")
+        cl = Client(reliable=True)
+        try:
+            fast = cl._r_stat("fast", 1000)
+            slow = cl._r_stat("slow", 2000)
+            assert fast is not slow
+            for _ in range(R.EndpointStats.MIN_SAMPLES):
+                fast.observe(0.010)
+                slow.observe(0.100)
+            floor = 0.001
+            assert cl._r_stat("fast", 1000) is fast  # stable identity
+            t_fast = fast.hedge_timeout(floor)
+            t_slow = slow.hedge_timeout(floor)
+            assert t_slow > t_fast * 5
+        finally:
+            cl.stop()
+
+
+# ---------------------------------------------------------------------------
+# kill switches
+# ---------------------------------------------------------------------------
+class TestKillSwitches:
+    def test_balance_off_is_default_and_off(self):
+        Client = get_subplugin(ELEMENT, "tensor_query_client")
+        cl = Client(reliable=True)
+        try:
+            assert cl.get_property("balance") == "off"
+            assert not cl._balance_on()
+        finally:
+            cl.stop()
+
+    def test_fleet_env_zero_disables(self, monkeypatch):
+        monkeypatch.setenv("NNSTPU_FLEET", "0")
+        Client = get_subplugin(ELEMENT, "tensor_query_client")
+        cl = Client(reliable=True, balance="shortest-slack")
+        try:
+            assert not cl._balance_on()
+        finally:
+            cl.stop()
+
+    def test_unknown_mode_rejected(self):
+        Client = get_subplugin(ELEMENT, "tensor_query_client")
+        cl = Client(reliable=True, balance="round-robin")
+        try:
+            with pytest.raises(FlowError, match="balance"):
+                cl._balance_on()
+        finally:
+            cl.stop()
+
+    def test_balance_requires_reliable(self):
+        Client = get_subplugin(ELEMENT, "tensor_query_client")
+        cl = Client(balance="shortest-slack")
+        try:
+            with pytest.raises(FlowError, match="reliable"):
+                cl.chain(cl.sinkpad, TensorBuffer(
+                    [np.zeros(2, np.float32)], pts=0))
+        finally:
+            cl.stop()
+
+    def test_balance_off_never_touches_balance_state(self):
+        """The byte-identical pin: with balance=off the single-server
+        resilient path runs and NO balance-mode state is ever built —
+        the exact PR-19 transport."""
+        src, stop, invokes = _echo_server()
+        Client = get_subplugin(ELEMENT, "tensor_query_client")
+        cl = Client(port=src.port, reliable=True, max_in_flight=2,
+                    timeout=5.0)
+        outs = []
+        cl.srcpad.push = lambda b: outs.append(b)
+        try:
+            for i in range(10):
+                cl.chain(cl.sinkpad, TensorBuffer(
+                    [np.full((4,), i, dtype=np.float32)], pts=i))
+            cl.handle_eos()
+            assert len(outs) == 10
+            assert sorted(int(o.to_host().tensors[0][0])
+                          for o in outs) == [2 * i for i in range(10)]
+            assert cl._b_channels == {}
+            assert cl._b_pending == {}
+            assert cl._b_discovery is None
+        finally:
+            stop.set()
+            cl.stop()
+            src.stop()
+
+
+# ---------------------------------------------------------------------------
+# loopback: 2 endpoints, balanced
+# ---------------------------------------------------------------------------
+def _echo_server(delay_s: float = 0.0):
+    """(serversrc, stopper, invokes): resilient echo x2 server whose
+    worker optionally sleeps ``delay_s`` per frame (a stalled replica)."""
+    Src = get_subplugin(ELEMENT, "tensor_query_serversrc")
+    src = Src(port=0, reliable=True)
+    src.start()
+    server = src.server
+    stop = threading.Event()
+    invokes = []
+
+    def worker():
+        while not stop.is_set():
+            try:
+                buf = server.get_buffer(timeout=0.1)
+            except Exception:
+                return
+            if buf is None:
+                continue
+            invokes.append(buf.meta.get("net_req_id"))
+            if delay_s:
+                time.sleep(delay_s)
+            out = TensorBuffer([t * 2 for t in buf.to_host().tensors],
+                               pts=buf.pts)
+            out.meta.update(buf.meta)
+            server.send_result(buf.meta["query_client_id"], out)
+
+    threading.Thread(target=worker, daemon=True).start()
+    return src, stop, invokes
+
+
+class TestBalancedLoopback:
+    def _run_pair(self, n, delay_a=0.0, delay_b=0.0, **client_props):
+        sa, stop_a, inv_a = _echo_server(delay_a)
+        sb, stop_b, inv_b = _echo_server(delay_b)
+        Client = get_subplugin(ELEMENT, "tensor_query_client")
+        props = dict(servers=f"127.0.0.1:{sa.port},127.0.0.1:{sb.port}",
+                     reliable=True, balance="shortest-slack",
+                     max_in_flight=4, timeout=5.0)
+        props.update(client_props)
+        cl = Client(**props)
+        outs = []
+        cl.srcpad.push = lambda b: outs.append(b)
+        try:
+            for i in range(n):
+                cl.chain(cl.sinkpad, TensorBuffer(
+                    [np.full((4,), i, dtype=np.float32)], pts=i))
+            cl.handle_eos()
+        finally:
+            stop_a.set()
+            stop_b.set()
+            cl.stop()
+            sa.stop()
+            sb.stop()
+        return outs, inv_a, inv_b
+
+    def test_both_replicas_serve_exactly_once_in_order(self):
+        outs, inv_a, inv_b = self._run_pair(60)
+        assert len(outs) == 60
+        # in-order delivery despite N channels (req_id watermark)
+        assert [int(o.to_host().tensors[0][0]) for o in outs] == \
+            [2 * i for i in range(60)]
+        assert len(inv_a) + len(inv_b) == 60
+        assert len(set(inv_a) | set(inv_b)) == 60  # no double invoke
+        assert inv_a and inv_b  # both replicas actually probed
+
+    def test_stalled_replica_sheds_routes_to_sibling(self):
+        """The acceptance behavior: a 100ms stall on replica A shifts
+        the bulk (>80%) of subsequent routes to healthy replica B."""
+        outs, inv_a, inv_b = self._run_pair(60, delay_a=0.1)
+        assert len(outs) == 60
+        assert len(set(inv_a) | set(inv_b)) == 60
+        assert len(inv_b) > 0.8 * 60
+
+    def test_breaker_open_endpoint_excluded(self):
+        """An endpoint whose breaker is open never appears among the
+        balance candidates."""
+        sa, stop_a, inv_a = _echo_server()
+        sb, stop_b, inv_b = _echo_server()
+        Client = get_subplugin(ELEMENT, "tensor_query_client")
+        cl = Client(servers=f"127.0.0.1:{sa.port},127.0.0.1:{sb.port}",
+                    reliable=True, balance="shortest-slack",
+                    max_in_flight=2, timeout=5.0)
+        outs = []
+        cl.srcpad.push = lambda b: outs.append(b)
+        try:
+            br = cl._r_breaker("127.0.0.1", sa.port)
+            for _ in range(100):  # force open regardless of threshold
+                br.record_failure()
+                if not br.allow():
+                    break
+            assert not br.allow()
+            cands = cl._b_candidates()
+            eps = [ep for ep, _, _, _ in cands]
+            assert ("127.0.0.1", sa.port) not in eps
+            assert ("127.0.0.1", sb.port) in eps
+            for i in range(10):
+                cl.chain(cl.sinkpad, TensorBuffer(
+                    [np.full((4,), i, dtype=np.float32)], pts=i))
+            cl.handle_eos()
+            assert len(outs) == 10
+            assert not inv_a  # everything went to the healthy sibling
+            assert len(inv_b) == 10
+        finally:
+            stop_a.set()
+            stop_b.set()
+            cl.stop()
+            sa.stop()
+            sb.stop()
+
+
+# ---------------------------------------------------------------------------
+# discovery ads: live load signal
+# ---------------------------------------------------------------------------
+class TestAdRefresh:
+    def test_refreshed_ad_carries_load_and_old_ads_parse_unknown(self):
+        from nnstreamer_tpu.query.discovery import (
+            ServerAdvertiser,
+            ServerDiscovery,
+        )
+        from nnstreamer_tpu.query.pubsub import Broker
+
+        broker = Broker(port=0).start()
+        try:
+            depth = [0]
+            adv = ServerAdvertiser(
+                "127.0.0.1", broker.port, "adtest", "127.0.0.1", 4321,
+                load_fn=lambda: {"queue_depth": depth[0],
+                                 "service_ms": 5.0},
+                refresh_s=0.05)
+            # a pre-fleet peer on the same operation: no load block
+            old = ServerAdvertiser("127.0.0.1", broker.port, "adtest",
+                                   "127.0.0.1", 4322)
+            disco = ServerDiscovery("127.0.0.1", broker.port, "adtest")
+            try:
+                adv.publish()
+                old.publish()
+                disco.wait_servers(timeout=5.0)
+                load = disco.load("127.0.0.1", 4321)
+                assert load == {"queue_depth": 0, "service_ms": 5.0}
+                # the refresh loop picks up live changes
+                depth[0] = 7
+                deadline = time.monotonic() + 5.0
+                while time.monotonic() < deadline:
+                    load = disco.load("127.0.0.1", 4321)
+                    if load and load.get("queue_depth") == 7:
+                        break
+                    time.sleep(0.02)
+                assert load["queue_depth"] == 7
+                # compat: the old peer's ad is load-unknown, not zero
+                assert disco.load("127.0.0.1", 4322) is None
+                assert B.parse_ad_load(
+                    {"load": disco.load("127.0.0.1", 4322)}) is None
+            finally:
+                adv.retract()
+                old.retract()
+                disco.close()
+        finally:
+            broker.stop()
